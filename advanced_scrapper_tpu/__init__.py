@@ -1,0 +1,27 @@
+"""advanced_scrapper_tpu — a TPU-native framework with the capabilities of
+``lwowlwowl/advanced_scrapper``.
+
+Layer map (successor of the reference's five de-facto layers, SURVEY.md §1):
+
+- ``core``       device runtime: byte tokenizer, batch specs, mesh builders
+- ``ops``        JAX/Pallas kernels: shingling, MinHash, LSH, exact-hash,
+                 entity-match screening
+- ``parallel``   pjit/shard_map sharding, psum bucket merge, multi-host init,
+                 host feed scheduler
+- ``cpu``        CPU reference oracles (datasketch-parity MinHash,
+                 rapidfuzz-parity partial_ratio) with C++ native backends
+- ``extractors`` the reference's plugin boundary: ``extract_article_data(soup)
+                 -> dict`` plus the declarative template interpreter and the
+                 TPU batch backend (north star)
+- ``pipeline``   the four workloads: CDX harvest, constant-rate scrape,
+                 Wikidata enrichment, ticker→article matching
+- ``storage``    resumable CSV stores, link DBs, progress ledgers
+- ``net``        fetch transports and the TCP lease protocol
+- ``obs``        windowed stats, console mux, profiler hooks
+"""
+
+from advanced_scrapper_tpu.config import Config, default_config
+
+__version__ = "0.1.0"
+
+__all__ = ["Config", "default_config", "__version__"]

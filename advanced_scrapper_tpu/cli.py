@@ -1,0 +1,130 @@
+"""Command-line interface.
+
+The reference has no CLI at all — every knob is a module constant and every
+workload a hand-run script (SURVEY.md §5.6).  Here each pipeline is an
+``astpu`` subcommand; flags override ``ASTPU_*`` env vars which override the
+reference-derived defaults in ``config.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import sys
+
+from advanced_scrapper_tpu import __version__, default_config
+
+
+def _cmd_version(args: argparse.Namespace) -> int:
+    print(__version__)
+    return 0
+
+
+def _cmd_config(args: argparse.Namespace) -> int:
+    import dataclasses
+
+    print(json.dumps(dataclasses.asdict(default_config()), indent=2, default=str))
+    return 0
+
+
+def _cmd_dedup(args: argparse.Namespace) -> int:
+    """Near-dup dedup of a newline-delimited text file (one doc per line)."""
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    cfg = default_config().dedup
+    engine = NearDupEngine(cfg)
+    with open(args.input, "r", encoding="utf-8", errors="replace") as f:
+        docs = [line.rstrip("\n") for line in f]
+    reps = engine.dedup_reps(docs)
+    kept = 0
+    sink = (
+        open(args.output, "w", encoding="utf-8")
+        if args.output
+        else contextlib.nullcontext(sys.stdout)
+    )
+    with sink as out:
+        for i, r in enumerate(reps):
+            if r == i:
+                kept += 1
+                out.write(docs[i] + "\n")
+    print(f"kept {kept}/{len(docs)} docs", file=sys.stderr)
+    return 0
+
+
+def _import_pipeline(module: str, attr: str):
+    import importlib
+
+    try:
+        mod = importlib.import_module(f"advanced_scrapper_tpu.pipeline.{module}")
+    except ImportError as e:
+        raise SystemExit(
+            f"astpu: the '{module}' pipeline is not available in this build: {e}"
+        ) from e
+    return getattr(mod, attr)
+
+
+def _cmd_harvest(args: argparse.Namespace) -> int:
+    run_harvest = _import_pipeline("harvest", "run_harvest")
+    return run_harvest(default_config().harvest, transport=args.transport)
+
+
+def _cmd_scrape(args: argparse.Namespace) -> int:
+    run_scraper = _import_pipeline("scraper", "run_scraper")
+    cfg = default_config().scraper
+    if args.transport:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, transport=args.transport)
+    return run_scraper(cfg)
+
+
+def _cmd_enrich(args: argparse.Namespace) -> int:
+    run_enrich = _import_pipeline("enrich", "run_enrich")
+    return run_enrich(default_config().enrich)
+
+
+def _cmd_match(args: argparse.Namespace) -> int:
+    run_matcher = _import_pipeline("matcher", "run_matcher")
+    return run_matcher(default_config().match)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="astpu",
+        description="TPU-native financial-news harvesting/dedup/matching framework",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("version", help="print version").set_defaults(fn=_cmd_version)
+    sub.add_parser("config", help="print effective config").set_defaults(fn=_cmd_config)
+
+    d = sub.add_parser("dedup", help="near-dup dedup of a line-delimited corpus")
+    d.add_argument("input")
+    d.add_argument("-o", "--output", default=None)
+    d.set_defaults(fn=_cmd_dedup)
+
+    h = sub.add_parser("harvest", help="CDX URL harvest -> deduped yfin_urls.csv")
+    h.add_argument("--transport", default=None)
+    h.set_defaults(fn=_cmd_harvest)
+
+    s = sub.add_parser("scrape", help="constant-rate article scrape")
+    s.add_argument("--transport", default=None)
+    s.set_defaults(fn=_cmd_scrape)
+
+    e = sub.add_parser("enrich", help="Wikidata ticker enrichment")
+    e.set_defaults(fn=_cmd_enrich)
+
+    m = sub.add_parser("match", help="ticker→article entity matching")
+    m.set_defaults(fn=_cmd_match)
+
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+"""Typed configuration for every subsystem.
+
+The reference has no config system at all — every knob is a module-level
+constant (``constant_rate_scrapper.py:17-28``, ``client1.py:17-24``,
+``03_worker_multi.py:31``; SURVEY.md §5.6).  Here each subsystem gets a
+frozen dataclass whose *defaults are the reference's operating points*, with
+overrides from environment variables (``ASTPU_<FIELD>``) and from the CLI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import typing
+from dataclasses import dataclass, field, fields
+from typing import Any, Type, TypeVar
+
+T = TypeVar("T")
+
+_ENV_PREFIX = "ASTPU_"
+
+
+def _coerce(raw: str, typ: Any) -> Any:
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(raw)
+    if typ is float:
+        return float(raw)
+    if typ is tuple or typing.get_origin(typ) is tuple:
+        return tuple(float(part) for part in raw.split(",") if part.strip())
+    return raw
+
+
+def from_env(cls: Type[T], **overrides: Any) -> T:
+    """Build a config dataclass, applying ASTPU_* env vars then overrides."""
+    kwargs: dict[str, Any] = {}
+    # PEP 563 postponed annotations make ``field.type`` a string; resolve the
+    # real types so _coerce's identity checks work.
+    hints = typing.get_type_hints(cls)
+    for f in fields(cls):  # type: ignore[arg-type]
+        env_key = _ENV_PREFIX + f.name.upper()
+        if env_key in os.environ:
+            kwargs[f.name] = _coerce(os.environ[env_key], hints.get(f.name, str))
+    kwargs.update({k: v for k, v in overrides.items() if v is not None})
+    return cls(**kwargs)  # type: ignore[call-arg]
+
+
+@dataclass(frozen=True)
+class ScraperConfig:
+    """Constant-rate acquisition engine (ref constant_rate_scrapper.py:17-28)."""
+
+    website: str = "yfin"
+    input_csv: str = "yfin_urls.csv"
+    desired_request_rate: float = 5.8   # ref :17
+    max_threads: int = 16               # ref :20
+    stats_time_window: float = 10.0     # ref :23
+    rate_limit_wait: float = 200.0      # ref :28
+    page_load_timeout: float = 30.0     # ref :139
+    ready_state_timeout: float = 10.0   # ref :151
+    result_timeout: float = 60.0        # ref :439
+    transport: str = "auto"             # auto|selenium|requests|mock
+    out_dir: str = "."
+
+
+@dataclass(frozen=True)
+class HarvestConfig:
+    """CDX URL-discovery shard sweep (ref yahoo_links_selenium.py:19-34)."""
+
+    num_workers: int = 10               # ref :19
+    shard_dir: str = "yahoo_links_1"    # ref :29
+    output_csv: str = "yfin_urls.csv"   # ref :178
+    cdx_base: str = "http://web.archive.org/cdx/search/"
+    target_pattern: str = "https://www.finance.yahoo.com/news/{prefix}*"
+    ready_state_timeout: float = 3.0    # ref :43
+    transport: str = "auto"
+
+
+@dataclass(frozen=True)
+class EnrichConfig:
+    """Wikidata SPARQL enrichment (ref ticker_symbol_query*.py)."""
+
+    endpoint: str = "https://query.wikidata.org/sparql"
+    symbols_csv: str = "sp500list.csv"  # ref ticker_symbol_query.py:196
+    out_dir: str = "info/ticker"        # ref :191
+    hardened: bool = True
+    max_retries: int = 5                # ref protected :34
+    base_delay: float = 5.0             # ref protected :34
+    connect_timeout: float = 15.0       # ref protected :212
+    read_timeout: float = 60.0          # ref protected :212
+    progress_file: str = "progress.json"  # ref protected :340
+    cooldown_every3: tuple = (15.0, 25.0)   # ref protected :419-421
+    cooldown_every10: tuple = (60.0, 120.0)  # ref protected :423-426
+
+
+@dataclass(frozen=True)
+class MatchConfig:
+    """Entity→article matching (ref match_keywords.py)."""
+
+    source_name: str = "yahoo"          # ref :222
+    info_dir: str = "info/Icahn_filter"  # ref :223
+    articles_csv: str = "datasets/yahoo_articles_all.csv"
+    chunk_size: int = 20000             # ref :227
+    fuzzy_threshold: float = 95.0       # ref :175 (partial_ratio > 95)
+    use_tpu: bool = True
+    out_dir_suffix: str = "_ticker_matched_articles"  # ref :129
+
+
+@dataclass(frozen=True)
+class DedupConfig:
+    """MinHash+LSH near-dup engine (BASELINE.json north star)."""
+
+    shingle_k: int = 5       # k=5 byte shingles
+    num_perm: int = 128      # 128 permutations
+    num_bands: int = 16      # 16-band LSH
+    block_len: int = 4096    # bytes per device block (bucketed padding)
+    batch_size: int = 1024
+    sim_threshold: float = 0.70  # signature-agreement verification threshold
+    seed: int = 1            # datasketch's default seed for oracle parity
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh layout (v5e-8 target: 8 chips, 1 axis of data parallelism
+    plus optional sequence-parallel axis for long articles)."""
+
+    data_axis: str = "data"
+    seq_axis: str = "seq"
+    data_parallel: int = -1  # -1: all devices
+    seq_parallel: int = 1
+
+
+@dataclass(frozen=True)
+class FeedConfig:
+    """Host feed scheduler / distributed lease protocol
+    (ref server1.py:20,102-138, client1.py:17-24,209-234)."""
+
+    host: str = "localhost"
+    port: int = 8000                  # ref server1.py:18
+    max_clients: int = 5              # ref server1.py:20
+    batch_size: int = 20              # ref client1.py:23
+    min_queue_length: int = 10        # ref client1.py:24
+    client_threads: int = 8           # ref client1.py:21
+    client_rate: float = 8.0          # ref client1.py:18
+
+
+@dataclass(frozen=True)
+class Config:
+    scraper: ScraperConfig = field(default_factory=ScraperConfig)
+    harvest: HarvestConfig = field(default_factory=HarvestConfig)
+    enrich: EnrichConfig = field(default_factory=EnrichConfig)
+    match: MatchConfig = field(default_factory=MatchConfig)
+    dedup: DedupConfig = field(default_factory=DedupConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    feed: FeedConfig = field(default_factory=FeedConfig)
+
+    def replace(self, **kw: Any) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+
+def default_config() -> Config:
+    return Config(
+        scraper=from_env(ScraperConfig),
+        harvest=from_env(HarvestConfig),
+        enrich=from_env(EnrichConfig),
+        match=from_env(MatchConfig),
+        dedup=from_env(DedupConfig),
+        mesh=from_env(MeshConfig),
+        feed=from_env(FeedConfig),
+    )

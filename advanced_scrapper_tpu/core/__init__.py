@@ -1,0 +1,19 @@
+from advanced_scrapper_tpu.core.tokenizer import (
+    encode_batch,
+    encode_blocks,
+    bucket_len,
+    to_bytes,
+)
+from advanced_scrapper_tpu.core.hashing import MinHashParams, make_params
+from advanced_scrapper_tpu.core.mesh import build_mesh, local_device_count
+
+__all__ = [
+    "encode_batch",
+    "encode_blocks",
+    "bucket_len",
+    "to_bytes",
+    "MinHashParams",
+    "make_params",
+    "build_mesh",
+    "local_device_count",
+]

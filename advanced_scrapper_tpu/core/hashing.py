@@ -1,0 +1,100 @@
+"""Hash-family parameters for the MinHash kernels and their CPU oracle.
+
+Two parameter sets are derived from one seed:
+
+- **Device family** (``MinHashParams.a32/b32``): 32-bit multiply-add
+  permutations ``h_i(x) = a_i * x + b_i (mod 2**32)`` with odd ``a_i``.
+  uint32 wrap-around multiply is native on TPU vector lanes; no 61-bit
+  arithmetic needed.
+- **Oracle family** (``MinHashParams.a61/b61``): datasketch's exact family
+  ``h_i(x) = ((a_i * x + b_i) mod (2**61 - 1)) & 0xFFFFFFFF`` with
+  ``a_i, b_i`` drawn from ``np.random.RandomState(seed)`` the same way
+  datasketch does, so the CPU oracle in ``cpu/oracle.py`` is
+  permutation-for-permutation identical to datasketch's MinHash.
+
+Near-dup *recall* is measured pair-wise (did both engines flag the pair),
+not signature-wise, so the two families only need to agree statistically on
+Jaccard estimation — which any pairwise-independent family does.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+MERSENNE_PRIME = np.uint64((1 << 61) - 1)
+MAX_HASH = np.uint64((1 << 32) - 1)
+
+
+@dataclass(frozen=True)
+class MinHashParams:
+    num_perm: int
+    num_bands: int
+    shingle_k: int
+    seed: int
+    # device (32-bit) permutation family
+    a32: np.ndarray  # uint32[num_perm], odd
+    b32: np.ndarray  # uint32[num_perm]
+    # band mixing salts for LSH band-key hashing
+    band_salt: np.ndarray  # uint32[num_bands]
+    # oracle (datasketch) permutation family
+    a61: np.ndarray  # uint64[num_perm] in [1, p)
+    b61: np.ndarray  # uint64[num_perm] in [0, p)
+
+    @property
+    def rows_per_band(self) -> int:
+        return self.num_perm // self.num_bands
+
+
+def make_params(
+    num_perm: int = 128,
+    num_bands: int = 16,
+    shingle_k: int = 5,
+    seed: int = 1,
+) -> MinHashParams:
+    if num_perm % num_bands:
+        raise ValueError(f"num_perm {num_perm} not divisible by bands {num_bands}")
+    # Oracle family: exactly datasketch's generator — interleaved (a_i, b_i)
+    # pair draws from one RandomState, matching _init_permutations order.
+    gen = np.random.RandomState(seed)
+    pairs = [
+        (
+            gen.randint(1, int(MERSENNE_PRIME), dtype=np.uint64),
+            gen.randint(0, int(MERSENNE_PRIME), dtype=np.uint64),
+        )
+        for _ in range(num_perm)
+    ]
+    a61 = np.array([p[0] for p in pairs], dtype=np.uint64)
+    b61 = np.array([p[1] for p in pairs], dtype=np.uint64)
+    # Device family: independent stream so the two families are uncorrelated.
+    gen32 = np.random.RandomState((seed + 0x5F3759DF) % (1 << 31))
+    a32 = (gen32.randint(0, 1 << 32, size=num_perm, dtype=np.uint64) | 1).astype(
+        np.uint32
+    )
+    b32 = gen32.randint(0, 1 << 32, size=num_perm, dtype=np.uint64).astype(np.uint32)
+    band_salt = gen32.randint(1, 1 << 32, size=num_bands, dtype=np.uint64).astype(
+        np.uint32
+    )
+    return MinHashParams(
+        num_perm=num_perm,
+        num_bands=num_bands,
+        shingle_k=shingle_k,
+        seed=seed,
+        a32=a32,
+        b32=b32,
+        band_salt=band_salt,
+        a61=a61,
+        b61=b61,
+    )
+
+
+def fmix32_np(h: np.ndarray) -> np.ndarray:
+    """murmur3 32-bit finaliser (numpy mirror of ops.shingle.fmix32)."""
+    h = h.astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    h = (h * np.uint32(0x85EBCA6B)) & MAX_HASH.astype(np.uint32)
+    h ^= h >> np.uint32(13)
+    h = (h * np.uint32(0xC2B2AE35)) & MAX_HASH.astype(np.uint32)
+    h ^= h >> np.uint32(16)
+    return h

@@ -1,0 +1,52 @@
+"""Device-mesh construction.
+
+The reference's parallelism is thread pools, process pools and a TCP
+master/worker star (SURVEY.md §2.4); the TPU-native equivalents are all
+expressed as shardings over one ``jax.sharding.Mesh``:
+
+- ``data`` axis — batch data parallelism (successor of the 16-thread worker
+  pool in ``constant_rate_scrapper.py:417-428`` and the round-robin machine
+  split in ``experiental/split.py``);
+- ``seq``  axis — sequence/block parallelism for long articles (successor of
+  the 20k-row chunk streaming in ``match_keywords.py:227-230``): blocks of
+  one article live on different devices and their MinHash partial minima are
+  combined with a ``psum``-min collective over this axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def build_mesh(
+    data_parallel: int = -1,
+    seq_parallel: int = 1,
+    *,
+    data_axis: str = "data",
+    seq_axis: str = "seq",
+    devices=None,
+) -> Mesh:
+    """Build a ``(data, seq)`` mesh.
+
+    ``data_parallel == -1`` consumes all remaining devices.  On a v5e-8 the
+    default is an 8×1 mesh; pass ``seq_parallel=2/4/8`` to trade batch
+    parallelism for long-article block parallelism.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if seq_parallel < 1 or n % seq_parallel:
+        raise ValueError(f"seq_parallel {seq_parallel} must divide device count {n}")
+    if data_parallel == -1:
+        data_parallel = n // seq_parallel
+    if data_parallel * seq_parallel != n:
+        raise ValueError(
+            f"mesh {data_parallel}x{seq_parallel} != {n} devices available"
+        )
+    grid = np.array(devs).reshape(data_parallel, seq_parallel)
+    return Mesh(grid, (data_axis, seq_axis))

@@ -1,0 +1,141 @@
+"""Byte tokenizer: ragged UTF-8 text → fixed-shape ``uint8[batch, block]``.
+
+The reference feeds ragged Python strings through pandas/rapidfuzz
+(``match_keywords.py:150-151``, ``yahoo_links_selenium.py:59``); XLA needs
+static shapes, so articles become padded byte rows.  Two tricks keep the MXU
+fed without recompilation storms (SURVEY.md §7 "ragged text on fixed
+shapes"):
+
+- **bucketed padding** — block lengths are rounded up to power-of-two
+  buckets so only O(log max_len) distinct shapes are ever compiled;
+- **blockwise splitting** — articles longer than the block are split into
+  overlapping blocks (overlap ``k-1`` bytes so no k-shingle is lost at a
+  boundary); per-block MinHash minima are later combined with ``jnp.minimum``
+  (the TPU analogue of the reference's 20k-row chunked streaming,
+  ``match_keywords.py:227-230``).
+
+Tokenisation is a pure reshape/pad — there is no vocabulary.  Padding byte is
+0x00, which never participates: validity masks come from ``lengths``.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+
+MIN_BUCKET = 64
+
+
+def bucket_len(n: int, min_bucket: int = MIN_BUCKET, max_bucket: int | None = None) -> int:
+    """Round ``n`` up to a power-of-two bucket (≥ min_bucket)."""
+    b = min_bucket
+    while b < n:
+        b <<= 1
+    if max_bucket is not None:
+        b = min(b, max_bucket)
+    return b
+
+
+def to_bytes(text: str | bytes) -> bytes:
+    if isinstance(text, bytes):
+        return text
+    return text.encode("utf-8", errors="replace")
+
+
+def encode_batch(
+    texts: Sequence[str | bytes],
+    block_len: int | None = None,
+    *,
+    min_bucket: int = MIN_BUCKET,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Encode a batch of texts into ``(tokens uint8[B, L], lengths int32[B])``.
+
+    Texts longer than the chosen block are truncated; use
+    :func:`encode_blocks` when full coverage matters (near-dup hashing).
+    When ``block_len`` is None a bucketed length is chosen from the longest
+    text in the batch.
+    """
+    raw = [to_bytes(t) for t in texts]
+    longest = max((len(r) for r in raw), default=1)
+    L = block_len if block_len is not None else bucket_len(max(longest, 1), min_bucket)
+    B = len(raw)
+    tokens = np.zeros((B, L), dtype=np.uint8)
+    lengths = np.zeros((B,), dtype=np.int32)
+    for i, r in enumerate(raw):
+        n = min(len(r), L)
+        tokens[i, :n] = np.frombuffer(r[:n], dtype=np.uint8)
+        lengths[i] = n
+    return tokens, lengths
+
+
+def encode_blocks(
+    texts: Sequence[str | bytes],
+    block_len: int,
+    *,
+    overlap: int = 4,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Encode texts into overlapping fixed-size blocks.
+
+    Returns ``(tokens uint8[N, block_len], lengths int32[N], owner int32[N])``
+    where ``owner[j]`` is the index into ``texts`` of block ``j``.  Blocks
+    overlap by ``overlap`` bytes (pass ``k-1`` for k-shingles) so the set of
+    shingles over all blocks of a text equals the shingles of the whole text.
+    """
+    if block_len <= overlap:
+        raise ValueError(f"block_len {block_len} must exceed overlap {overlap}")
+    stride = block_len - overlap
+    tok_rows: list[np.ndarray] = []
+    lens: list[int] = []
+    owners: list[int] = []
+    for i, t in enumerate(texts):
+        r = to_bytes(t)
+        if not r:
+            r = b"\x00"
+        pos = 0
+        while True:
+            chunk = r[pos : pos + block_len]
+            row = np.zeros((block_len,), dtype=np.uint8)
+            row[: len(chunk)] = np.frombuffer(chunk, dtype=np.uint8)
+            tok_rows.append(row)
+            lens.append(len(chunk))
+            owners.append(i)
+            if pos + block_len >= len(r):
+                break
+            pos += stride
+    return (
+        np.stack(tok_rows),
+        np.asarray(lens, dtype=np.int32),
+        np.asarray(owners, dtype=np.int32),
+    )
+
+
+def pad_batch_to(
+    tokens: np.ndarray, lengths: np.ndarray, batch: int
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """Pad the leading batch dim up to ``batch`` rows (returns real count)."""
+    n = tokens.shape[0]
+    if n == batch:
+        return tokens, lengths, n
+    if n > batch:
+        raise ValueError(f"batch {n} exceeds target {batch}")
+    pad_t = np.zeros((batch - n,) + tokens.shape[1:], dtype=tokens.dtype)
+    pad_l = np.zeros((batch - n,), dtype=lengths.dtype)
+    return np.concatenate([tokens, pad_t]), np.concatenate([lengths, pad_l]), n
+
+
+def iter_batches(
+    texts: Iterable[str | bytes], batch_size: int, block_len: int
+) -> Iterable[tuple[np.ndarray, np.ndarray, int]]:
+    """Yield fixed-shape ``(tokens, lengths, n_valid)`` batches."""
+    buf: list[str | bytes] = []
+    for t in texts:
+        buf.append(t)
+        if len(buf) == batch_size:
+            tok, ln = encode_batch(buf, block_len)
+            yield tok, ln, len(buf)
+            buf = []
+    if buf:
+        tok, ln = encode_batch(buf, block_len)
+        tok, ln, n = pad_batch_to(tok, ln, batch_size)
+        yield tok, ln, n

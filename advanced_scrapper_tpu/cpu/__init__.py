@@ -1,0 +1,22 @@
+from advanced_scrapper_tpu.cpu.oracle import (
+    sha1_hash32,
+    oracle_signature,
+    oracle_signatures,
+    oracle_candidate_pairs,
+    oracle_dedup_reps,
+    shingle_set,
+    jaccard,
+)
+from advanced_scrapper_tpu.cpu.fuzz import ratio, partial_ratio
+
+__all__ = [
+    "sha1_hash32",
+    "oracle_signature",
+    "oracle_signatures",
+    "oracle_candidate_pairs",
+    "oracle_dedup_reps",
+    "shingle_set",
+    "jaccard",
+    "ratio",
+    "partial_ratio",
+]

@@ -1,0 +1,74 @@
+"""Reference implementation of rapidfuzz's ``fuzz.ratio`` / ``fuzz.partial_ratio``.
+
+``match_keywords.py:175-176`` gates the fuzzy entity-match path on
+``rapidfuzz.fuzz.partial_ratio(text, name) > 95``.  rapidfuzz is not
+installable here, so this module is the semantic reference:
+
+- ``ratio(s1, s2)``: normalised indel similarity,
+  ``100 * (1 - dist / (len1 + len2))`` where ``dist`` is the
+  insertion/deletion-only edit distance ``len1 + len2 - 2*LCS``.
+- ``partial_ratio(s1, s2)``: the shorter string slides over the longer; the
+  score is the max ``ratio`` over windows of the shorter string's length,
+  including the partial windows overhanging either end.  When the shorter
+  string is empty, 100.0 is returned (an empty window matches perfectly) —
+  mirroring rapidfuzz's behaviour for empty needles.
+
+This pure-Python version is the oracle for tests and small inputs; the C++
+twin in ``native/fastmatch.cpp`` (bit-parallel Hyyrö LCS) is the production
+verifier behind the TPU q-gram screen (``ops/match.py``), loaded via
+``cpu/native.py``.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+
+def _lcs_len(a: str, b: str) -> int:
+    """Classic O(|a|·|b|) LCS-length DP (row-rolling)."""
+    if not a or not b:
+        return 0
+    if len(a) < len(b):
+        a, b = b, a
+    prev = [0] * (len(b) + 1)
+    for ca in a:
+        cur = [0] * (len(b) + 1)
+        for j, cb in enumerate(b, 1):
+            cur[j] = prev[j - 1] + 1 if ca == cb else max(prev[j], cur[j - 1])
+        prev = cur
+    return prev[-1]
+
+
+def indel_distance(s1: str, s2: str) -> int:
+    return len(s1) + len(s2) - 2 * _lcs_len(s1, s2)
+
+
+def ratio(s1: str, s2: str) -> float:
+    total = len(s1) + len(s2)
+    if total == 0:
+        return 100.0
+    return 100.0 * (1.0 - indel_distance(s1, s2) / total)
+
+
+def partial_ratio(s1: str, s2: str) -> float:
+    shorter, longer = (s1, s2) if len(s1) <= len(s2) else (s2, s1)
+    m, n = len(shorter), len(longer)
+    if m == 0:
+        return 100.0
+    best = 0.0
+    # Every window of length m, plus the overhanging partial windows.
+    for start in range(-(m - 1), n):
+        lo, hi = max(0, start), min(n, start + m)
+        if hi <= lo:
+            continue
+        sc = ratio(shorter, longer[lo:hi])
+        if sc > best:
+            best = sc
+            if best >= 100.0:
+                break
+    return best
+
+
+@lru_cache(maxsize=65536)
+def partial_ratio_cached(s1: str, s2: str) -> float:
+    return partial_ratio(s1, s2)

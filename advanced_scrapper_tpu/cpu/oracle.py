@@ -1,0 +1,132 @@
+"""CPU MinHash/LSH oracle — algorithm-identical to ``datasketch``.
+
+``datasketch`` is the recall baseline named in BASELINE.json but is not
+installable in this environment, so this module re-implements its exact
+algorithm (verified against the published datasketch behaviour):
+
+- base hash: first 4 bytes of SHA1, little-endian (``sha1_hash32``);
+- permutations: ``h_i(x) = ((a_i·x + b_i) mod (2^61 - 1)) & 0xFFFFFFFF``
+  with ``a_i ∈ [1, p)``, ``b_i ∈ [0, p)`` drawn from
+  ``np.random.RandomState(seed)`` in datasketch's order (``core.hashing``);
+- signature: elementwise min over the shingle set, initialised to 2^32-1;
+- LSH: hash-table buckets keyed by band tuples (16 bands × 8 rows).
+
+This oracle defines ground truth for the ≥0.95 near-dup recall metric and
+is deliberately simple, slow and obviously-correct numpy.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from collections import defaultdict
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from advanced_scrapper_tpu.core.hashing import MAX_HASH, MERSENNE_PRIME, MinHashParams
+
+
+def sha1_hash32(data: bytes) -> int:
+    """datasketch's default hash: low 4 bytes of SHA1, little-endian."""
+    return struct.unpack("<I", hashlib.sha1(data).digest()[:4])[0]
+
+
+def shingle_set(text: str | bytes, k: int) -> set[bytes]:
+    raw = text.encode("utf-8", errors="replace") if isinstance(text, str) else text
+    if len(raw) < k:
+        return set()
+    return {raw[i : i + k] for i in range(len(raw) - k + 1)}
+
+
+def jaccard(a: set, b: set) -> float:
+    if not a and not b:
+        return 1.0
+    if not a or not b:
+        return 0.0
+    return len(a & b) / len(a | b)
+
+
+def oracle_signature(text: str | bytes, params: MinHashParams) -> np.ndarray:
+    """uint64[num_perm] signature, exactly as datasketch.MinHash.update()."""
+    hv = np.full(params.num_perm, int(MAX_HASH), dtype=np.uint64)
+    for sh in shingle_set(text, params.shingle_k):
+        x = np.uint64(sha1_hash32(sh))
+        phv = ((params.a61 * x + params.b61) % MERSENNE_PRIME) & MAX_HASH
+        hv = np.minimum(hv, phv)
+    return hv
+
+
+def oracle_signatures(
+    texts: Sequence[str | bytes], params: MinHashParams
+) -> np.ndarray:
+    return np.stack([oracle_signature(t, params) for t in texts])
+
+
+def band_tuples(sig: np.ndarray, params: MinHashParams) -> list[tuple]:
+    r = params.rows_per_band
+    return [tuple(sig[b * r : (b + 1) * r].tolist()) for b in range(params.num_bands)]
+
+
+def oracle_candidate_pairs(
+    sigs: np.ndarray, params: MinHashParams
+) -> set[tuple[int, int]]:
+    """All (i < j) pairs sharing at least one LSH band bucket."""
+    pairs: set[tuple[int, int]] = set()
+    for b in range(params.num_bands):
+        buckets: dict[tuple, list[int]] = defaultdict(list)
+        r = params.rows_per_band
+        for i in range(sigs.shape[0]):
+            buckets[tuple(sigs[i, b * r : (b + 1) * r].tolist())].append(i)
+        for members in buckets.values():
+            if len(members) > 1:
+                members.sort()
+                for x in range(len(members)):
+                    for y in range(x + 1, len(members)):
+                        pairs.add((members[x], members[y]))
+    return pairs
+
+
+def estimated_jaccard(sig_a: np.ndarray, sig_b: np.ndarray) -> float:
+    return float(np.mean(sig_a == sig_b))
+
+
+def oracle_dedup_reps(
+    texts: Sequence[str | bytes],
+    params: MinHashParams,
+    threshold: float,
+) -> np.ndarray:
+    """First-seen-wins union-find dedup, the CPU twin of
+    ``ops.lsh.duplicate_reps`` + ``resolve_reps``."""
+    sigs = oracle_signatures(texts, params)
+    n = len(texts)
+    parent = np.arange(n)
+
+    def find(i: int) -> int:
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    for i, j in sorted(oracle_candidate_pairs(sigs, params)):
+        if estimated_jaccard(sigs[i], sigs[j]) >= threshold:
+            ri, rj = find(i), find(j)
+            if ri != rj:
+                lo, hi = min(ri, rj), max(ri, rj)
+                parent[hi] = lo
+    return np.array([find(i) for i in range(n)], dtype=np.int32)
+
+
+def oracle_near_dup_pairs(
+    texts: Sequence[str | bytes],
+    params: MinHashParams,
+    threshold: float,
+) -> set[tuple[int, int]]:
+    """Candidate pairs whose estimated Jaccard clears ``threshold`` —
+    the pair set the recall metric is computed against."""
+    sigs = oracle_signatures(texts, params)
+    return {
+        (i, j)
+        for i, j in oracle_candidate_pairs(sigs, params)
+        if estimated_jaccard(sigs[i], sigs[j]) >= threshold
+    }

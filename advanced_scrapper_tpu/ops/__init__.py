@@ -1,0 +1,22 @@
+from advanced_scrapper_tpu.ops.shingle import fmix32, shingle_hash
+from advanced_scrapper_tpu.ops.minhash import (
+    minhash_signatures,
+    combine_block_signatures,
+)
+from advanced_scrapper_tpu.ops.lsh import (
+    band_keys,
+    duplicate_reps,
+    bucket_histogram,
+)
+from advanced_scrapper_tpu.ops.exact import row_hash128
+
+__all__ = [
+    "fmix32",
+    "shingle_hash",
+    "minhash_signatures",
+    "combine_block_signatures",
+    "band_keys",
+    "duplicate_reps",
+    "bucket_histogram",
+    "row_hash128",
+]

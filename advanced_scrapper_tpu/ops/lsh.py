@@ -1,0 +1,130 @@
+"""16-band LSH bucketing and device-side first-seen-wins deduplication.
+
+The reference dedups with pandas ``drop_duplicates(keep='first')``
+(``yahoo_links_selenium.py:79,174``) — a hash-table walk on one CPU core.
+The TPU formulation turns "same bucket" into a *sort*: for every band,
+rows are sorted by (band key, row index); equal-key runs are bucket
+collisions, and the run head (smallest row index — i.e. first seen) becomes
+every member's candidate representative.  A signature-agreement check
+verifies candidates, and log₂(B) rounds of pointer jumping resolve chains so
+the final representative array has union-find semantics — all without
+leaving the device or introducing data-dependent shapes.
+
+Sorting is the idiomatic XLA substitute for hash tables: ``lax.sort`` is a
+fused multi-operand bitonic sort that tiles well on TPU, whereas scattered
+hash-table updates would serialise.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from advanced_scrapper_tpu.ops.shingle import FNV_OFFSET, FNV_PRIME, U32_MAX, fmix32
+
+
+@jax.jit
+def band_keys(sig: jnp.ndarray, band_salt: jnp.ndarray) -> jnp.ndarray:
+    """Fold each band's rows into one salted uint32 bucket key.
+
+    ``sig`` is ``uint32[B, num_perm]``; returns ``uint32[B, num_bands]``.
+    The north-star config is 16 bands × 8 rows (BASELINE.json).
+    """
+    B, P = sig.shape
+    nb = band_salt.shape[0]
+    r = P // nb
+    rows = sig.reshape(B, nb, r)
+    k = jnp.full((B, nb), FNV_OFFSET, dtype=jnp.uint32)
+    for j in range(r):
+        k = (k ^ rows[:, :, j]) * FNV_PRIME
+    return fmix32(k ^ band_salt[None, :])
+
+
+def _run_head_per_band(kt: jnp.ndarray, idxb: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """For each band row (axis 1 = batch): sorted keys → run-head indices."""
+    nb, B = kt.shape
+    sk, si = jax.lax.sort((kt, idxb), dimension=1, num_keys=2)
+    seg_start = jnp.concatenate(
+        [jnp.ones((nb, 1), dtype=bool), sk[:, 1:] != sk[:, :-1]], axis=1
+    )
+    seg_id = jnp.cumsum(seg_start, axis=1) - 1  # int32 [nb, B], < B
+    # si is ascending within each equal-key run, so the run head (first-seen
+    # row) is the segment minimum of si.
+    run_min = jax.vmap(
+        lambda s, g: jax.ops.segment_min(s, g, num_segments=B)
+    )(si, seg_id)
+    rep_sorted = jnp.take_along_axis(run_min, seg_id, axis=1)
+    return si, rep_sorted
+
+
+@jax.jit
+def duplicate_reps(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Candidate representative per row: smallest earlier row sharing any band.
+
+    Args:
+      keys: ``uint32[B, num_bands]`` band bucket keys.
+      valid: ``bool[B]`` — rows with no shingles (or batch padding) are
+        excluded and map to themselves.
+
+    Returns ``int32[B]`` with ``rep[i] <= i``; ``rep[i] == i`` means no
+    earlier collision.  Candidates still need signature verification
+    (:func:`resolve_reps`) — band collisions can be accidental.
+    """
+    B, nb = keys.shape
+    idx = jnp.arange(B, dtype=jnp.int32)
+    keys = jnp.where(valid[:, None], keys, U32_MAX)
+    kt = keys.T
+    idxb = jnp.broadcast_to(idx, (nb, B))
+    si, rep_sorted = _run_head_per_band(kt, idxb)
+    rep_band = jax.vmap(
+        lambda s, r: jnp.zeros((B,), dtype=jnp.int32).at[s].set(r)
+    )(si, rep_sorted)
+    rep = rep_band.min(axis=0)
+    # Invalid rows were all assigned key U32_MAX and may have grouped with
+    # each other; sever them (and protect the pathological valid row that
+    # really hashes to U32_MAX) by self-assignment.
+    return jnp.where(valid, rep, idx)
+
+
+@partial(jax.jit, static_argnames=("jump_rounds",))
+def resolve_reps(
+    rep: jnp.ndarray,
+    sig: jnp.ndarray,
+    valid: jnp.ndarray,
+    threshold: float,
+    *,
+    jump_rounds: int,
+) -> jnp.ndarray:
+    """Verify candidates by signature agreement, then resolve chains.
+
+    ``agreement = mean(sig_i == sig_rep)`` is the standard unbiased MinHash
+    Jaccard estimate; candidates below ``threshold`` revert to self.
+    ``jump_rounds`` should be ≥ ceil(log2(B)) — pointer jumping over a
+    monotone parent array reaches the fixpoint in log rounds.
+    """
+    B = rep.shape[0]
+    idx = jnp.arange(B, dtype=jnp.int32)
+    agree = (sig == jnp.take(sig, rep, axis=0)).mean(axis=1)
+    rep = jnp.where((agree >= threshold) & valid, rep, idx)
+    for _ in range(jump_rounds):
+        rep = jnp.take(rep, rep)
+    return rep
+
+
+@partial(jax.jit, static_argnames=("nbins",))
+def bucket_histogram(
+    keys: jnp.ndarray, valid: jnp.ndarray, *, nbins: int = 1 << 16
+) -> jnp.ndarray:
+    """Histogram of band keys over ``nbins`` — the psum-able dense summary
+    used for cross-shard bucket-merge statistics (north star names
+    ``lax.psum`` for this merge; see ``parallel/sharded.py``)."""
+    flat = (keys % jnp.uint32(nbins)).astype(jnp.int32).reshape(-1)
+    w = jnp.broadcast_to(valid[:, None], keys.shape).reshape(-1).astype(jnp.int32)
+    return jnp.zeros((nbins,), dtype=jnp.int32).at[flat].add(w)
+
+
+def keep_mask(rep: jnp.ndarray) -> jnp.ndarray:
+    """True for rows that are their own representative (first seen)."""
+    return rep == jnp.arange(rep.shape[0], dtype=rep.dtype)

@@ -1,0 +1,98 @@
+"""MinHash signature kernel.
+
+The TPU successor of datasketch-style CPU MinHash (the oracle in
+``cpu/oracle.py`` reproduces datasketch exactly; see ``core/hashing.py`` for
+why the device uses a 32-bit multiply-add family instead of 61-bit Mersenne
+arithmetic).  Configuration fixed by the north star (BASELINE.json): k=5 byte
+shingles, 128 permutations.
+
+Shape/memory strategy: the naive formulation materialises
+``uint32[B, S, 128]`` (shingles × permutations).  We instead scan over
+shingle-position chunks, keeping a running per-permutation minimum — peak
+intermediate is ``[B, chunk, 128]`` and XLA fuses the multiply-add into the
+min-reduction.  Long articles are handled *blockwise* upstream
+(``core.tokenizer.encode_blocks``; k-1 byte overlap) and block signatures are
+combined here with a segment-min — the same algebra lets sequence-parallel
+shards combine partial signatures with ``lax.pmin`` over the mesh's ``seq``
+axis (``parallel/sharded.py``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from advanced_scrapper_tpu.core.hashing import MinHashParams
+from advanced_scrapper_tpu.ops.shingle import U32_MAX, shingle_hash
+
+
+@partial(jax.jit, static_argnames=("k", "chunk"))
+def _signatures_impl(
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    *,
+    k: int,
+    chunk: int,
+) -> jnp.ndarray:
+    h, valid = shingle_hash(tokens, lengths, k)
+    B, S = h.shape
+    P = a.shape[0]
+    # Pad shingle axis to a chunk multiple, transpose chunks to the scan axis.
+    n_chunks = -(-S // chunk)
+    pad = n_chunks * chunk - S
+    h = jnp.pad(h, ((0, 0), (0, pad)))
+    valid = jnp.pad(valid, ((0, 0), (0, pad)))
+    h_t = h.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+    v_t = valid.reshape(B, n_chunks, chunk).transpose(1, 0, 2)
+
+    def body(sig, xs):
+        hc, vc = xs  # uint32[B, chunk], bool[B, chunk]
+        ph = a[None, None, :] * hc[:, :, None] + b[None, None, :]
+        ph = jnp.where(vc[:, :, None], ph, U32_MAX)
+        return jnp.minimum(sig, ph.min(axis=1)), None
+
+    init = jnp.full((B, P), U32_MAX, dtype=jnp.uint32)
+    sig, _ = jax.lax.scan(body, init, (h_t, v_t))
+    return sig
+
+
+def minhash_signatures(
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    params: MinHashParams,
+    *,
+    chunk: int = 512,
+) -> jnp.ndarray:
+    """Compute ``uint32[B, num_perm]`` MinHash signatures.
+
+    Rows with fewer than k valid bytes yield all-``U32_MAX`` signatures;
+    callers must mask them out of LSH (``lsh.duplicate_reps(valid=...)``).
+    """
+    return _signatures_impl(
+        tokens,
+        lengths,
+        jnp.asarray(params.a32),
+        jnp.asarray(params.b32),
+        k=params.shingle_k,
+        chunk=chunk,
+    )
+
+
+@partial(jax.jit, static_argnames=("num_articles",))
+def combine_block_signatures(
+    block_sigs: jnp.ndarray, owners: jnp.ndarray, *, num_articles: int
+) -> jnp.ndarray:
+    """Per-article signature = elementwise min over its blocks' signatures.
+
+    MinHash is a min-reduction over the shingle set, and the blockwise split
+    (with k-1 overlap) preserves the shingle set, so segment-min over blocks
+    is *exact*, not an approximation.  TPU analogue of the reference's
+    chunked streaming (``match_keywords.py:227-230``).
+    """
+    return jax.ops.segment_min(
+        block_sigs, owners, num_segments=num_articles, indices_are_sorted=False
+    )

@@ -1,0 +1,66 @@
+"""Byte-shingle hashing on device.
+
+Replaces the reference's CPU shingle-free string scans
+(``match_keywords.py:165-180`` does O(names × article_len) rapidfuzz calls;
+``yahoo_links_selenium.py:79`` hashes whole URLs inside pandas) with a
+vectorised k-byte rolling FNV-1a + murmur3 finalisation over ``uint8[B, L]``
+token blocks.  Everything is uint32: TPU vector lanes have native
+wrap-around 32-bit integer multiply, so no 64-bit emulation is needed.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+FNV_OFFSET = jnp.uint32(0x811C9DC5)
+FNV_PRIME = jnp.uint32(0x01000193)
+U32_MAX = jnp.uint32(0xFFFFFFFF)
+
+
+def fmix32(h: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 32-bit finaliser — cheap avalanche for uint32 lanes."""
+    h = h.astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def shingle_hash(
+    tokens: jnp.ndarray, lengths: jnp.ndarray, k: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Hash every k-byte shingle of each row.
+
+    Args:
+      tokens: ``uint8[B, L]`` zero-padded byte rows.
+      lengths: ``int32[B]`` valid byte counts.
+      k: shingle width (static; the north star fixes k=5).
+
+    Returns:
+      ``(hashes uint32[B, L-k+1], valid bool[B, L-k+1])``.  ``valid[b, i]``
+      iff shingle ``i`` lies fully inside the first ``lengths[b]`` bytes.
+
+    The k-wide window is unrolled (k is tiny and static), producing k shifted
+    elementwise ops XLA fuses into one pass — no gather, no dynamic shapes.
+    """
+    if tokens.ndim != 2:
+        raise ValueError(f"tokens must be rank-2, got {tokens.shape}")
+    L = tokens.shape[-1]
+    if L < k:
+        raise ValueError(f"block length {L} < shingle width {k}")
+    t32 = tokens.astype(jnp.uint32)
+    n = L - k + 1
+    h = jnp.full(t32.shape[:-1] + (n,), FNV_OFFSET, dtype=jnp.uint32)
+    for j in range(k):
+        h = (h ^ t32[..., j : j + n]) * FNV_PRIME
+    h = fmix32(h)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    valid = pos < jnp.maximum(lengths - (k - 1), 0)[..., None]
+    return h, valid
+
+
+def gram_hash(tokens: jnp.ndarray, lengths: jnp.ndarray, q: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Alias of :func:`shingle_hash` for the q-gram match screen (q≠k)."""
+    return shingle_hash(tokens, lengths, q)

@@ -1,0 +1,156 @@
+"""Dedup engines — the TPU rerouting of the reference's dedup steps.
+
+- :class:`NearDupEngine` — MinHash(k=5, 128-perm) + 16-band LSH near-dup
+  clustering (the north-star workload; no analogue in the reference, which
+  only ever does exact dedup).
+- :class:`ExactDedup` — byte-identical replacement for pandas
+  ``drop_duplicates(subset=['url'], keep='first')``
+  (``yahoo_links_selenium.py:79,174``): 128-bit device hashing proposes
+  groups, the host confirms true string equality inside each group, so the
+  surviving row set is *provably* identical to the pandas path.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from advanced_scrapper_tpu.config import DedupConfig
+from advanced_scrapper_tpu.core.hashing import MinHashParams, make_params
+from advanced_scrapper_tpu.core.tokenizer import (
+    bucket_len,
+    encode_batch,
+    encode_blocks,
+    to_bytes,
+)
+from advanced_scrapper_tpu.ops.exact import ExactHasher
+from advanced_scrapper_tpu.ops.lsh import band_keys, duplicate_reps, keep_mask, resolve_reps
+from advanced_scrapper_tpu.ops.minhash import combine_block_signatures, minhash_signatures
+
+
+def _jump_rounds(n: int) -> int:
+    r = 1
+    while (1 << r) < n:
+        r += 1
+    return r
+
+
+class NearDupEngine:
+    """Batch near-duplicate detector.
+
+    Long texts are split into overlapping blocks (`core.tokenizer.encode_blocks`)
+    so device shapes stay fixed; block signatures are exactly min-combined per
+    article. Block batches are padded to a fixed size to avoid recompilation.
+    """
+
+    def __init__(self, cfg: DedupConfig | None = None, params: MinHashParams | None = None):
+        self.cfg = cfg or DedupConfig()
+        self.params = params or make_params(
+            num_perm=self.cfg.num_perm,
+            num_bands=self.cfg.num_bands,
+            shingle_k=self.cfg.shingle_k,
+            seed=self.cfg.seed,
+        )
+
+    def signatures(self, texts: Sequence[str | bytes]) -> np.ndarray:
+        """uint32[N, num_perm] MinHash signatures (blockwise, batched)."""
+        cfg, params = self.cfg, self.params
+        if len(texts) == 0:
+            return np.zeros((0, params.num_perm), np.uint32)
+        tok, lens, owners = encode_blocks(
+            texts, cfg.block_len, overlap=params.shingle_k - 1
+        )
+        n_blocks = tok.shape[0]
+        bs = cfg.batch_size
+        sig_parts = []
+        for start in range(0, n_blocks, bs):
+            t = tok[start : start + bs]
+            l = lens[start : start + bs]
+            if t.shape[0] < bs:
+                pad = bs - t.shape[0]
+                t = np.concatenate([t, np.zeros((pad, t.shape[1]), np.uint8)])
+                l = np.concatenate([l, np.zeros((pad,), np.int32)])
+            sig_parts.append(np.asarray(minhash_signatures(t, l, params)))
+        sigs = np.concatenate(sig_parts)[:n_blocks]
+        # Bucket the article count so combine compiles O(log N) variants, not
+        # one per corpus size (same trick as the block-length axis).
+        n_bucket = bucket_len(len(texts), min_bucket=64)
+        combined = combine_block_signatures(sigs, owners, num_articles=n_bucket)
+        return np.asarray(combined)[: len(texts)]
+
+    def dedup_reps(self, texts: Sequence[str | bytes]) -> np.ndarray:
+        """int32[N] first-seen-wins representative per text (union-find roots)."""
+        n = len(texts)
+        if n == 0:
+            return np.zeros((0,), np.int32)
+        sigs = self.signatures(texts)
+        lens = np.array([len(to_bytes(t)) for t in texts])
+        valid = lens >= self.params.shingle_k
+        # Pad the corpus axis to a bucket: padded rows are invalid, so they
+        # self-assign and never affect real rows; compiled shapes stay O(log N).
+        n_bucket = bucket_len(n, min_bucket=64)
+        if n_bucket != n:
+            sigs = np.concatenate(
+                [sigs, np.full((n_bucket - n, sigs.shape[1]), 0xFFFFFFFF, np.uint32)]
+            )
+            valid = np.concatenate([valid, np.zeros(n_bucket - n, bool)])
+        keys = band_keys(sigs, self.params.band_salt)
+        rep = duplicate_reps(keys, valid)
+        rep = resolve_reps(
+            rep, sigs, valid, self.cfg.sim_threshold,
+            jump_rounds=_jump_rounds(n_bucket),
+        )
+        return np.asarray(rep)[:n]
+
+    def keep(self, texts: Sequence[str | bytes]) -> np.ndarray:
+        reps = self.dedup_reps(texts)
+        return reps == np.arange(len(reps))
+
+
+class ExactDedup:
+    """First-seen exact dedup with a byte-identical guarantee.
+
+    The device proposes equality groups via 128-bit hashes; the host walks
+    each group in original order comparing *actual* strings, so a 2⁻¹²⁸
+    collision can propose but never cause a wrong drop.  Result: the kept
+    index set equals pandas ``drop_duplicates(keep='first')`` exactly.
+    """
+
+    def __init__(self, hasher: ExactHasher | None = None, max_len: int = 4096):
+        self.hasher = hasher or ExactHasher()
+        self.max_len = max_len
+
+    def keep_indices(self, items: Sequence[str]) -> list[int]:
+        if not items:
+            return []
+        longest = max(len(s.encode("utf-8", "replace")) for s in items)
+        if longest > self.max_len:
+            raise ValueError(
+                f"item of {longest} bytes exceeds max_len {self.max_len}; "
+                "raise max_len so hashing covers every byte (truncated hashing "
+                "would break the byte-identical guarantee)"
+            )
+        L = bucket_len(max(longest, 1))
+        tok, lens = encode_batch(items, block_len=L)
+        h = np.asarray(self.hasher(tok, lens))  # uint32[N, 4]
+        first_by_hash: dict[bytes, list[int]] = {}
+        kept: list[int] = []
+        for i in range(len(items)):
+            key = h[i].tobytes()
+            group = first_by_hash.get(key)
+            if group is None:
+                first_by_hash[key] = [i]
+                kept.append(i)
+            else:
+                # hash collision group: confirm a true string match
+                if any(items[j] == items[i] for j in group):
+                    continue
+                group.append(i)
+                kept.append(i)
+        return kept
+
+    def keep_mask(self, items: Sequence[str]) -> np.ndarray:
+        mask = np.zeros(len(items), dtype=bool)
+        mask[self.keep_indices(items)] = True
+        return mask

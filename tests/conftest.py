@@ -1,0 +1,30 @@
+"""Test harness: run every test on a virtual 8-device CPU mesh.
+
+The reference has no automated test suite (SURVEY.md §4); this framework's
+tests follow the strategy mandated there: pure-function extractor tests on
+saved HTML, CPU-oracle vs TPU kernel equivalence, byte-identical CSV golden
+tests, and multi-device sharding exercised on one host via
+``--xla_force_host_platform_device_count``.
+
+This file must set the env vars *before* jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs[:8]

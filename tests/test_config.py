@@ -1,0 +1,40 @@
+import os
+
+from advanced_scrapper_tpu.config import DedupConfig, ScraperConfig, from_env, default_config
+
+
+def test_env_override_coerces_types(monkeypatch):
+    monkeypatch.setenv("ASTPU_NUM_PERM", "256")
+    monkeypatch.setenv("ASTPU_SIM_THRESHOLD", "0.8")
+    cfg = from_env(DedupConfig)
+    assert cfg.num_perm == 256 and isinstance(cfg.num_perm, int)
+    assert cfg.sim_threshold == 0.8 and isinstance(cfg.sim_threshold, float)
+
+
+def test_env_override_bool(monkeypatch):
+    monkeypatch.setenv("ASTPU_HARDENED", "0")
+    from advanced_scrapper_tpu.config import EnrichConfig
+
+    assert from_env(EnrichConfig).hardened is False
+    monkeypatch.setenv("ASTPU_HARDENED", "true")
+    assert from_env(EnrichConfig).hardened is True
+
+
+def test_defaults_are_reference_operating_points():
+    cfg = default_config()
+    # ref constant_rate_scrapper.py:17,20,23,28
+    assert cfg.scraper.desired_request_rate == 5.8
+    assert cfg.scraper.max_threads == 16
+    assert cfg.scraper.stats_time_window == 10.0
+    assert cfg.scraper.rate_limit_wait == 200.0
+    # ref server1.py:20 / client1.py:23-24
+    assert cfg.feed.max_clients == 5
+    assert cfg.feed.batch_size == 20
+    assert cfg.feed.min_queue_length == 10
+    # BASELINE.json north star
+    assert (cfg.dedup.shingle_k, cfg.dedup.num_perm, cfg.dedup.num_bands) == (5, 128, 16)
+
+
+def test_explicit_override_beats_env(monkeypatch):
+    monkeypatch.setenv("ASTPU_MAX_THREADS", "4")
+    assert from_env(ScraperConfig, max_threads=9).max_threads == 9

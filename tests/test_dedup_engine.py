@@ -1,0 +1,63 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from advanced_scrapper_tpu.config import DedupConfig
+from advanced_scrapper_tpu.core.tokenizer import encode_batch
+from advanced_scrapper_tpu.ops.exact import ExactHasher
+from advanced_scrapper_tpu.pipeline.dedup import ExactDedup, NearDupEngine
+
+
+def test_exact_hash_stable_across_block_lengths():
+    """Same bytes must hash identically whatever padded bucket they land in."""
+    h = ExactHasher()
+    url = "https://finance.yahoo.com/news/some-article-1234.html"
+    t64, l64 = encode_batch([url], block_len=64)
+    t256, l256 = encode_batch([url], block_len=256)
+    np.testing.assert_array_equal(np.asarray(h(t64, l64)), np.asarray(h(t256, l256)))
+
+
+def test_exact_hash_distinguishes_trailing_nul():
+    h = ExactHasher()
+    t, l = encode_batch(["ab", "ab\x00"], block_len=64)
+    hv = np.asarray(h(t, l))
+    assert (hv[0] != hv[1]).any()
+
+
+def test_exact_dedup_matches_pandas_drop_duplicates():
+    urls = [
+        "https://a.com/1.html",
+        "https://b.com/2.html",
+        "https://a.com/1.html",   # dup of 0
+        "https://c.com/3.html",
+        "https://b.com/2.html",   # dup of 1
+        "https://a.com/1.html",   # dup of 0
+        "",
+        "",
+    ]
+    df = pd.DataFrame({"url": urls})
+    expected = df.drop_duplicates(subset=["url"]).index.tolist()
+    got = ExactDedup().keep_indices(urls)
+    assert got == expected
+
+
+def test_exact_dedup_rejects_overlong_items():
+    with pytest.raises(ValueError):
+        ExactDedup(max_len=16).keep_indices(["x" * 100])
+
+
+def test_near_dup_engine_blockwise_long_articles():
+    rng = np.random.RandomState(5)
+    long_text = bytes(rng.randint(32, 127, size=9000, dtype=np.uint8))
+    near = long_text[:8950] + b"THE END CHANGED HERE!!"
+    other = bytes(rng.randint(32, 127, size=9000, dtype=np.uint8))
+    cfg = DedupConfig(block_len=2048, batch_size=8)
+    eng = NearDupEngine(cfg)
+    reps = eng.dedup_reps([long_text, other, near])
+    assert reps.tolist() == [0, 1, 0]
+    keep = eng.keep([long_text, other, near])
+    assert keep.tolist() == [True, True, False]
+
+
+def test_near_dup_engine_empty_corpus():
+    assert NearDupEngine().dedup_reps([]).shape == (0,)

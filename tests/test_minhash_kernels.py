@@ -1,0 +1,151 @@
+"""Device-kernel equivalence and property tests for shingle/MinHash/LSH ops."""
+
+import numpy as np
+import pytest
+
+from advanced_scrapper_tpu.core.hashing import make_params
+from advanced_scrapper_tpu.core.tokenizer import encode_batch, encode_blocks
+from advanced_scrapper_tpu.ops.minhash import (
+    combine_block_signatures,
+    minhash_signatures,
+)
+from advanced_scrapper_tpu.ops.lsh import (
+    band_keys,
+    bucket_histogram,
+    duplicate_reps,
+    keep_mask,
+    resolve_reps,
+)
+from advanced_scrapper_tpu.ops.shingle import shingle_hash
+
+PARAMS = make_params(num_perm=128, num_bands=16, shingle_k=5, seed=1)
+
+
+def _np_shingle_ref(raw: bytes, k: int) -> np.ndarray:
+    """Independent numpy mirror of the device shingle hash."""
+    out = []
+    for i in range(len(raw) - k + 1):
+        h = np.uint32(0x811C9DC5)
+        for j in range(k):
+            h = np.uint32((int(h) ^ raw[i + j]) * 0x01000193 & 0xFFFFFFFF)
+        # fmix32
+        x = int(h)
+        x ^= x >> 16
+        x = (x * 0x85EBCA6B) & 0xFFFFFFFF
+        x ^= x >> 13
+        x = (x * 0xC2B2AE35) & 0xFFFFFFFF
+        x ^= x >> 16
+        out.append(x)
+    return np.array(out, dtype=np.uint32)
+
+
+def test_shingle_hash_matches_numpy_reference():
+    texts = [b"hello world shingles", b"tiny"]
+    tok, ln = encode_batch(texts, block_len=64)
+    h, valid = shingle_hash(tok, ln, 5)
+    h, valid = np.asarray(h), np.asarray(valid)
+    ref0 = _np_shingle_ref(texts[0], 5)
+    n0 = len(texts[0]) - 4
+    assert valid[0, :n0].all() and not valid[0, n0:].any()
+    np.testing.assert_array_equal(h[0, :n0], ref0)
+    assert not valid[1].any()  # len 4 < k=5 → no shingles
+
+
+def test_signatures_permutation_invariance():
+    """Same shingle multiset (different order) → same signature."""
+    a = b"abcdefghij" * 4
+    b = a[5:] + a[:5]  # rotation shares most shingles but not all
+    same1 = b"xx" + a + b"yy"
+    same2 = b"qq" + a + b"zz"
+    tok, ln = encode_batch([a, same1, same2], block_len=64)
+    sig = np.asarray(minhash_signatures(tok, ln, PARAMS))
+    assert sig.shape == (3, 128)
+    # signatures over supersets share most minima but are not all-equal
+    assert (sig[1] == sig[2]).mean() > 0.5
+
+
+def test_signatures_equal_for_equal_texts():
+    t = b"the quick brown fox jumps over the lazy dog"
+    tok, ln = encode_batch([t, t], block_len=64)
+    sig = np.asarray(minhash_signatures(tok, ln, PARAMS))
+    np.testing.assert_array_equal(sig[0], sig[1])
+
+
+def test_empty_rows_give_sentinel_signature():
+    tok, ln = encode_batch([b"", b"abc"], block_len=64)
+    sig = np.asarray(minhash_signatures(tok, ln, PARAMS))
+    assert (sig[0] == 0xFFFFFFFF).all()
+    assert (sig[1] == 0xFFFFFFFF).all()  # len 3 < k → also sentinel
+
+
+def test_blockwise_signatures_equal_whole_text():
+    """Blockwise min-combine must be exact (not approximate)."""
+    rng = np.random.RandomState(0)
+    text = bytes(rng.randint(32, 127, size=3000, dtype=np.uint8))
+    # whole-text signature
+    tok_w, ln_w = encode_batch([text], block_len=4096)
+    sig_w = np.asarray(minhash_signatures(tok_w, ln_w, PARAMS))[0]
+    # blockwise
+    tok_b, ln_b, owner = encode_blocks([text], block_len=512, overlap=4)
+    sig_b = np.asarray(minhash_signatures(tok_b, ln_b, PARAMS))
+    combined = np.asarray(
+        combine_block_signatures(sig_b, owner, num_articles=1)
+    )[0]
+    np.testing.assert_array_equal(combined, sig_w)
+
+
+def test_band_keys_shape_and_equality():
+    t = b"some article body text for banding purposes"
+    tok, ln = encode_batch([t, t, b"completely different content here!"], block_len=64)
+    sig = minhash_signatures(tok, ln, PARAMS)
+    keys = np.asarray(band_keys(sig, PARAMS.band_salt))
+    assert keys.shape == (3, 16)
+    np.testing.assert_array_equal(keys[0], keys[1])
+    assert (keys[0] != keys[2]).any()
+
+
+def test_duplicate_reps_first_seen_wins():
+    texts = [b"alpha beta gamma delta epsilon", b"unrelated text entirely",
+             b"alpha beta gamma delta epsilon", b"alpha beta gamma delta epsilon"]
+    tok, ln = encode_batch(texts, block_len=64)
+    sig = minhash_signatures(tok, ln, PARAMS)
+    keys = band_keys(sig, PARAMS.band_salt)
+    valid = np.asarray(ln) >= 5
+    rep = np.asarray(duplicate_reps(keys, valid))
+    assert rep[0] == 0 and rep[1] == 1
+    assert rep[2] == 0 and rep[3] == 0
+    rep2 = np.asarray(resolve_reps(rep, sig, valid, 0.7, jump_rounds=3))
+    assert rep2.tolist() == [0, 1, 0, 0]
+    assert np.asarray(keep_mask(rep2)).tolist() == [True, True, False, False]
+
+
+def test_duplicate_reps_chain_resolution():
+    """A~B and B~C must land in one cluster even built pairwise."""
+    base = b"the quick brown fox jumps over the lazy dog again and again"
+    texts = [base, base + b" x", base + b" x y"]
+    tok, ln = encode_batch(texts, block_len=128)
+    sig = minhash_signatures(tok, ln, PARAMS)
+    keys = band_keys(sig, PARAMS.band_salt)
+    valid = np.ones(3, bool)
+    rep = duplicate_reps(keys, valid)
+    rep = np.asarray(resolve_reps(rep, sig, valid, 0.7, jump_rounds=3))
+    assert rep.tolist() == [0, 0, 0]
+
+
+def test_invalid_rows_never_group():
+    tok, ln = encode_batch([b"", b"", b""], block_len=64)
+    sig = minhash_signatures(tok, ln, PARAMS)
+    keys = band_keys(sig, PARAMS.band_salt)
+    valid = np.asarray(ln) >= 5
+    rep = np.asarray(duplicate_reps(keys, valid))
+    assert rep.tolist() == [0, 1, 2]
+
+
+def test_bucket_histogram_counts():
+    tok, ln = encode_batch([b"aaaaaaaaaa", b"bbbbbbbbbb"], block_len=64)
+    sig = minhash_signatures(tok, ln, PARAMS)
+    keys = band_keys(sig, PARAMS.band_salt)
+    hist = np.asarray(bucket_histogram(keys, np.ones(2, bool), nbins=1 << 12))
+    assert hist.sum() == 2 * 16
+    hist0 = np.asarray(bucket_histogram(keys, np.zeros(2, bool), nbins=1 << 12))
+    assert hist0.sum() == 0

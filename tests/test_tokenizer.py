@@ -1,0 +1,60 @@
+import numpy as np
+
+from advanced_scrapper_tpu.core.tokenizer import (
+    bucket_len,
+    encode_batch,
+    encode_blocks,
+    iter_batches,
+    pad_batch_to,
+)
+
+
+def test_bucket_len_powers_of_two():
+    assert bucket_len(1) == 64
+    assert bucket_len(64) == 64
+    assert bucket_len(65) == 128
+    assert bucket_len(5000) == 8192
+    assert bucket_len(5000, max_bucket=4096) == 4096
+
+
+def test_encode_batch_roundtrip():
+    texts = ["hello", "worldly", ""]
+    tok, ln = encode_batch(texts)
+    assert tok.dtype == np.uint8 and ln.dtype == np.int32
+    assert tok.shape == (3, 64)
+    assert bytes(tok[0, :5]) == b"hello"
+    assert list(ln) == [5, 7, 0]
+    assert tok[2].sum() == 0
+
+
+def test_encode_batch_truncates():
+    tok, ln = encode_batch(["x" * 100], block_len=64)
+    assert ln[0] == 64
+
+
+def test_encode_blocks_preserves_shingles():
+    k = 5
+    text = bytes(range(256)) * 3  # 768 bytes
+    tok, ln, owner = encode_blocks([text], block_len=256, overlap=k - 1)
+    # union of block shingles == shingles of the whole text
+    whole = {text[i : i + k] for i in range(len(text) - k + 1)}
+    got = set()
+    for row, n in zip(tok, ln):
+        raw = bytes(row[:n])
+        got |= {raw[i : i + k] for i in range(len(raw) - k + 1)}
+    assert got == whole
+    assert all(o == 0 for o in owner)
+
+
+def test_encode_blocks_owner_mapping():
+    tok, ln, owner = encode_blocks(["a" * 10, "b" * 600], block_len=256, overlap=4)
+    assert owner.tolist() == [0, 1, 1, 1]
+
+
+def test_pad_and_iter_batches():
+    tok, ln = encode_batch(["abc", "de"], block_len=64)
+    tok2, ln2, n = pad_batch_to(tok, ln, 8)
+    assert tok2.shape == (8, 64) and n == 2
+    batches = list(iter_batches(["a", "b", "c"], batch_size=2, block_len=64))
+    assert len(batches) == 2
+    assert batches[0][2] == 2 and batches[1][2] == 1
