@@ -32,14 +32,19 @@ def _coerce(raw: str, typ: Any) -> Any:
     return raw
 
 
-def from_env(cls: Type[T], **overrides: Any) -> T:
-    """Build a config dataclass, applying ASTPU_* env vars then overrides."""
+def from_env(cls: Type[T], section: str = "", **overrides: Any) -> T:
+    """Build a config dataclass from ``ASTPU_<SECTION>_<FIELD>`` env vars.
+
+    The section prefix keeps same-named fields in different subsystems
+    independent (``ASTPU_DEDUP_BATCH_SIZE`` vs ``ASTPU_FEED_BATCH_SIZE``).
+    """
     kwargs: dict[str, Any] = {}
     # PEP 563 postponed annotations make ``field.type`` a string; resolve the
     # real types so _coerce's identity checks work.
     hints = typing.get_type_hints(cls)
+    prefix = _ENV_PREFIX + (section.upper() + "_" if section else "")
     for f in fields(cls):  # type: ignore[arg-type]
-        env_key = _ENV_PREFIX + f.name.upper()
+        env_key = prefix + f.name.upper()
         if env_key in os.environ:
             kwargs[f.name] = _coerce(os.environ[env_key], hints.get(f.name, str))
     kwargs.update({k: v for k, v in overrides.items() if v is not None})
@@ -160,11 +165,11 @@ class Config:
 
 def default_config() -> Config:
     return Config(
-        scraper=from_env(ScraperConfig),
-        harvest=from_env(HarvestConfig),
-        enrich=from_env(EnrichConfig),
-        match=from_env(MatchConfig),
-        dedup=from_env(DedupConfig),
-        mesh=from_env(MeshConfig),
-        feed=from_env(FeedConfig),
+        scraper=from_env(ScraperConfig, "scraper"),
+        harvest=from_env(HarvestConfig, "harvest"),
+        enrich=from_env(EnrichConfig, "enrich"),
+        match=from_env(MatchConfig, "match"),
+        dedup=from_env(DedupConfig, "dedup"),
+        mesh=from_env(MeshConfig, "mesh"),
+        feed=from_env(FeedConfig, "feed"),
     )

@@ -13,10 +13,10 @@ installable here, so this module is the semantic reference:
   string is empty, 100.0 is returned (an empty window matches perfectly) —
   mirroring rapidfuzz's behaviour for empty needles.
 
-This pure-Python version is the oracle for tests and small inputs; the C++
-twin in ``native/fastmatch.cpp`` (bit-parallel Hyyrö LCS) is the production
-verifier behind the TPU q-gram screen (``ops/match.py``), loaded via
-``cpu/native.py``.
+This pure-Python version is the oracle for tests and small inputs.  A C++
+twin (bit-parallel Hyyrö LCS, planned as ``native/fastmatch.cpp``) will be
+the production verifier behind the TPU q-gram screen once the matcher
+pipeline lands; until then this module is the only implementation.
 """
 
 from __future__ import annotations

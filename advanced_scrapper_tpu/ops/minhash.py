@@ -28,17 +28,19 @@ from advanced_scrapper_tpu.core.hashing import MinHashParams
 from advanced_scrapper_tpu.ops.shingle import U32_MAX, shingle_hash
 
 
-@partial(jax.jit, static_argnames=("k", "chunk"))
-def _signatures_impl(
-    tokens: jnp.ndarray,
-    lengths: jnp.ndarray,
+def scan_min_signature(
+    h: jnp.ndarray,
+    valid: jnp.ndarray,
     a: jnp.ndarray,
     b: jnp.ndarray,
-    *,
-    k: int,
     chunk: int,
 ) -> jnp.ndarray:
-    h, valid = shingle_hash(tokens, lengths, k)
+    """Per-permutation minimum over shingle hashes, scanned in chunks.
+
+    ``h/valid`` are ``[B, S]``; peak intermediate is ``[B, chunk, P]``
+    (XLA fuses the multiply-add into the min-reduce).  Shared by the
+    single-device kernel and the sequence-parallel shard kernel.
+    """
     B, S = h.shape
     P = a.shape[0]
     # Pad shingle axis to a chunk multiple, transpose chunks to the scan axis.
@@ -58,6 +60,20 @@ def _signatures_impl(
     init = jnp.full((B, P), U32_MAX, dtype=jnp.uint32)
     sig, _ = jax.lax.scan(body, init, (h_t, v_t))
     return sig
+
+
+@partial(jax.jit, static_argnames=("k", "chunk"))
+def _signatures_impl(
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    *,
+    k: int,
+    chunk: int,
+) -> jnp.ndarray:
+    h, valid = shingle_hash(tokens, lengths, k)
+    return scan_min_signature(h, valid, a, b, chunk)
 
 
 def minhash_signatures(

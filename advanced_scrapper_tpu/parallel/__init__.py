@@ -1,0 +1,17 @@
+from advanced_scrapper_tpu.parallel.sharded import (
+    sharded_dedup_step,
+    seq_sharded_signatures,
+    make_seq_sharded_signatures,
+    make_sharded_dedup,
+    shard_batch,
+)
+from advanced_scrapper_tpu.parallel.dist import initialize_multihost
+
+__all__ = [
+    "sharded_dedup_step",
+    "seq_sharded_signatures",
+    "make_seq_sharded_signatures",
+    "make_sharded_dedup",
+    "shard_batch",
+    "initialize_multihost",
+]

@@ -1,0 +1,51 @@
+"""Multi-host initialisation.
+
+The reference reaches multiple machines with a hand-rolled TCP protocol and
+manual CSV splits (``server1.py``, ``experiental/split.py``).  The TPU-native
+equivalent is ``jax.distributed``: one process per host, XLA collectives over
+ICI within a slice and DCN across slices.  The host-side work distribution
+(URL leases, requeue-on-disconnect — planned in ``net/``) is separate; this
+module only brings up the device world.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialise ``jax.distributed`` when a multi-host world is configured.
+
+    Returns True if distributed mode was initialised.  Controlled by
+    arguments or the standard JAX env vars; a no-op single-host fallback
+    keeps every pipeline runnable on one machine (the reference's scripts
+    likewise default to localhost, ``server1.py:17-18``).
+    """
+    addr = coordinator_address or os.environ.get("ASTPU_COORDINATOR")
+    if addr is None:
+        return False
+    if num_processes is None:
+        num_processes = int(os.environ.get("ASTPU_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("ASTPU_PROCESS_ID", "0"))
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def world_info() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

@@ -1,0 +1,71 @@
+"""Flagship benchmark: MinHash(k=5, 128-perm) + 16-band LSH dedup throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "articles/s", "vs_baseline": N/50000}
+
+The baseline is the north-star target from BASELINE.json: 50,000 articles/s
+on a TPU v5e-8 at ≥0.95 recall.  This driver runs on however many chips are
+visible (one, under the current harness); the value reported is the measured
+end-to-end device throughput of the full dedup step (signatures → band keys
+→ first-seen representative resolution) on device-resident batches.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from advanced_scrapper_tpu.core.hashing import make_params
+    from advanced_scrapper_tpu.core.mesh import build_mesh
+    from advanced_scrapper_tpu.parallel.sharded import make_sharded_dedup, shard_batch
+
+    params = make_params()
+    n_dev = len(jax.devices())
+    mesh = build_mesh(n_dev, 1)
+
+    batch = 8192
+    block = 1024  # bytes/article (typical short news article body)
+    rng = np.random.RandomState(0)
+    tok = rng.randint(32, 127, size=(batch, block)).astype(np.uint8)
+    lengths = np.full((batch,), block, dtype=np.int32)
+    # plant 25% duplicates so the merge path does real work
+    dup_src = rng.randint(0, batch // 2, size=batch // 4)
+    tok[batch // 2 : batch // 2 + batch // 4] = tok[dup_src]
+
+    t, l = shard_batch(tok, lengths, mesh)
+    step = make_sharded_dedup(mesh, params)
+
+    # warmup / compile
+    rep, hist = step(t, l)
+    jax.block_until_ready(rep)
+
+    iters = 10
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        rep, hist = step(t, l)
+        jax.block_until_ready(rep)
+        times.append(time.perf_counter() - t0)
+    dt = float(np.median(times))
+    articles_per_sec = batch / dt
+
+    print(
+        json.dumps(
+            {
+                "metric": "minhash_lsh_dedup_articles_per_sec",
+                "value": round(articles_per_sec, 1),
+                "unit": "articles/s",
+                "vs_baseline": round(articles_per_sec / 50000.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

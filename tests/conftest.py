@@ -11,12 +11,21 @@ This file must set the env vars *before* jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The axon TPU plugin's sitecustomize force-registers itself at interpreter
+# startup (before this file runs) and sets jax_platforms="axon,cpu".  Undo it
+# through jax.config — XLA_FLAGS is still honoured because no backend has
+# been *initialised* yet at conftest-import time.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -4,20 +4,20 @@ from advanced_scrapper_tpu.config import DedupConfig, ScraperConfig, from_env, d
 
 
 def test_env_override_coerces_types(monkeypatch):
-    monkeypatch.setenv("ASTPU_NUM_PERM", "256")
-    monkeypatch.setenv("ASTPU_SIM_THRESHOLD", "0.8")
-    cfg = from_env(DedupConfig)
+    monkeypatch.setenv("ASTPU_DEDUP_NUM_PERM", "256")
+    monkeypatch.setenv("ASTPU_DEDUP_SIM_THRESHOLD", "0.8")
+    cfg = from_env(DedupConfig, "dedup")
     assert cfg.num_perm == 256 and isinstance(cfg.num_perm, int)
     assert cfg.sim_threshold == 0.8 and isinstance(cfg.sim_threshold, float)
 
 
 def test_env_override_bool(monkeypatch):
-    monkeypatch.setenv("ASTPU_HARDENED", "0")
+    monkeypatch.setenv("ASTPU_ENRICH_HARDENED", "0")
     from advanced_scrapper_tpu.config import EnrichConfig
 
-    assert from_env(EnrichConfig).hardened is False
-    monkeypatch.setenv("ASTPU_HARDENED", "true")
-    assert from_env(EnrichConfig).hardened is True
+    assert from_env(EnrichConfig, "enrich").hardened is False
+    monkeypatch.setenv("ASTPU_ENRICH_HARDENED", "true")
+    assert from_env(EnrichConfig, "enrich").hardened is True
 
 
 def test_defaults_are_reference_operating_points():
@@ -36,5 +36,21 @@ def test_defaults_are_reference_operating_points():
 
 
 def test_explicit_override_beats_env(monkeypatch):
-    monkeypatch.setenv("ASTPU_MAX_THREADS", "4")
-    assert from_env(ScraperConfig, max_threads=9).max_threads == 9
+    monkeypatch.setenv("ASTPU_SCRAPER_MAX_THREADS", "4")
+    assert from_env(ScraperConfig, "scraper", max_threads=9).max_threads == 9
+
+
+def test_env_sections_do_not_collide(monkeypatch):
+    """ASTPU_FEED_BATCH_SIZE must not leak into DedupConfig.batch_size."""
+    from advanced_scrapper_tpu.config import FeedConfig
+
+    monkeypatch.setenv("ASTPU_FEED_BATCH_SIZE", "20")
+    assert from_env(FeedConfig, "feed").batch_size == 20
+    assert from_env(DedupConfig, "dedup").batch_size == 1024
+
+
+def test_env_tuple_coercion(monkeypatch):
+    from advanced_scrapper_tpu.config import EnrichConfig
+
+    monkeypatch.setenv("ASTPU_ENRICH_COOLDOWN_EVERY3", "10,20")
+    assert from_env(EnrichConfig, "enrich").cooldown_every3 == (10.0, 20.0)

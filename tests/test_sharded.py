@@ -1,0 +1,94 @@
+"""Multi-device sharding tests on the 8-virtual-CPU mesh (SURVEY.md §4:
+multi-host behaviour exercised on a single host)."""
+
+import numpy as np
+import pytest
+
+from advanced_scrapper_tpu.core import build_mesh, encode_batch, make_params
+from advanced_scrapper_tpu.ops.minhash import minhash_signatures
+from advanced_scrapper_tpu.parallel.sharded import (
+    make_sharded_dedup,
+    seq_sharded_signatures,
+    shard_batch,
+)
+
+PARAMS = make_params()
+
+
+def _random_corpus(n, length, seed=0):
+    rng = np.random.RandomState(seed)
+    return [bytes(rng.randint(32, 127, size=length, dtype=np.uint8)) for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def mesh8(request):
+    return build_mesh(4, 2)
+
+
+def test_cross_shard_duplicates_resolve(mesh8, devices8):
+    texts = _random_corpus(16, 200)
+    texts[5] = texts[0]                       # exact dup on another shard
+    texts[9] = texts[0][:190] + b"EDITEDHERE"  # near dup on a third shard
+    tok, ln = encode_batch(texts, block_len=256)
+    t, l = shard_batch(tok, ln, mesh8)
+    rep, hist = make_sharded_dedup(mesh8, PARAMS)(t, l)
+    rep = np.asarray(rep)
+    assert rep[5] == 0 and rep[9] == 0
+    others = [i for i in range(16) if i not in (5, 9)]
+    assert (rep[others] == np.asarray(others)).all()
+
+
+def test_sharded_matches_single_device(mesh8):
+    """The mesh path must be semantically identical to the local path."""
+    from advanced_scrapper_tpu.ops.lsh import band_keys, duplicate_reps, resolve_reps
+
+    texts = _random_corpus(32, 150, seed=3)
+    texts[17] = texts[2]
+    tok, ln = encode_batch(texts, block_len=256)
+    # local reference
+    sig = minhash_signatures(tok, ln, PARAMS)
+    valid = np.asarray(ln) >= 5
+    rep_local = resolve_reps(
+        duplicate_reps(band_keys(sig, PARAMS.band_salt), valid),
+        sig, valid, 0.7, jump_rounds=8,
+    )
+    # sharded
+    t, l = shard_batch(tok, ln, mesh8)
+    rep_sharded, _ = make_sharded_dedup(mesh8, PARAMS)(t, l)
+    np.testing.assert_array_equal(np.asarray(rep_sharded), np.asarray(rep_local))
+
+
+def test_psum_histogram_counts_all_shards(mesh8):
+    texts = _random_corpus(16, 100, seed=5)
+    tok, ln = encode_batch(texts, block_len=128)
+    t, l = shard_batch(tok, ln, mesh8)
+    _, hist = make_sharded_dedup(mesh8, PARAMS)(t, l)
+    assert int(np.asarray(hist).sum()) == 16 * PARAMS.num_bands
+
+
+def test_seq_parallel_signatures_exact(mesh8):
+    """Halo exchange + pmin must reproduce single-device signatures bit-for-bit,
+    including texts whose end falls inside a shard (masked wraparound halo)."""
+    texts = [
+        b"a" * 37,                      # ends mid-first-shard
+        _random_corpus(1, 200, 7)[0],   # spans both seq shards
+        _random_corpus(1, 256, 8)[0],   # exactly full block
+        b"tiny",                        # < k: sentinel row
+    ]
+    tok, ln = encode_batch(texts, block_len=256)
+    sig_ref = np.asarray(minhash_signatures(tok, ln, PARAMS))
+    sig_sp = np.asarray(seq_sharded_signatures(tok, ln, PARAMS, mesh8))
+    np.testing.assert_array_equal(sig_ref, sig_sp)
+
+
+def test_seq_parallel_rejects_indivisible_block(mesh8):
+    tok, ln = encode_batch([b"hello world"], block_len=65)
+    with pytest.raises(ValueError):
+        seq_sharded_signatures(tok, ln, PARAMS, mesh8)
+
+
+def test_mesh_validation():
+    with pytest.raises(ValueError):
+        build_mesh(3, 2)  # 6 != 8
+    with pytest.raises(ValueError):
+        build_mesh(-1, 3)  # 3 does not divide 8
