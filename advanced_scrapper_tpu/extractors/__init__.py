@@ -1,0 +1,40 @@
+"""Extractor plugin boundary.
+
+The reference's L2→L3 interface is ``import_module(f"extractors.{website}")``
+plus the single-function contract ``extract_article_data(soup) -> dict``
+(``constant_rate_scrapper.py:301``, ``extractors/yfin.py:7``).  This package
+preserves both: any module here (or any registered callable) exposing
+``extract_article_data`` is a site plugin, and the TPU batch backend
+(``tpu_batch``) plugs in *behind* this boundary exactly as the north star
+mandates.
+"""
+
+from __future__ import annotations
+
+from importlib import import_module
+from typing import Callable, Protocol
+
+_REGISTRY: dict[str, Callable] = {}
+
+
+class Extractor(Protocol):
+    def __call__(self, soup) -> dict: ...
+
+
+def register(name: str, fn: Callable) -> None:
+    """Register a non-module extractor (e.g. a template-driven one)."""
+    _REGISTRY[name] = fn
+
+
+def load_extractor(website: str) -> Callable:
+    """Resolve a site name to its ``extract_article_data`` callable.
+
+    Mirrors the reference's dynamic import
+    (``constant_rate_scrapper.py:299-304``) with a registry layered on top
+    so declarative-template extractors (``template.py``) can be addressed by
+    name too.
+    """
+    if website in _REGISTRY:
+        return _REGISTRY[website]
+    mod = import_module(f"advanced_scrapper_tpu.extractors.{website}")
+    return mod.extract_article_data

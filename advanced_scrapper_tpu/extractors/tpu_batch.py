@@ -1,0 +1,144 @@
+"""The TPU batch backend behind the extractor plugin boundary (north star).
+
+BASELINE.json: *"scraped pages are queued into fixed-size batches and
+dispatched to a new ``extractors/tpu_batch.py`` that runs byte-tokenization,
+MinHash shingling, and LSH near-duplicate bucketing as ``jax.vmap``'d
+kernels"*.
+
+:class:`TpuBatchBackend` is a **streaming** dedup stage: extracted article
+records are submitted one by one (by the CPU-side fetch loop), buffered into
+fixed-size device batches, hashed on the TPU, and joined against a host-side
+bucket index that persists across batches — the cross-batch successor of the
+reference's resume-by-rereading-CSVs idiom.  Decisions are annotated onto the
+records (``dup_of``/``near_dup_of``), never destructive, so downstream
+writers decide what to drop.
+
+Division of labour (why the host keeps a dict): the TPU turns O(len) text
+into 128-int signatures and 16 band keys — the quadratic/hashing work — while
+the host does O(1) dict probes per band key.  A device-resident global index
+would need dynamic shapes; a host dict over compact keys is the
+XLA-idiomatic split.  For *static* corpora the all-device path
+(``parallel.sharded.make_sharded_dedup``) does the whole join on the mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+import numpy as np
+
+from advanced_scrapper_tpu.config import DedupConfig
+from advanced_scrapper_tpu.core.hashing import make_params
+from advanced_scrapper_tpu.ops.lsh import band_keys
+from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+
+@dataclass
+class BatchStats:
+    submitted: int = 0
+    batches: int = 0
+    exact_dups: int = 0
+    near_dups: int = 0
+    kept: int = 0
+
+
+class TpuBatchBackend:
+    """Streaming exact + near-dup annotator over fixed-size TPU batches."""
+
+    def __init__(
+        self,
+        cfg: DedupConfig | None = None,
+        *,
+        text_field: str = "article",
+        key_field: str = "url",
+        sink: Callable[[dict], None] | None = None,
+    ):
+        self.cfg = cfg or DedupConfig()
+        self.params = make_params(
+            num_perm=self.cfg.num_perm,
+            num_bands=self.cfg.num_bands,
+            shingle_k=self.cfg.shingle_k,
+            seed=self.cfg.seed,
+        )
+        self.engine = NearDupEngine(self.cfg, self.params)
+        self.text_field = text_field
+        self.key_field = key_field
+        self.sink = sink
+        self.stats = BatchStats()
+        self._buffer: list[dict] = []
+        # cross-batch state: exact keys seen, and band-bucket → (key, sig row)
+        self._seen_keys: set[str] = set()
+        self._buckets: dict[tuple[int, int], int] = {}  # (band, key) -> sig idx
+        self._kept_sigs: list[np.ndarray] = []
+        self._kept_keys: list[str] = []
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, record: dict) -> list[dict]:
+        """Queue one extracted record; returns processed records when a full
+        device batch was flushed (empty list otherwise)."""
+        self.stats.submitted += 1
+        self._buffer.append(record)
+        if len(self._buffer) >= self.cfg.batch_size:
+            return self._process()
+        return []
+
+    def flush(self) -> list[dict]:
+        """Process whatever is buffered (padding the device batch)."""
+        return self._process() if self._buffer else []
+
+    # -- internals ---------------------------------------------------------
+
+    def _process(self) -> list[dict]:
+        records, self._buffer = self._buffer, []
+        self.stats.batches += 1
+
+        # exact stage: host dict over record keys (urls)
+        for rec in records:
+            key = str(rec.get(self.key_field, ""))
+            if key and key in self._seen_keys:
+                rec["dup_of"] = key
+                self.stats.exact_dups += 1
+            else:
+                rec["dup_of"] = None
+                if key:
+                    self._seen_keys.add(key)
+
+        # near-dup stage: device signatures + band keys, host bucket join
+        texts = [str(r.get(self.text_field, "") or "") for r in records]
+        sigs = self.engine.signatures(texts)
+        keys = np.asarray(band_keys(sigs, self.params.band_salt))
+        thresh = self.cfg.sim_threshold
+        for i, rec in enumerate(records):
+            rec["near_dup_of"] = None
+            if rec["dup_of"] is not None:
+                continue  # already an exact dup
+            if not str(rec.get(self.key_field, "") or ""):
+                continue  # keyless records cannot be referenced as dup targets
+            if len(texts[i].encode("utf-8", "replace")) < self.params.shingle_k:
+                continue  # no shingles: never bucket
+            candidate = None
+            for b in range(self.params.num_bands):
+                idx = self._buckets.get((b, int(keys[i, b])))
+                if idx is not None:
+                    agree = float(np.mean(self._kept_sigs[idx] == sigs[i]))
+                    if agree >= thresh:
+                        candidate = self._kept_keys[idx]
+                        break
+            if candidate is not None:
+                rec["near_dup_of"] = candidate
+                self.stats.near_dups += 1
+            else:
+                sig_idx = len(self._kept_sigs)
+                # copy: a row view would pin the whole batch array forever
+                self._kept_sigs.append(sigs[i].copy())
+                self._kept_keys.append(str(rec.get(self.key_field, "")))
+                for b in range(self.params.num_bands):
+                    self._buckets.setdefault((b, int(keys[i, b])), sig_idx)
+                self.stats.kept += 1
+
+        if self.sink is not None:
+            for rec in records:
+                self.sink(rec)
+        return records

@@ -1,0 +1,151 @@
+"""Pure-function extractor tests on saved HTML (SURVEY.md §4's strategy —
+the reference has only live integration scripts, 02_test_1.py:58-61)."""
+
+import json
+import os
+
+import pytest
+from bs4 import BeautifulSoup
+
+from advanced_scrapper_tpu.extractors import load_extractor, register
+from advanced_scrapper_tpu.extractors.template import (
+    TemplateStore,
+    extract_with_template,
+    make_template_extractor,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def _soup(name):
+    with open(os.path.join(FIXTURES, name), encoding="utf-8") as f:
+        return BeautifulSoup(f.read(), "html.parser")
+
+
+@pytest.fixture(scope="module")
+def article():
+    return load_extractor("yfin")(_soup("yfin_article.html"))
+
+
+def test_yfin_title_author_datetime(article):
+    assert article["title"] == "Apple Reports Record Q3 iPhone Revenue"
+    assert article["author"] == "Jane Smith"
+    assert article["datetime"] == "2024-05-14T13:30:00.000Z"
+    assert "error" not in article
+
+
+def test_yfin_body_structure(article):
+    lines = article["article"].split("\n")
+    assert lines[0] == "Apple Inc. reported record revenue for the third quarter."
+    assert lines[1] == "Analysts had expected weaker results amid supply concerns."
+    # unordered list → bullets, empty <li> skipped
+    assert "• iPhone revenue up 8%" in lines
+    assert "• Services revenue up 12%" in lines
+    # ordered list → numbered
+    assert "1. Record quarter" in lines and "2. Guidance raised" in lines
+    # table → JSON with header zip
+    table_line = next(l for l in lines if l.startswith("["))
+    assert json.loads(table_line) == [
+        {"Segment": "iPhone", "Revenue": "$39.7B"},
+        {"Segment": "Services", "Revenue": "$21.2B"},
+    ]
+
+
+def test_yfin_ticker_symbols_ordered_dedup(article):
+    assert article["ticker_symbols"] == ["AAPL", "MSFT"]
+
+
+def test_yfin_source(article):
+    assert article["source"] == "Reuters"
+    assert article["source_url"] == "https://www.reuters.com/technology/apple-q3"
+
+
+def test_yfin_rate_limit_sentinel():
+    data = load_extractor("yfin")(_soup("yfin_rate_limited.html"))
+    assert data["title"] == ""
+    assert data["error"] == "rate_limit_reached"
+    assert data["article"] == ""
+
+
+def test_yfin_headerless_table_and_orphan_li():
+    data = load_extractor("yfin")(_soup("yfin_headerless_table.html"))
+    lines = data["article"].split("\n")
+    # headerless table keeps all rows as lists
+    assert json.loads(lines[0]) == [["", ""], ["Dow", "+0.5%"]]
+    assert lines[1] == "• orphan bullet"
+    assert data["source"] == "" and data["source_url"] == ""
+
+
+def test_template_interpreter_reference_dialect():
+    """Spec semantics must match the reference interpreters
+    (03_worker_multi.py:107-133, local.py:61-83): index is a LIST,
+    attribute defaults to 'text', dict specs always return lists."""
+    soup = _soup("yfin_article.html")
+    template = {
+        "title": "div.cover-title",                                  # plain string
+        "date": {"selector": "time", "attribute": "datetime", "index": [0]},
+        "bullets": {"selector": "ul li"},                            # no index → all
+        "second_bullet": {"selector": "ul li", "index": [1]},
+        "missing": "div.does-not-exist",                             # → ''
+        "missing_dict": {"selector": "div.does-not-exist"},          # → []
+        "links": {                                                   # nested inner spec
+            "selector": "div.body p",
+            "inner": {"selector": "a", "attribute": "href"},
+        },
+    }
+    out = extract_with_template(soup, template)
+    assert out["title"] == "Apple Reports Record Q3 iPhone Revenue"
+    assert out["date"] == ["2024-05-14T13:30:00.000Z"]
+    assert out["bullets"] == ["iPhone revenue up 8%", "Services revenue up 12%", ""]
+    assert out["second_bullet"] == ["Services revenue up 12%"]
+    assert out["missing"] == ""
+    assert out["missing_dict"] == []
+    # inner: one (possibly empty) list per selected <p>; the quote links land
+    # in the last paragraph's sub-list
+    assert out["links"][-1][0].startswith("https://finance.yahoo.com/quote/AAPL")
+
+
+def test_template_reference_templates_json_dialect_loads():
+    """The persisted reference template (experiental/templates.json dialect)
+    must interpret without error — index [0] lists, inner specs, attributes."""
+    template = {
+        "title": 'h1[data-test-locator="headline"]',
+        "author": "span.caas-author-byline-collapse",
+        "date": {"selector": "time", "attribute": "datetime", "index": [0]},
+        "article": "div.caas-body",
+        "ticker_symbols": {
+            "selector": "div.caas-body-content",
+            "attribute": "data-symbol",
+            "index": [0],
+            "inner": {"selector": "fin-ticker", "attribute": "symbol"},
+        },
+    }
+    soup = _soup("yfin_article.html")  # new-DOM page: caas-era fields absent
+    out = extract_with_template(soup, template)
+    assert out["title"] == ""                         # caas selector not present
+    assert out["date"] == ["2024-05-14T13:30:00.000Z"]  # <time> is generic
+    assert out["ticker_symbols"] == []                # caas container absent
+
+
+def test_template_index_out_of_range_filtered():
+    soup = _soup("yfin_article.html")
+    # reference filters out-of-range indices (03_worker_multi.py:116)
+    assert extract_with_template(soup, {"x": {"selector": "ul li", "index": [99]}})["x"] == []
+
+
+def test_template_store_roundtrip(tmp_path):
+    path = str(tmp_path / "templates.json")
+    store = TemplateStore(path)
+    store.add("mysite", {"title": "div.cover-title"})
+    # registered as a plugin under its name
+    fn = load_extractor("mysite")
+    assert fn(_soup("yfin_article.html"))["title"].startswith("Apple")
+    # reload from disk
+    store2 = TemplateStore(path)
+    assert store2.names() == ["mysite"]
+    store2.register_all()
+
+
+def test_register_custom_plugin():
+    register("nullsite", lambda soup: {"title": ""})
+    assert load_extractor("nullsite")(None) == {"title": ""}

@@ -1,0 +1,83 @@
+"""Streaming TPU batch backend tests (north star: dedup behind the
+extractor plugin boundary, with state that survives across device batches)."""
+
+import numpy as np
+
+from advanced_scrapper_tpu.config import DedupConfig
+from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+
+
+def _rec(url, text):
+    return {"url": url, "article": text, "title": "t"}
+
+
+def _corpus_text(rng, n=300):
+    return bytes(rng.randint(32, 127, size=n, dtype=np.uint8)).decode("ascii")
+
+
+def test_exact_dup_within_and_across_batches():
+    be = TpuBatchBackend(DedupConfig(batch_size=4, block_len=512))
+    rng = np.random.RandomState(0)
+    texts = [_corpus_text(rng) for _ in range(6)]
+    out = []
+    for i in range(4):
+        out += be.submit(_rec(f"u{i}", texts[i]))
+    assert len(out) == 4 and all(r["dup_of"] is None for r in out)
+    # second batch repeats u1 exactly (same url)
+    out2 = []
+    for rec in [_rec("u1", texts[1]), _rec("u4", texts[4]), _rec("u5", texts[5]), _rec("u9", texts[1])]:
+        out2 += be.submit(rec)
+    assert out2[0]["dup_of"] == "u1"          # exact url dup across batches
+    assert out2[1]["dup_of"] is None
+    # u9: different url, identical text → near-dup of u1
+    assert out2[3]["dup_of"] is None
+    assert out2[3]["near_dup_of"] == "u1"
+    assert be.stats.exact_dups == 1 and be.stats.near_dups == 1
+
+
+def test_near_dup_across_batches_with_mutation():
+    be = TpuBatchBackend(DedupConfig(batch_size=2, block_len=512))
+    rng = np.random.RandomState(7)
+    base = _corpus_text(rng, 400)
+    mutated = base[:390] + "XXCHANGEDX"
+    other1, other2 = _corpus_text(rng, 400), _corpus_text(rng, 400)
+    r1 = be.submit(_rec("a", base)) + be.submit(_rec("b", other1))
+    r2 = be.submit(_rec("c", mutated)) + be.submit(_rec("d", other2))
+    assert r1[0]["near_dup_of"] is None
+    assert r2[0]["near_dup_of"] == "a"
+    assert r2[1]["near_dup_of"] is None
+
+
+def test_flush_processes_partial_batch_and_sink():
+    seen = []
+    be = TpuBatchBackend(DedupConfig(batch_size=64, block_len=512), sink=seen.append)
+    be.submit(_rec("x", "some article text body here"))
+    assert be.flush()[0]["dup_of"] is None
+    assert len(seen) == 1
+    assert be.flush() == []
+
+
+def test_short_texts_never_near_dup():
+    be = TpuBatchBackend(DedupConfig(batch_size=2, block_len=512))
+    out = be.submit(_rec("a", "ab")) + be.submit(_rec("b", "ab"))
+    assert all(r["near_dup_of"] is None for r in out)
+    assert be.stats.kept == 0  # nothing bucketable
+
+
+def test_empty_text_field_handled():
+    be = TpuBatchBackend(DedupConfig(batch_size=2, block_len=512))
+    out = be.submit(_rec("a", None)) + be.submit(_rec("b", ""))
+    assert len(out) == 2
+    assert all(r["near_dup_of"] is None for r in out)
+
+
+def test_keyless_records_never_become_dup_targets():
+    be = TpuBatchBackend(DedupConfig(batch_size=2, block_len=512))
+    rng = np.random.RandomState(3)
+    text = _corpus_text(rng, 300)
+    out = be.submit({"article": text}) + be.submit(_rec("real", text))
+    assert out[0]["near_dup_of"] is None       # keyless: skipped entirely
+    assert out[1]["near_dup_of"] is None       # nothing was registered before it
+    # and the keyed record IS registered as a future target
+    out2 = be.submit(_rec("later", text)) + be.submit(_rec("x", "unrelated totally different body"))
+    assert out2[0]["near_dup_of"] == "real"
