@@ -64,19 +64,21 @@ def _import_pipeline(module: str, attr: str):
     return getattr(mod, attr)
 
 
+def _with_overrides(cfg, **overrides):
+    import dataclasses
+
+    overrides = {k: v for k, v in overrides.items() if v is not None}
+    return dataclasses.replace(cfg, **overrides) if overrides else cfg
+
+
 def _cmd_harvest(args: argparse.Namespace) -> int:
     run_harvest = _import_pipeline("harvest", "run_harvest")
-    return run_harvest(default_config().harvest, transport=args.transport)
+    return run_harvest(_with_overrides(default_config().harvest, transport=args.transport))
 
 
 def _cmd_scrape(args: argparse.Namespace) -> int:
     run_scraper = _import_pipeline("scraper", "run_scraper")
-    cfg = default_config().scraper
-    if args.transport:
-        import dataclasses
-
-        cfg = dataclasses.replace(cfg, transport=args.transport)
-    return run_scraper(cfg)
+    return run_scraper(_with_overrides(default_config().scraper, transport=args.transport))
 
 
 def _cmd_enrich(args: argparse.Namespace) -> int:

@@ -1,0 +1,8 @@
+from advanced_scrapper_tpu.net.transport import (
+    FetchError,
+    MockTransport,
+    RequestsTransport,
+    make_transport,
+)
+
+__all__ = ["FetchError", "MockTransport", "RequestsTransport", "make_transport"]

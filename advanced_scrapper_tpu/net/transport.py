@@ -1,0 +1,165 @@
+"""Fetch transports — the CPU-side fetch substrate behind the engine.
+
+The reference drives external browser binaries over WebDriver
+(geckodriver/Firefox at ``constant_rate_scrapper.py:136-139``,
+undetected-chromedriver in ``experiental/00_worker.py:31``); the north star
+keeps fetching CPU-side.  The engine only needs ``fetch(url) -> html``, so
+transports are swappable:
+
+- :class:`SeleniumTransport` — headless Firefox with the reference's
+  preferences (images off, JS off, 30 s page-load timeout, readyState wait);
+  available only where selenium + geckodriver exist.
+- :class:`RequestsTransport` — plain HTTP with a browser UA (the substrate
+  of ``ticker_symbol_query*.py``).
+- :class:`MockTransport` — fixture pages for tests and offline runs.
+
+``FetchError`` carries the error string; the engine fingerprints it for
+rate-limit detection exactly like the reference fingerprints WebDriver
+exceptions (``constant_rate_scrapper.py:190-193``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Mapping
+
+USER_AGENT = (
+    "Mozilla/5.0 (Windows NT 10.0; Win64; x64) AppleWebKit/537.36 "
+    "(KHTML, like Gecko) Chrome/120.0.0.0 Safari/537.36"
+)
+
+
+class FetchError(Exception):
+    """Fetch failure; ``str(e)`` is the error string recorded in failed CSVs."""
+
+
+class MockTransport:
+    """Serve canned pages.  ``pages`` maps url → html | Exception | callable;
+    unknown urls raise FetchError("about:neterror")."""
+
+    def __init__(self, pages: Mapping[str, object] | Callable[[str], str], latency: float = 0.0):
+        self._pages = pages
+        self._latency = latency
+        self.fetched: list[str] = []
+
+    def fetch(self, url: str) -> str:
+        if self._latency:
+            time.sleep(self._latency)
+        self.fetched.append(url)
+        if callable(self._pages):
+            return self._pages(url)
+        page = self._pages.get(url)
+        if page is None:
+            # deliberately NOT 'about:neterror': that substring is the
+            # engine's rate-limit fingerprint and would trip a long global
+            # pause for every missing fixture
+            raise FetchError(f"no fixture for {url}")
+        if isinstance(page, Exception):
+            raise page
+        if callable(page):
+            return page(url)
+        return str(page)
+
+    def close(self) -> None:
+        pass
+
+
+class RequestsTransport:
+    def __init__(self, timeout: float = 30.0, user_agent: str = USER_AGENT):
+        import requests
+
+        self._session = requests.Session()
+        self._session.headers["User-Agent"] = user_agent
+        self._timeout = timeout
+
+    def fetch(self, url: str) -> str:
+        import requests
+
+        try:
+            resp = self._session.get(url, timeout=self._timeout)
+        except requests.RequestException as e:
+            raise FetchError(str(e)) from e
+        if resp.status_code >= 400:
+            raise FetchError(f"HTTP {resp.status_code} for {url}")
+        return resp.text
+
+    def close(self) -> None:
+        self._session.close()
+
+
+class SeleniumTransport:
+    """Headless Firefox via geckodriver, reference preferences
+    (``constant_rate_scrapper.py:33-41,136-153``)."""
+
+    def __init__(
+        self,
+        page_load_timeout: float = 30.0,
+        ready_state_timeout: float = 10.0,
+        executable_path: str = "geckodriver",
+    ):
+        from selenium import webdriver
+        from selenium.webdriver.firefox.options import Options
+        from selenium.webdriver.firefox.service import Service
+
+        options = Options()
+        options.set_preference("permissions.default.image", 2)
+        options.set_preference("javascript.enabled", False)
+        options.set_preference("dom.ipc.plugins.enabled.libflashplayer.so", False)
+        options.add_argument("-headless")
+        self._driver = webdriver.Firefox(
+            service=Service(executable_path=executable_path), options=options
+        )
+        self._driver.set_page_load_timeout(page_load_timeout)
+        self._ready_timeout = ready_state_timeout
+
+    def fetch(self, url: str) -> str:
+        from selenium.webdriver.support.ui import WebDriverWait
+
+        try:
+            self._driver.get(url)
+            WebDriverWait(self._driver, self._ready_timeout).until(
+                lambda d: d.execute_script("return document.readyState") == "complete"
+            )
+            return self._driver.page_source
+        except Exception as e:  # WebDriver raises many exception types
+            raise FetchError(str(e)) from e
+
+    def close(self) -> None:
+        self._driver.quit()
+
+
+def selenium_available() -> bool:
+    try:
+        import selenium  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def make_transport(
+    name: str = "auto",
+    *,
+    page_load_timeout: float = 30.0,
+    ready_state_timeout: float = 10.0,
+    pages=None,
+    **kw,
+):
+    """``auto`` prefers selenium (browser fidelity) and falls back to HTTP.
+
+    Timeouts map onto whichever transport is chosen: selenium gets both,
+    requests uses ``page_load_timeout`` as its request timeout.
+    """
+    if name == "auto":
+        name = "selenium" if selenium_available() else "requests"
+    if name == "selenium":
+        return SeleniumTransport(
+            page_load_timeout=page_load_timeout,
+            ready_state_timeout=ready_state_timeout,
+            **kw,
+        )
+    if name == "requests":
+        return RequestsTransport(timeout=page_load_timeout)
+    if name == "mock":
+        return MockTransport(pages if pages is not None else {})
+    raise ValueError(f"unknown transport '{name}'")

@@ -1,0 +1,4 @@
+from advanced_scrapper_tpu.obs.stats import StatsTracker
+from advanced_scrapper_tpu.obs.console import ConsoleMux, green, red
+
+__all__ = ["StatsTracker", "ConsoleMux", "green", "red"]

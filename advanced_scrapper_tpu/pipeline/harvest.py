@@ -1,0 +1,175 @@
+"""L1: Internet-Archive CDX URL harvest → deduplicated ``yfin_urls.csv``.
+
+Re-implements ``yahoo_links_selenium.py`` semantics:
+
+- shard space: every 2-character prefix over the reference's 39-char
+  alphabet (a-z, 0-9, ``-``, ``_``, ``$``; ref ``:28``) → one CDX query per
+  prefix (``:31-34``);
+- **shard-file resume**: prefixes whose ``yahoo_<pfx>.txt`` already exists
+  are skipped (``:29-33``) — the shard files ARE the checkpoint;
+- per-shard parse of the space-delimited CDX dump with pandas (columns 1-2 →
+  ``date_time,url``; ``:59``) and the exact normalisation chain
+  (``:63-76``): keep rows containing ``.html`` (regex semantics preserved),
+  truncate at ``.html``, strip ``:80``, ``http:``→``https:``, drop
+  ``news/%`` and ``news/'`` junk; per-shard ``drop_duplicates`` (``:79``);
+- **merge**: concat all shard CSVs and global exact-dedup keep-first.  This
+  is the step the north star reroutes through the TPU backend: the 128-bit
+  device hash proposes groups, the host confirms equality, and the output
+  CSV is byte-identical to the pandas ``drop_duplicates`` path (``:174``)
+  — asserted by golden tests.
+"""
+
+from __future__ import annotations
+
+import glob
+import io
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable
+
+import pandas as pd
+from bs4 import BeautifulSoup
+
+from advanced_scrapper_tpu.config import HarvestConfig
+
+CHAR_LIST = list("abcdefghijklmnopqrstuvwxyz") + list("1234567890") + ["-", "_", "$"]
+# ref yahoo_links_selenium.py:28
+
+
+def shard_prefixes(shard_dir: str) -> list[str]:
+    """All 2-char prefixes without an existing shard file (resume, ref :29-34)."""
+    done = set(os.listdir(shard_dir)) if os.path.isdir(shard_dir) else set()
+    out = []
+    for c0 in CHAR_LIST:
+        for c1 in CHAR_LIST:
+            if f"yahoo_{c0}{c1}.txt" not in done:
+                out.append(c0 + c1)
+    return out
+
+
+def cdx_query_url(prefix: str, cfg: HarvestConfig) -> str:
+    target = cfg.target_pattern.format(prefix=prefix)
+    return f"{cfg.cdx_base}?url={target}"
+
+
+def normalize_cdx_frame(df: pd.DataFrame) -> pd.DataFrame:
+    """The reference's normalisation chain, verbatim semantics (ref :63-79).
+
+    ``str.contains('.html')`` is kept with default regex=True on purpose —
+    byte-identical output requires reproducing the reference's (technically
+    sloppy) any-char dot.
+    """
+    df = df[df["url"].str.contains(".html")]
+    df = df.copy()
+    df["url"] = df["url"].str.split(".html").str[0] + ".html"
+    df["url"] = df["url"].str.replace(":80", "", regex=False)
+    df["url"] = df["url"].str.replace("http:", "https:", regex=False)
+    df = df[~df["url"].str.contains("news/%")]
+    df = df[~df["url"].str.contains("news/'")]
+    df = df.drop_duplicates(subset=["url"])
+    return df
+
+
+def parse_cdx_text(text: str) -> pd.DataFrame:
+    """Space-delimited CDX dump → (date_time, url) frame (ref :59)."""
+    return pd.read_csv(
+        io.StringIO(text),
+        delimiter=" ",
+        header=None,
+        usecols=[1, 2],
+        names=["date_time", "url"],
+    )
+
+
+def process_shard(prefix: str, transport, cfg: HarvestConfig) -> str | None:
+    """Fetch one CDX shard, persist raw text + normalised CSV (ref :38-82)."""
+    url = cdx_query_url(prefix, cfg)
+    try:
+        page = transport.fetch(url)
+        text = BeautifulSoup(page, "html.parser").get_text(separator="\n", strip=True)
+        txt_path = os.path.join(cfg.shard_dir, f"yahoo_{prefix}.txt")
+        with open(txt_path, "w", encoding="utf-8") as f:
+            f.write(text)
+        if not text.strip():
+            return None
+        df = normalize_cdx_frame(parse_cdx_text(text))
+        csv_path = os.path.join(cfg.shard_dir, f"yahoo_{prefix}.csv")
+        df.to_csv(csv_path, index=False)
+        return csv_path
+    except Exception as e:
+        print(f"Error scraping {url}: {e}")
+        return None
+
+
+def merge_shards(cfg: HarvestConfig, *, use_tpu: bool = True) -> int:
+    """Concat shard CSVs → global keep-first exact dedup → output CSV.
+
+    ``use_tpu`` routes the dedup through ``pipeline.dedup.ExactDedup``
+    (device hashing + host confirmation); the fallback is the reference's
+    pandas path.  Outputs are byte-identical either way (golden-tested).
+    """
+    files = sorted(glob.glob(os.path.join(cfg.shard_dir, "*.csv")))
+    dfs = []
+    for f in files:
+        try:
+            dfs.append(pd.read_csv(f))
+        except Exception as e:
+            print(f"Error reading {f}: {e}")
+    if not dfs:
+        print("No CSV files were processed.")
+        return 0
+    merged = pd.concat(dfs, ignore_index=True)
+    if use_tpu:
+        from advanced_scrapper_tpu.pipeline.dedup import ExactDedup
+
+        urls = merged["url"].astype(str).tolist()
+        max_len = max((len(u.encode("utf-8", "replace")) for u in urls), default=1)
+        keep = ExactDedup(max_len=max(4096, max_len)).keep_mask(urls)
+        merged = merged[keep]
+    else:
+        merged = merged.drop_duplicates(subset=["url"])
+    merged.to_csv(cfg.output_csv, index=False)
+    print(f"Found {len(merged)} unique URLs → {cfg.output_csv}")
+    return len(merged)
+
+
+def run_harvest(
+    cfg: HarvestConfig,
+    *,
+    transport=None,
+    transport_factory: Callable[[], object] | None = None,
+    use_tpu: bool = True,
+) -> int:
+    """CLI entry: full shard sweep + merge (ref ``__main__`` :129-182)."""
+    os.makedirs(cfg.shard_dir, exist_ok=True)
+    prefixes = shard_prefixes(cfg.shard_dir)
+    if prefixes:
+        if transport_factory is None:
+            if transport is not None:
+                shared = transport
+                transport_factory = lambda: shared  # noqa: E731
+            else:
+                from advanced_scrapper_tpu.net.transport import make_transport
+
+                transport_factory = lambda: make_transport(  # noqa: E731
+                    cfg.transport, ready_state_timeout=cfg.ready_state_timeout
+                )
+        print(f"Harvesting {len(prefixes)} CDX shards with {cfg.num_workers} workers")
+
+        def worker_batch(batch: list[str]) -> None:
+            t = transport_factory()
+            try:
+                for p in batch:
+                    process_shard(p, t, cfg)
+            finally:
+                try:
+                    t.close()
+                except Exception:
+                    pass
+
+        n = max(1, cfg.num_workers)
+        batches = [prefixes[i::n] for i in range(n)]
+        with ThreadPoolExecutor(max_workers=n) as ex:
+            list(ex.map(worker_batch, [b for b in batches if b]))
+    merge_shards(cfg, use_tpu=use_tpu)
+    return 0

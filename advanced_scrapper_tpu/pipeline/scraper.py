@@ -1,0 +1,396 @@
+"""Constant-rate acquisition engine (L2).
+
+Re-implements the production engine's semantics
+(``constant_rate_scrapper.py:115-493``) with the races designed out
+(SURVEY.md §5.2):
+
+- **admission control at the feeder**, not the workers: one URL enters the
+  queue every ``1/rate`` seconds (ref ``:207-220``);
+- **worker pool** of N fetch threads, each owning its transport (the ref's
+  per-thread Firefox, ``:136``);
+- **rate-limit circuit breaker**: the extractor's ``rate_limit_reached``
+  sentinel or a network fingerprint (``contentEncodingError`` /
+  ``about:neterror``, ref ``:190-193``) trips a global pause for
+  ``rate_limit_wait`` seconds.  The ref mutates an unlocked global ``pause``
+  read by three threads; here :class:`PauseController` owns a deadline
+  behind a lock;
+- **single-writer CSVs**: only the result loop touches the success/failed
+  files (the ref locks per-file; we remove the shared mutation instead),
+  flush-per-row so the checkpoint is always current;
+- **resume**: the work list is anti-joined against urls already present in
+  the success/failed CSVs (ref ``:316-356``) — failures are first-class
+  data and are not retried;
+- a URL consumed by a rate-limited fetch is *not* written anywhere, so a
+  later resume retries it (ref behaviour, ``:160-164``).
+
+The optional ``on_success`` hook is the CPU→TPU seam: ``run_scraper`` wires
+it to ``extractors.tpu_batch.TpuBatchBackend.submit`` so scraped articles
+stream into device batches asynchronously (north star).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Sequence
+
+from bs4 import BeautifulSoup
+
+from advanced_scrapper_tpu.config import ScraperConfig
+from advanced_scrapper_tpu.obs.console import ConsoleMux
+from advanced_scrapper_tpu.obs.stats import StatsTracker
+from advanced_scrapper_tpu.storage.csvio import AppendCsv, count_rows, scraped_url_set
+
+SUCCESS_FIELDS = [
+    "url",
+    "datetime",
+    "ticker_symbols",
+    "author",
+    "source",
+    "source_url",
+    "title",
+    "article",
+]  # ref constant_rate_scrapper.py:320-329
+FAILED_FIELDS = ["url", "error"]  # ref :330
+
+_RATE_LIMIT_FINGERPRINTS = ("contentEncodingError", "about:neterror")  # ref :190
+
+
+class PauseController:
+    """Deadline-based global pause (race-free successor of ref :30)."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._until = 0.0
+        self.trips = 0
+
+    def trigger(self, duration: float) -> None:
+        with self._lock:
+            self._until = max(self._until, self._clock() + duration)
+            self.trips += 1
+
+    def remaining(self) -> float:
+        with self._lock:
+            return max(0.0, self._until - self._clock())
+
+    def wait(self, sleep=time.sleep, tick: float = 1.0, should_stop=lambda: False) -> None:
+        while not should_stop():
+            r = self.remaining()
+            if r <= 0:
+                return
+            sleep(min(tick, r))
+
+
+@dataclass
+class ScrapeSummary:
+    total_urls: int = 0
+    already_scraped: int = 0
+    attempted: int = 0
+    succeeded: int = 0
+    failed: int = 0
+    rate_limited_skipped: int = 0  # consumed by a sentinel page; retried on resume
+    rate_limit_trips: int = 0
+    errors: list = field(default_factory=list)
+
+
+class ScraperEngine:
+    def __init__(
+        self,
+        cfg: ScraperConfig,
+        extractor: Callable,
+        transport_factory: Callable[[], object],
+        *,
+        console: ConsoleMux | None = None,
+        on_success: Callable[[dict], None] | None = None,
+        sleep=time.sleep,
+    ):
+        self.cfg = cfg
+        self.extractor = extractor
+        self.transport_factory = transport_factory
+        self.console = console or ConsoleMux()
+        self.on_success = on_success
+        self.sleep = sleep
+        self.stats = StatsTracker(window=cfg.stats_time_window)
+        self.pause = PauseController()
+        self._stop = threading.Event()
+
+    # -- worker ------------------------------------------------------------
+
+    def _classify(self, url: str, html: str):
+        soup = BeautifulSoup(html, "html.parser")
+        data = self.extractor(soup)
+        if "rate_limit_reached" in str(data.get("error", "")).lower():
+            # carry the url so the result loop can account for it; the url is
+            # still written nowhere (resume retries it, ref :160-164)
+            return ("rate_limit", {"url": url})
+        if not data.get("title", ""):
+            return ("failed", {"url": url, "error": "Title is empty"})
+        data["url"] = url
+        return ("success", data)
+
+    def _worker(self, url_q: queue.Queue, result_q: queue.Queue) -> None:
+        try:
+            transport = self.transport_factory()
+        except Exception as e:
+            self.console.failure(f"Failed to start transport: {e}")
+            self._stop.set()
+            return
+        try:
+            while not self._stop.is_set():
+                try:
+                    url = url_q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                try:
+                    html = transport.fetch(url)
+                    kind, payload = self._classify(url, html)
+                    if kind == "rate_limit":
+                        self.console.failure("!!!RATE LIMIT DETECTED!!!")
+                        self.pause.trigger(self.cfg.rate_limit_wait)
+                        result_q.put(("rate_limit", payload))
+                    elif kind == "failed":
+                        self.console.failure(f"FAIL {url} : {payload['error']}")
+                        self.stats.record_fail()
+                        result_q.put(("failed", payload))
+                    else:
+                        self.console.success(f"SUCCESS: {url}")
+                        self.stats.record_success()
+                        result_q.put(("success", payload))
+                except Exception as e:
+                    msg = str(e)
+                    self.console.failure(f"FAIL {url} : {msg}")
+                    self.stats.record_fail()
+                    result_q.put(("failed", {"url": url, "error": msg}))
+                    if any(fp in msg for fp in _RATE_LIMIT_FINGERPRINTS):
+                        self.console.failure(
+                            "!!!RATE LIMIT DETECTED (network fingerprint)!!!"
+                        )
+                        self.pause.trigger(self.cfg.rate_limit_wait)
+                        result_q.put(("rate_limit", None))
+                finally:
+                    url_q.task_done()
+        finally:
+            try:
+                transport.close()
+            except Exception:
+                pass
+
+    # -- feeder ------------------------------------------------------------
+
+    def _feeder(self, urls: Sequence[str], url_q: queue.Queue) -> None:
+        interval = 1.0 / self.cfg.desired_request_rate
+        for url in urls:
+            if self._stop.is_set():
+                return
+            self.pause.wait(sleep=self.sleep, should_stop=self._stop.is_set)
+            url_q.put(url)
+            self.sleep(interval)
+
+    # -- stats line --------------------------------------------------------
+
+    def _stats_line(self, initial_total: int, already: int) -> str:
+        rate = self.stats.get_actual_rate()
+        s, f = self.stats.get_stats()
+        cs, cf = self.stats.get_cumulative_stats()
+        total = cs + cf + already
+        progress = (total / initial_total * 100) if initial_total else 0.0
+        return (
+            f"Threads: {self.cfg.max_threads} | Requests: {rate:.2f}/s | "
+            f"Last {int(self.cfg.stats_time_window)} s: {s} Success, {f} Fail | "
+            f"Count: {total} | Progress: {progress:.4f}%"
+        )  # format ref :236-242
+
+    # -- run ---------------------------------------------------------------
+
+    def run(
+        self,
+        urls: Sequence[str],
+        success_csv: str,
+        failed_csv: str,
+        *,
+        initial_total: int | None = None,
+        already_scraped: int = 0,
+        show_stats: bool = False,
+    ) -> ScrapeSummary:
+        summary = ScrapeSummary(
+            total_urls=len(urls), already_scraped=already_scraped
+        )
+        initial_total = initial_total or len(urls)
+        url_q: queue.Queue = queue.Queue()
+        result_q: queue.Queue = queue.Queue()
+
+        workers = [
+            threading.Thread(target=self._worker, args=(url_q, result_q), daemon=True)
+            for _ in range(self.cfg.max_threads)
+        ]
+        for w in workers:
+            w.start()
+        feeder = threading.Thread(target=self._feeder, args=(urls, url_q), daemon=True)
+        feeder.start()
+
+        stats_stop = threading.Event()
+        if show_stats:
+            def stats_loop():
+                while not stats_stop.is_set():
+                    self.console.stats(self._stats_line(initial_total, already_scraped))
+                    self.sleep(0.1)
+
+            threading.Thread(target=stats_loop, daemon=True).start()
+
+        with AppendCsv(success_csv, SUCCESS_FIELDS) as ok_csv, AppendCsv(
+            failed_csv, FAILED_FIELDS
+        ) as bad_csv:
+            processed = 0
+            while processed < len(urls):
+                try:
+                    kind, data = result_q.get(timeout=self.cfg.result_timeout)
+                except queue.Empty:
+                    summary.errors.append("result timeout")
+                    break
+                if kind == "success":
+                    ok_csv.write_row(
+                        {f: data.get(f, "") for f in SUCCESS_FIELDS}
+                    )
+                    summary.succeeded += 1
+                    processed += 1
+                    if self.on_success is not None:
+                        try:
+                            self.on_success(dict(data))
+                        except Exception as e:
+                            summary.errors.append(f"on_success: {e}")
+                elif kind == "failed":
+                    bad_csv.write_row(data)
+                    summary.failed += 1
+                    processed += 1
+                elif kind == "rate_limit":
+                    # Sentinel-path events carry the consumed url: count it so
+                    # the loop terminates without stalling on result_timeout.
+                    # Fingerprint-path events (data None) already produced a
+                    # failed row and must not double-count.
+                    if data is not None:
+                        summary.rate_limited_skipped += 1
+                        processed += 1
+                    # Wait out the pause here too (ref :463-468) — otherwise
+                    # the result timeout below would fire mid-pause and abort
+                    # the run.  The pause controller is the single authority.
+                    self.console.event(
+                        f"Rate limit: pausing {self.pause.remaining():.0f} s"
+                    )
+                    self.pause.wait(sleep=self.sleep, should_stop=self._stop.is_set)
+                    self.console.event("Resuming scraping.")
+        summary.attempted = summary.succeeded + summary.failed
+        summary.rate_limit_trips = self.pause.trips
+        self._stop.set()
+        stats_stop.set()
+        feeder.join(timeout=5)
+        for w in workers:
+            w.join(timeout=5)
+        self.console.drain()
+        return summary
+
+
+def run_scraper(
+    cfg: ScraperConfig,
+    *,
+    transport_factory: Callable[[], object] | None = None,
+    urls: Iterable[str] | None = None,
+    with_tpu_backend: bool = True,
+    show_stats: bool = True,
+) -> int:
+    """CLI entry: resume-aware scrape of ``cfg.input_csv``.
+
+    Mirrors ``constant_rate_scrapper.main()`` (``:289-493``): dynamic
+    extractor import, CSV resume anti-join, then the engine; optionally
+    streams successes into the TPU dedup backend (north star).
+    """
+    import os
+
+    from advanced_scrapper_tpu.extractors import load_extractor
+
+    extractor = load_extractor(cfg.website)
+
+    success_csv = os.path.join(cfg.out_dir, f"success_articles_{cfg.website}.csv")
+    failed_csv = os.path.join(cfg.out_dir, f"failed_articles_{cfg.website}.csv")
+
+    if urls is None:
+        from advanced_scrapper_tpu.storage.csvio import read_url_column
+
+        if not os.path.exists(cfg.input_csv):
+            print(f"Input CSV file '{cfg.input_csv}' not found.")
+            return 1
+        urls = read_url_column(cfg.input_csv)
+    all_urls = [str(u) for u in urls]
+    initial_total = len(all_urls)
+
+    scraped = scraped_url_set(success_csv, failed_csv)
+    already = count_rows(success_csv) + count_rows(failed_csv)
+    todo = [u for u in all_urls if u not in scraped]
+    print(f"Total URLs in CSV: {initial_total}")
+    print(f"Already scraped (Success + Fails): {already}")
+    print(f"Remaining URLs to scrape: {len(todo)}")
+
+    if transport_factory is None:
+        from advanced_scrapper_tpu.net.transport import make_transport
+
+        transport_factory = lambda: make_transport(  # noqa: E731
+            cfg.transport,
+            page_load_timeout=cfg.page_load_timeout,
+            ready_state_timeout=cfg.ready_state_timeout,
+        )
+
+    on_success = None
+    backend = None
+    ann_csv = None
+    if with_tpu_backend:
+        from advanced_scrapper_tpu.config import DedupConfig
+        from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+        from advanced_scrapper_tpu.storage.csvio import AppendCsv as _Csv
+
+        ann_csv = _Csv(
+            os.path.join(cfg.out_dir, f"dedup_annotations_{cfg.website}.csv"),
+            ["url", "dup_of", "near_dup_of"],
+        )
+        backend = TpuBatchBackend(
+            DedupConfig(),
+            sink=lambda rec: ann_csv.write_row(
+                {
+                    "url": rec.get("url", ""),
+                    "dup_of": rec.get("dup_of") or "",
+                    "near_dup_of": rec.get("near_dup_of") or "",
+                }
+            ),
+        )
+        on_success = backend.submit
+
+    console = ConsoleMux().start()
+    engine = ScraperEngine(
+        cfg,
+        extractor,
+        transport_factory,
+        console=console,
+        on_success=on_success,
+    )
+    try:
+        summary = engine.run(
+            todo,
+            success_csv,
+            failed_csv,
+            initial_total=initial_total,
+            already_scraped=already,
+            show_stats=show_stats,
+        )
+    finally:
+        if backend is not None:
+            backend.flush()
+        if ann_csv is not None:
+            ann_csv.close()
+        console.stop()
+    print(
+        f"\nScraping completed: {summary.succeeded} success, "
+        f"{summary.failed} failed, {summary.rate_limited_skipped} rate-limited, "
+        f"{summary.rate_limit_trips} rate-limit trips."
+    )
+    return 0

@@ -1,0 +1,8 @@
+from advanced_scrapper_tpu.storage.csvio import (
+    AppendCsv,
+    read_url_column,
+    scraped_url_set,
+)
+from advanced_scrapper_tpu.storage.progress import ProgressLedger
+
+__all__ = ["AppendCsv", "read_url_column", "scraped_url_set", "ProgressLedger"]

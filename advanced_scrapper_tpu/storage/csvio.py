@@ -1,0 +1,77 @@
+"""Resumable append-only CSV stores.
+
+Output-artifact-as-checkpoint is the reference's resilience model
+(SURVEY.md §5.4): success/failed CSVs are re-read on startup and the work
+list anti-joined (``constant_rate_scrapper.py:316-356``); every row is
+flushed immediately so the checkpoint is always current (:448,:458).
+:class:`AppendCsv` packages that idiom: append mode, header-if-empty,
+flush-per-row, and a lock so it is safe even if a caller shares it across
+threads (the engine itself keeps a single writer thread by construction).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import threading
+from typing import Iterable, Sequence
+
+
+class AppendCsv:
+    def __init__(self, path: str, fieldnames: Sequence[str]):
+        self.path = path
+        self.fieldnames = list(fieldnames)
+        self._lock = threading.Lock()
+        existed = os.path.exists(path) and os.stat(path).st_size > 0
+        self._fh = open(path, "a", newline="", encoding="utf-8")
+        self._writer = csv.DictWriter(self._fh, fieldnames=self.fieldnames)
+        if not existed:
+            self._writer.writeheader()
+            self._fh.flush()
+
+    def write_row(self, data: dict) -> None:
+        """Write one row (missing fields become ''), flushing immediately."""
+        row = {f: data.get(f, "") for f in self.fieldnames}
+        with self._lock:
+            self._writer.writerow(row)
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._fh.close()
+
+    def __enter__(self) -> "AppendCsv":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_url_column(path: str, column: str = "url") -> list[str]:
+    """Read one column as strings (pandas-free fast path)."""
+    if not os.path.exists(path):
+        return []
+    out: list[str] = []
+    with open(path, newline="", encoding="utf-8") as fh:
+        for row in csv.DictReader(fh):
+            v = row.get(column)
+            if v is not None:
+                out.append(str(v))
+    return out
+
+
+def scraped_url_set(*paths: str, column: str = "url") -> set[str]:
+    """Union of url columns across existing CSVs — the resume anti-join set
+    (``constant_rate_scrapper.py:317-342``)."""
+    seen: set[str] = set()
+    for p in paths:
+        seen.update(read_url_column(p, column))
+    return seen
+
+
+def count_rows(path: str) -> int:
+    if not os.path.exists(path):
+        return 0
+    with open(path, newline="", encoding="utf-8") as fh:
+        n = sum(1 for _ in csv.reader(fh))
+    return max(0, n - 1)  # minus header

@@ -1,0 +1,108 @@
+"""CDX harvest tests incl. the byte-identical golden vs the pandas path."""
+
+import os
+
+import pandas as pd
+import pytest
+
+from advanced_scrapper_tpu.config import HarvestConfig
+from advanced_scrapper_tpu.net.transport import MockTransport
+from advanced_scrapper_tpu.pipeline.harvest import (
+    CHAR_LIST,
+    cdx_query_url,
+    merge_shards,
+    normalize_cdx_frame,
+    parse_cdx_text,
+    run_harvest,
+    shard_prefixes,
+)
+
+CDX_SAMPLE = """\
+com,yahoo,finance)/news/apple-hits-record 20230101010101 http://finance.yahoo.com:80/news/apple-hits-record.html text/html 200 AAAA 123
+com,yahoo,finance)/news/apple-hits-record 20230202020202 https://finance.yahoo.com/news/apple-hits-record.html text/html 200 BBBB 124
+com,yahoo,finance)/news/tesla-update 20230303030303 http://finance.yahoo.com/news/tesla-update.html?src=rss text/html 200 CCCC 125
+com,yahoo,finance)/news/junk 20230404040404 https://finance.yahoo.com/news/%20junkencoded.html text/html 200 DDDD 126
+com,yahoo,finance)/news/quoted 20230505050505 https://finance.yahoo.com/news/'quoted.html text/html 200 EEEE 127
+com,yahoo,finance)/news/notanarticle 20230606060606 https://finance.yahoo.com/news/image.png image/png 200 FFFF 128
+com,yahoo,finance)/news/msft-earnings 20230707070707 https://finance.yahoo.com/news/msft-earnings.html text/html 200 GGGG 129
+"""
+
+
+def test_char_list_matches_reference():
+    # ref yahoo_links_selenium.py:28 — 26 letters + 10 digits + 3 symbols
+    assert len(CHAR_LIST) == 39
+    assert CHAR_LIST[0] == "a" and CHAR_LIST[-1] == "$"
+
+
+def test_shard_prefixes_resume(tmp_path):
+    d = str(tmp_path)
+    all_p = shard_prefixes(d)
+    assert len(all_p) == 39 * 39
+    open(os.path.join(d, "yahoo_ab.txt"), "w").write("")
+    assert "ab" not in shard_prefixes(d)
+    assert len(shard_prefixes(d)) == 39 * 39 - 1
+
+
+def test_cdx_query_url():
+    cfg = HarvestConfig()
+    u = cdx_query_url("ab", cfg)
+    assert u == (
+        "http://web.archive.org/cdx/search/"
+        "?url=https://www.finance.yahoo.com/news/ab*"
+    )  # ref :34
+
+
+def test_normalization_chain_matches_reference_semantics():
+    df = normalize_cdx_frame(parse_cdx_text(CDX_SAMPLE))
+    urls = df["url"].tolist()
+    # http→https, :80 stripped, query truncated at .html
+    assert "https://finance.yahoo.com/news/apple-hits-record.html" in urls
+    assert "https://finance.yahoo.com/news/tesla-update.html" in urls
+    assert "https://finance.yahoo.com/news/msft-earnings.html" in urls
+    # junk rows dropped
+    assert not any("news/%" in u or "news/'" in u for u in urls)
+    # non-.html row dropped; duplicates collapsed keep-first
+    assert len(urls) == 3
+    assert df["date_time"].iloc[0] == 20230101010101  # first occurrence kept
+
+
+def test_run_harvest_end_to_end_and_byte_identical_merge(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cfg = HarvestConfig(shard_dir="shards", output_csv="yfin_urls.csv", num_workers=2)
+
+    # mock CDX: two prefixes return data, everything else empty
+    def pages(url):
+        if "news/aa*" in url:
+            return CDX_SAMPLE
+        if "news/ms*" in url:
+            return CDX_SAMPLE.replace("msft", "msft2")
+        return ""
+
+    rc = run_harvest(cfg, transport=MockTransport(pages), use_tpu=True)
+    assert rc == 0
+    # every prefix produced a .txt checkpoint → a rerun fetches nothing
+    assert len(os.listdir("shards")) >= 39 * 39
+    out_tpu = open("yfin_urls.csv", "rb").read()
+
+    # pandas reference path (the exact reference merge, ref :160-180)
+    files = sorted(
+        os.path.join("shards", f)
+        for f in os.listdir("shards")
+        if f.endswith(".csv")
+    )
+    merged = pd.concat([pd.read_csv(f) for f in files], ignore_index=True)
+    merged = merged.drop_duplicates(subset=["url"])
+    merged.to_csv("expected.csv", index=False)
+    assert out_tpu == open("expected.csv", "rb").read()
+
+    # resume: nothing left to harvest
+    t2 = MockTransport(pages)
+    run_harvest(cfg, transport=t2, use_tpu=False)
+    assert t2.fetched == []  # all shards checkpointed
+    assert open("yfin_urls.csv", "rb").read() == out_tpu  # pandas path identical
+
+
+def test_merge_shards_empty_dir(tmp_path):
+    cfg = HarvestConfig(shard_dir=str(tmp_path / "none"), output_csv=str(tmp_path / "o.csv"))
+    os.makedirs(cfg.shard_dir)
+    assert merge_shards(cfg) == 0

@@ -1,0 +1,96 @@
+import io
+import os
+
+from advanced_scrapper_tpu.obs.console import ConsoleMux, green, red
+from advanced_scrapper_tpu.obs.stats import RateStats, StatsTracker
+from advanced_scrapper_tpu.storage.csvio import (
+    AppendCsv,
+    count_rows,
+    read_url_column,
+    scraped_url_set,
+)
+from advanced_scrapper_tpu.storage.progress import ProgressLedger
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_stats_tracker_window_pruning():
+    clk = FakeClock()
+    st = StatsTracker(window=10.0, clock=clk)
+    st.record_success()
+    clk.t += 5
+    st.record_fail()
+    assert st.get_stats() == (1, 1)
+    clk.t += 6  # first event now 11s old
+    assert st.get_stats() == (0, 1)
+    assert st.get_cumulative_stats() == (1, 1)  # cumulative never prunes
+
+
+def test_stats_tracker_rate():
+    clk = FakeClock()
+    st = StatsTracker(window=10.0, clock=clk)
+    for _ in range(5):
+        st.record_success()
+        clk.t += 1.0
+    # 5 requests over 5s window span (ref definition: count / span-from-oldest)
+    assert abs(st.get_actual_rate() - 1.0) < 0.3
+    clk.t += 100
+    assert st.get_actual_rate() == 0.0
+
+
+def test_rate_stats_pair():
+    clk = FakeClock()
+    rs = RateStats(window=10.0, clock=clk)
+    rs.record_request()
+    rs.record_request()
+    rs.record_response()
+    req, resp = rs.rates()
+    assert req >= resp
+
+
+def test_console_mux_stats_line_and_events():
+    buf = io.StringIO()
+    mux = ConsoleMux(out=buf)
+    mux.stats("S1")
+    mux.event("hello")
+    mux.stats("S2")
+    mux.drain()
+    out = buf.getvalue()
+    assert "S1" in out and "hello" in out and "S2" in out
+    assert "\r\033[K" in out  # in-place repaint
+    assert green("x").startswith("\033[92m") and red("x").startswith("\033[91m")
+
+
+def test_append_csv_header_resume_and_flush(tmp_path):
+    path = str(tmp_path / "out.csv")
+    with AppendCsv(path, ["url", "error"]) as c:
+        c.write_row({"url": "a", "error": "boom", "extra": "ignored"})
+    # reopen: no duplicate header, append continues
+    with AppendCsv(path, ["url", "error"]) as c:
+        c.write_row({"url": "b"})
+    lines = open(path).read().splitlines()
+    assert lines[0] == "url,error"
+    assert lines[1:] == ["a,boom", "b,"]
+    assert count_rows(path) == 2
+    assert read_url_column(path) == ["a", "b"]
+    assert scraped_url_set(path, str(tmp_path / "missing.csv")) == {"a", "b"}
+
+
+def test_progress_ledger_repair(tmp_path):
+    path = str(tmp_path / "progress.json")
+    led = ProgressLedger(path)
+    led.mark_processed("AAPL")
+    led.mark_failed("MSFT")
+    led2 = ProgressLedger(path)  # reload from disk
+    assert led2.processed == {"AAPL"} and led2.failed == {"MSFT"}
+    # artifact exists → skip
+    assert led2.should_skip("AAPL", lambda: True)
+    # artifact vanished → un-mark and reprocess (ref :381-393)
+    assert not led2.should_skip("AAPL", lambda: False)
+    assert "AAPL" not in led2.processed

@@ -1,0 +1,185 @@
+"""End-to-end engine tests with the mock transport — the reference's
+untested core loop (constant_rate_scrapper.py) under deterministic fixtures."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from advanced_scrapper_tpu.config import ScraperConfig
+from advanced_scrapper_tpu.net.transport import FetchError, MockTransport, make_transport
+from advanced_scrapper_tpu.pipeline.scraper import (
+    FAILED_FIELDS,
+    SUCCESS_FIELDS,
+    PauseController,
+    ScraperEngine,
+    run_scraper,
+)
+from advanced_scrapper_tpu.storage.csvio import read_url_column
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+ARTICLE_HTML = open(os.path.join(FIXTURES, "yfin_article.html")).read()
+RATE_LIMIT_HTML = open(os.path.join(FIXTURES, "yfin_rate_limited.html")).read()
+NO_TITLE_HTML = "<html><body><p>nothing here</p></body></html>"
+
+
+def _cfg(**kw):
+    base = dict(
+        desired_request_rate=500.0,  # fast tests
+        max_threads=4,
+        rate_limit_wait=0.3,
+        result_timeout=5.0,
+    )
+    base.update(kw)
+    return ScraperConfig(**base)
+
+
+def _engine(pages, cfg=None, **kw):
+    from advanced_scrapper_tpu.extractors import load_extractor
+
+    transport = MockTransport(pages)
+    return (
+        ScraperEngine(
+            cfg or _cfg(),
+            load_extractor("yfin"),
+            lambda: transport,
+            **kw,
+        ),
+        transport,
+    )
+
+
+def test_success_failed_and_resume(tmp_path):
+    ok = str(tmp_path / "ok.csv")
+    bad = str(tmp_path / "bad.csv")
+    pages = {
+        "https://x/a.html": ARTICLE_HTML,
+        "https://x/b.html": NO_TITLE_HTML,
+        "https://x/c.html": FetchError("connection reset"),
+        "https://x/d.html": ARTICLE_HTML,
+    }
+    eng, _ = _engine(pages)
+    s = eng.run(list(pages), ok, bad)
+    assert s.succeeded == 2 and s.failed == 2 and s.rate_limit_trips == 0
+    assert sorted(read_url_column(ok)) == ["https://x/a.html", "https://x/d.html"]
+    rows = open(bad).read()
+    assert "Title is empty" in rows and "connection reset" in rows
+    # success CSV schema is the reference schema
+    assert open(ok).read().splitlines()[0] == ",".join(SUCCESS_FIELDS)
+    assert open(bad).read().splitlines()[0] == ",".join(FAILED_FIELDS)
+
+
+def test_rate_limit_sentinel_pauses_and_skips_url(tmp_path):
+    ok, bad = str(tmp_path / "ok.csv"), str(tmp_path / "bad.csv")
+    pages = {
+        "https://x/limited.html": RATE_LIMIT_HTML,
+        "https://x/fine.html": ARTICLE_HTML,
+    }
+    cfg = _cfg(rate_limit_wait=0.2, result_timeout=2.0)
+    eng, _ = _engine(pages, cfg)
+    t0 = time.monotonic()
+    s = eng.run(list(pages), ok, bad)
+    assert s.rate_limit_trips == 1
+    assert s.succeeded == 1
+    # rate-limited url written nowhere → retried on a future resume (ref :160-164)
+    assert read_url_column(ok) == ["https://x/fine.html"]
+    assert read_url_column(bad) == []
+    assert time.monotonic() - t0 >= 0.2  # pause actually held
+
+
+def test_network_fingerprint_trips_rate_limit(tmp_path):
+    ok, bad = str(tmp_path / "ok.csv"), str(tmp_path / "bad.csv")
+    pages = {
+        "https://x/neterr.html": FetchError("about:neterror — blocked"),
+        "https://x/fine.html": ARTICLE_HTML,
+    }
+    eng, _ = _engine(pages, _cfg(rate_limit_wait=0.2, result_timeout=2.0))
+    s = eng.run(list(pages), ok, bad)
+    assert s.rate_limit_trips == 1
+    # fingerprinted failure IS recorded as failed (ref records then signals)
+    assert read_url_column(bad) == ["https://x/neterr.html"]
+
+
+def test_on_success_hook_feeds_backend(tmp_path):
+    got = []
+    pages = {"https://x/a.html": ARTICLE_HTML}
+    eng, _ = _engine(pages, on_success=got.append)
+    eng.run(list(pages), str(tmp_path / "ok.csv"), str(tmp_path / "bad.csv"))
+    assert len(got) == 1 and got[0]["url"] == "https://x/a.html"
+    assert got[0]["title"].startswith("Apple")
+
+
+def test_pause_controller_threadsafe_extension():
+    p = PauseController(clock=lambda: 100.0)
+    p.trigger(5)
+    p.trigger(2)  # shorter trigger must not shrink the deadline
+    assert p.remaining() == 5
+    assert p.trips == 2
+
+
+def test_run_scraper_end_to_end_with_resume(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    urls = [f"https://x/{i}.html" for i in range(6)]
+    with open("yfin_urls.csv", "w") as f:
+        f.write("url\n" + "\n".join(urls) + "\n")
+    pages = {u: ARTICLE_HTML for u in urls}
+    pages[urls[2]] = NO_TITLE_HTML
+    cfg = _cfg(input_csv="yfin_urls.csv", out_dir=".")
+    rc = run_scraper(
+        cfg,
+        transport_factory=lambda: MockTransport(pages),
+        with_tpu_backend=True,
+        show_stats=False,
+    )
+    assert rc == 0
+    ok = read_url_column("success_articles_yfin.csv")
+    assert len(ok) == 5
+    # dedup annotations: first article kept, later identical ones near-dups
+    ann = read_url_column("dedup_annotations_yfin.csv", column="near_dup_of")
+    assert sum(1 for a in ann if a) >= 3  # same fixture page → near-dups
+    # resume: rerun touches nothing new
+    rc = run_scraper(
+        cfg,
+        transport_factory=lambda: MockTransport(pages),
+        with_tpu_backend=False,
+        show_stats=False,
+    )
+    assert rc == 0
+    assert len(read_url_column("success_articles_yfin.csv")) == 5  # unchanged
+
+
+def test_make_transport_auto_falls_back_to_requests():
+    t = make_transport("auto")
+    assert type(t).__name__ == "RequestsTransport"  # selenium absent in env
+    t.close()
+    with pytest.raises(ValueError):
+        make_transport("bogus")
+
+
+def test_mock_transport_unknown_url_raises():
+    t = MockTransport({})
+    with pytest.raises(FetchError):
+        t.fetch("https://nope")
+
+
+def test_rate_limit_sentinel_does_not_stall_result_loop(tmp_path):
+    """A sentinel-consumed URL must count toward loop termination (no
+    spurious result-timeout stall)."""
+    ok, bad = str(tmp_path / "ok.csv"), str(tmp_path / "bad.csv")
+    pages = {"https://x/limited.html": RATE_LIMIT_HTML}
+    cfg = _cfg(rate_limit_wait=0.1, result_timeout=30.0)
+    eng, _ = _engine(pages, cfg)
+    t0 = time.monotonic()
+    s = eng.run(list(pages), ok, bad)
+    assert time.monotonic() - t0 < 10  # must not wait out result_timeout
+    assert s.rate_limited_skipped == 1
+    assert s.errors == []
+
+
+def test_mock_transport_error_does_not_trip_rate_limit(tmp_path):
+    """Missing fixtures are plain failures, not rate-limit fingerprints."""
+    ok, bad = str(tmp_path / "ok.csv"), str(tmp_path / "bad.csv")
+    eng, _ = _engine({}, _cfg())
+    s = eng.run(["https://x/missing.html"], ok, bad)
+    assert s.failed == 1 and s.rate_limit_trips == 0
