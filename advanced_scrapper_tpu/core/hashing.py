@@ -89,6 +89,22 @@ def make_params(
     )
 
 
+def gram_hashes_np(raw: bytes, q: int) -> np.ndarray:
+    """numpy mirror of ``ops.shingle.shingle_hash`` for host-side name
+    hashing: uint32[len(raw)-q+1] (empty when the text is shorter than q).
+    Must stay bit-identical to the device kernel — the match screen gathers
+    device-built bitmaps at these indices."""
+    if len(raw) < q:
+        return np.zeros((0,), np.uint32)
+    b = np.frombuffer(raw, dtype=np.uint8).astype(np.uint32)
+    n = len(raw) - q + 1
+    h = np.full(n, 0x811C9DC5, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        for j in range(q):
+            h = (h ^ b[j : j + n]) * np.uint32(0x01000193)
+    return fmix32_np(h)
+
+
 def fmix32_np(h: np.ndarray) -> np.ndarray:
     """murmur3 32-bit finaliser (numpy mirror of ops.shingle.fmix32)."""
     h = h.astype(np.uint32)

@@ -1,0 +1,88 @@
+"""ctypes loader for the C++ host kernels (``native/fastmatch.cpp``).
+
+The library is compiled on demand with g++ (once per source change — the
+.so is cached next to the source with an mtime check) and falls back to the
+pure-Python oracle in ``cpu/fuzz.py`` when no compiler is available, so the
+framework stays importable everywhere.  Use :func:`partial_ratio` /
+:func:`ratio`; :data:`BACKEND` reports which implementation is live.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native", "fastmatch.cpp")
+_LIB = os.path.join(os.path.dirname(_SRC), "libfastmatch.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+BACKEND = "unloaded"
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, BACKEND
+    with _lock:
+        if BACKEND != "unloaded":
+            return _lib
+        needs_build = (not os.path.exists(_LIB)) or (
+            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        )
+        if needs_build and not _build():
+            BACKEND = "python"
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            BACKEND = "python"
+            return None
+        lib.fm_ratio.restype = ctypes.c_double
+        lib.fm_ratio.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.fm_partial_ratio.restype = ctypes.c_double
+        lib.fm_partial_ratio.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ]
+        _lib = lib
+        BACKEND = "native"
+        return lib
+
+
+def _enc(s: str | bytes) -> bytes:
+    return s if isinstance(s, bytes) else s.encode("utf-8", "replace")
+
+
+def ratio(s1: str | bytes, s2: str | bytes) -> float:
+    lib = _load()
+    a, b = _enc(s1), _enc(s2)
+    if lib is not None:
+        return lib.fm_ratio(a, len(a), b, len(b))
+    from advanced_scrapper_tpu.cpu import fuzz
+
+    return fuzz.ratio(a.decode("utf-8", "replace"), b.decode("utf-8", "replace"))
+
+
+def partial_ratio(s1: str | bytes, s2: str | bytes) -> float:
+    lib = _load()
+    a, b = _enc(s1), _enc(s2)
+    if lib is not None:
+        return lib.fm_partial_ratio(a, len(a), b, len(b))
+    from advanced_scrapper_tpu.cpu import fuzz
+
+    return fuzz.partial_ratio(a.decode("utf-8", "replace"), b.decode("utf-8", "replace"))

@@ -1,0 +1,133 @@
+// fastmatch: host-side exact verification kernels behind the TPU match screen.
+//
+// The reference leans on rapidfuzz (a C++ pip extension) for
+// fuzz.partial_ratio (match_keywords.py:4,175-176).  rapidfuzz is not
+// available in this environment, so this library provides the same
+// semantics natively (and `cpu/fuzz.py` is the pure-Python oracle it is
+// tested against):
+//
+//   ratio(s1, s2)        = 100 * (1 - indel_dist / (|s1|+|s2|))
+//                          with indel_dist = |s1|+|s2| - 2*LCS
+//   partial_ratio(s1,s2) = max over sliding windows of the shorter string's
+//                          length across the longer (including overhanging
+//                          partial windows at both ends)
+//
+// LCS length uses the Crochemore/Hyyrö bit-parallel recurrence
+//   V = (V + (V & M)) | (V & ~M)
+// over 64-bit words (multi-word with carry for patterns > 64 bytes);
+// LCS = zero bits of V within the pattern length.  Complexity per call:
+// O(windows * |window| * ceil(m/64)) — microseconds for typical entity
+// names against full articles.
+//
+// Build: g++ -O3 -shared -fPIC fastmatch.cpp -o libfastmatch.so
+// (driven automatically by cpu/native.py)
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct PatternMasks {
+  int m;
+  int words;
+  // 256 characters x words bitmask table
+  std::vector<uint64_t> table;
+
+  explicit PatternMasks(const uint8_t* p, int len) : m(len), words((len + 63) / 64) {
+    table.assign(256 * (size_t)words, 0);
+    for (int i = 0; i < len; ++i) {
+      table[(size_t)p[i] * words + (i >> 6)] |= 1ULL << (i & 63);
+    }
+  }
+};
+
+// LCS length of the pattern (via masks) against text[0..tlen)
+int lcs_len(const PatternMasks& pm, const uint8_t* text, int tlen) {
+  const int words = pm.words;
+  uint64_t vbuf[8];
+  std::vector<uint64_t> vheap;
+  uint64_t* V = vbuf;
+  if (words > 8) {
+    vheap.assign(words, ~0ULL);
+    V = vheap.data();
+  } else {
+    for (int w = 0; w < words; ++w) vbuf[w] = ~0ULL;
+  }
+  for (int j = 0; j < tlen; ++j) {
+    const uint64_t* M = &pm.table[(size_t)text[j] * words];
+    uint64_t carry = 0;
+    for (int w = 0; w < words; ++w) {
+      const uint64_t u = V[w] & M[w];
+      const uint64_t sum = V[w] + u + carry;
+      carry = (sum < V[w] || (carry && sum == V[w])) ? 1 : 0;
+      V[w] = sum | (V[w] & ~M[w]);
+    }
+  }
+  // LCS = zero bits within the first m positions
+  int zeros = 0;
+  for (int w = 0; w < words; ++w) {
+    uint64_t mask = ~0ULL;
+    const int remaining = pm.m - (w << 6);
+    if (remaining < 64) mask = (remaining <= 0) ? 0 : ((1ULL << remaining) - 1);
+    zeros += __builtin_popcountll(~V[w] & mask);
+  }
+  return zeros;
+}
+
+inline double indel_ratio(int m, int w, int lcs) {
+  const int total = m + w;
+  if (total == 0) return 100.0;
+  return 200.0 * (double)lcs / (double)total;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Normalised indel similarity in [0, 100].
+double fm_ratio(const uint8_t* s1, int len1, const uint8_t* s2, int len2) {
+  if (len1 + len2 == 0) return 100.0;
+  if (len1 == 0 || len2 == 0) return 0.0;
+  PatternMasks pm(s1, len1);
+  const int lcs = lcs_len(pm, s2, len2);
+  return indel_ratio(len1, len2, lcs);
+}
+
+// Sliding-window partial ratio (rapidfuzz semantics; see header comment).
+double fm_partial_ratio(const uint8_t* s1, int len1, const uint8_t* s2, int len2) {
+  const uint8_t* shorter = s1;
+  const uint8_t* longer = s2;
+  int m = len1, n = len2;
+  if (len1 > len2) {
+    shorter = s2; longer = s1; m = len2; n = len1;
+  }
+  if (m == 0) return 100.0;
+  PatternMasks pm(shorter, m);
+  double best = 0.0;
+  for (int start = -(m - 1); start < n; ++start) {
+    const int lo = start > 0 ? start : 0;
+    const int hi = (start + m) < n ? (start + m) : n;
+    if (hi <= lo) continue;
+    const int lcs = lcs_len(pm, longer + lo, hi - lo);
+    const double sc = indel_ratio(m, hi - lo, lcs);
+    if (sc > best) {
+      best = sc;
+      if (best >= 100.0) break;
+    }
+  }
+  return best;
+}
+
+// Batch: one needle against many haystacks (offsets into a byte arena).
+// Scores must point at n doubles.
+void fm_partial_ratio_batch(
+    const uint8_t* needle, int needle_len,
+    const uint8_t* arena, const int64_t* offsets, const int32_t* lengths,
+    int n, double* scores) {
+  for (int i = 0; i < n; ++i) {
+    scores[i] = fm_partial_ratio(needle, needle_len, arena + offsets[i], lengths[i]);
+  }
+}
+
+}  // extern "C"

@@ -20,6 +20,7 @@ exceptions (``constant_rate_scrapper.py:190-193``).
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Callable, Mapping
 
@@ -129,12 +130,16 @@ class SeleniumTransport:
 
 
 def selenium_available() -> bool:
+    """True only when the whole stack exists: the selenium package AND a
+    geckodriver binary (the external WebDriver shim the reference ships,
+    ``.MISSING_LARGE_BLOBS:1-2``)."""
+    import shutil
+
     try:
         import selenium  # noqa: F401
-
-        return True
     except ImportError:
         return False
+    return shutil.which("geckodriver") is not None or os.path.exists("geckodriver")
 
 
 def make_transport(
@@ -151,7 +156,16 @@ def make_transport(
     requests uses ``page_load_timeout`` as its request timeout.
     """
     if name == "auto":
-        name = "selenium" if selenium_available() else "requests"
+        if selenium_available():
+            try:
+                return SeleniumTransport(
+                    page_load_timeout=page_load_timeout,
+                    ready_state_timeout=ready_state_timeout,
+                    **kw,
+                )
+            except Exception:
+                pass  # broken browser stack → HTTP fallback, as documented
+        name = "requests"
     if name == "selenium":
         return SeleniumTransport(
             page_load_timeout=page_load_timeout,

@@ -1,0 +1,108 @@
+"""TPU q-gram screen for entity→article matching.
+
+``match_keywords.py:159-180`` scans O(articles × tickers × names) strings on
+CPU — regex word-boundary for ALL-CAPS names, ``rapidfuzz.partial_ratio >
+95`` otherwise.  The TPU rerouting keeps the *decisions* on the host (so
+CSV outputs stay byte-identical) but eliminates almost all of the quadratic
+scanning with a device-side **no-false-negative screen**:
+
+1. each article's q-gram set is hashed into a 2¹⁵-bit bitmap on device
+   (one scatter per gram position);
+2. each entity name's q-gram hash indices are gathered from every article's
+   bitmap; an (article, name) pair survives only if enough name-grams are
+   present.
+
+Soundness thresholds (why the screen can't drop a true match):
+
+- **exact/ALL-CAPS path**: a regex word-boundary hit implies the name is a
+  substring, so ALL its ``m-q+1`` grams appear in the article → require all.
+- **fuzzy path**: ``partial_ratio(article, name) > 95`` means some window
+  ``w`` (``|w| ≤ m``) has indel distance ``d < 0.05·(m+|w|) ≤ 0.1·m``.
+  One indel edit destroys at most q of the name's grams (q-gram lemma), so
+  at least ``(m-q+1) - q·⌊0.1·m⌋`` name-grams must appear → require that.
+
+Bloom collisions and window-vs-whole-article relaxation only ADD candidates
+(false positives are later killed by exact host verification); they never
+remove true ones.  Names too short to carry grams are always candidates.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from advanced_scrapper_tpu.core.hashing import gram_hashes_np
+from advanced_scrapper_tpu.ops.shingle import shingle_hash
+
+NBITS = 1 << 15
+DEFAULT_Q = 3
+
+
+def prepare_names(
+    names: list[bytes], q: int = DEFAULT_Q, *, fuzzy: np.ndarray | None = None,
+    nbits: int = NBITS, max_grams: int = 96,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side: names → (gram bit indices int32[N, max_grams] padded -1,
+    required counts int32[N]).
+
+    ``fuzzy[i]`` selects the fuzzy threshold for name i (else exact/all
+    grams).  Names with no grams get required=0 → always candidates.
+    """
+    n = len(names)
+    fuzzy = np.zeros(n, bool) if fuzzy is None else np.asarray(fuzzy, bool)
+    grams = np.full((n, max_grams), -1, dtype=np.int32)
+    required = np.zeros(n, dtype=np.int32)
+    for i, raw in enumerate(names):
+        h = gram_hashes_np(raw, q)
+        g = (h % nbits).astype(np.int32)[:max_grams]
+        grams[i, : len(g)] = g
+        m = len(raw)
+        total = max(0, m - q + 1)
+        if total == 0:
+            required[i] = 0
+        elif fuzzy[i]:
+            # q-gram lemma bound for ratio > 95 (see module docstring)
+            required[i] = max(1, min(len(g), total - q * int(0.1 * m)))
+        else:
+            required[i] = len(g)  # substring ⇒ every (kept) gram present
+    return grams, required
+
+
+@partial(jax.jit, static_argnames=("nbits", "q"))
+def _screen_impl(tokens, lengths, name_grams, name_required, *, nbits: int, q: int):
+    h, valid = shingle_hash(tokens, lengths, q)
+    idx = jnp.where(valid, (h % jnp.uint32(nbits)).astype(jnp.int32), nbits)
+    B = tokens.shape[0]
+    bitmap = jnp.zeros((B, nbits), dtype=bool)
+    bitmap = jax.vmap(lambda bm, ix: bm.at[ix].set(True, mode="drop"))(bitmap, idx)
+    # gather name gram bits from every article's bitmap: [B, N, G]
+    safe = jnp.maximum(name_grams, 0)
+    present = jax.vmap(lambda bm: bm[safe])(bitmap)
+    present = present & (name_grams >= 0)[None, :, :]
+    counts = present.sum(axis=-1).astype(jnp.int32)
+    return counts >= name_required[None, :]
+
+
+def match_screen(
+    tokens: np.ndarray,
+    lengths: np.ndarray,
+    name_grams: np.ndarray,
+    name_required: np.ndarray,
+    *,
+    nbits: int = NBITS,
+    q: int = DEFAULT_Q,
+) -> np.ndarray:
+    """``bool[B, N]`` — True where (article, name) survives the screen."""
+    return np.asarray(
+        _screen_impl(
+            tokens,
+            lengths,
+            jnp.asarray(name_grams),
+            jnp.asarray(name_required),
+            nbits=nbits,
+            q=q,
+        )
+    )

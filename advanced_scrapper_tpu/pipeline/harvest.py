@@ -84,17 +84,21 @@ def parse_cdx_text(text: str) -> pd.DataFrame:
 def process_shard(prefix: str, transport, cfg: HarvestConfig) -> str | None:
     """Fetch one CDX shard, persist raw text + normalised CSV (ref :38-82)."""
     url = cdx_query_url(prefix, cfg)
+    txt_path = os.path.join(cfg.shard_dir, f"yahoo_{prefix}.txt")
     try:
         page = transport.fetch(url)
         text = BeautifulSoup(page, "html.parser").get_text(separator="\n", strip=True)
-        txt_path = os.path.join(cfg.shard_dir, f"yahoo_{prefix}.txt")
+        csv_path = None
+        if text.strip():
+            df = normalize_cdx_frame(parse_cdx_text(text))
+            csv_path = os.path.join(cfg.shard_dir, f"yahoo_{prefix}.csv")
+            df.to_csv(csv_path, index=False)
+        # the .txt is the resume checkpoint (shard_prefixes skips on it), so
+        # it must be written only once the shard fully succeeded — the
+        # reference writes it first (:52-54) and silently loses shards whose
+        # parse then fails; checkpoint-last fixes that
         with open(txt_path, "w", encoding="utf-8") as f:
             f.write(text)
-        if not text.strip():
-            return None
-        df = normalize_cdx_frame(parse_cdx_text(text))
-        csv_path = os.path.join(cfg.shard_dir, f"yahoo_{prefix}.csv")
-        df.to_csv(csv_path, index=False)
         return csv_path
     except Exception as e:
         print(f"Error scraping {url}: {e}")
@@ -144,9 +148,11 @@ def run_harvest(
     os.makedirs(cfg.shard_dir, exist_ok=True)
     prefixes = shard_prefixes(cfg.shard_dir)
     if prefixes:
+        owns_transports = True  # workers close only transports they created
         if transport_factory is None:
             if transport is not None:
                 shared = transport
+                owns_transports = False  # caller-owned: never close it here
                 transport_factory = lambda: shared  # noqa: E731
             else:
                 from advanced_scrapper_tpu.net.transport import make_transport
@@ -162,10 +168,11 @@ def run_harvest(
                 for p in batch:
                     process_shard(p, t, cfg)
             finally:
-                try:
-                    t.close()
-                except Exception:
-                    pass
+                if owns_transports:
+                    try:
+                        t.close()
+                    except Exception:
+                        pass
 
         n = max(1, cfg.num_workers)
         batches = [prefixes[i::n] for i in range(n)]

@@ -1,0 +1,396 @@
+"""L5: entity→article matching, rerouted through the TPU q-gram screen.
+
+Re-implements ``match_keywords.py`` end to end:
+
+- **entity loading** (ref ``:40-120``): every ``info/*.json`` (utf-8 → gbk →
+  latin1 fallback chain), company filter ``(len >= 2 and 'United States' in
+  country) or len <= 1``, and ``"Name (Start: …) (End: …)"`` suffix parsing
+  into per-name date windows;
+- **match rules** (ref ``:159-180``), byte-identical decisions:
+  - ALL-CAPS names of length > 1 → ``\\b re.escape(name) \\b`` positions in
+    article text and title;
+  - names that are not pure-lowercase-alphabetic → fuzzy
+    ``partial_ratio(text, name) > 95`` (native C++ kernel, rapidfuzz
+    semantics), positions via un-escaped ``re.finditer`` like the ref;
+  - everything else is skipped entirely;
+  - a name only counts when the article date is inside its window
+    (``is_within_period``, naive datetimes promoted to UTC, ref ``:17-37``);
+- **outputs** (ref ``:128-146,195-217``): per-ticker
+  ``{source}_ticker_matched_articles/{ticker}_match.csv`` rows with
+  JSON-encoded match-position dicts, then a final per-file sort by
+  ``time_unix``.
+
+The TPU reroute: instead of scanning every (article × name) pair on the
+host (the reference's quadratic hot loop), a device q-gram screen
+(``ops/match.py``) prunes pairs first; only survivors are verified with the
+exact host rules above, so outputs cannot differ — golden-tested against a
+pure reference implementation.
+
+Documented divergences from the reference (both are reference *crashes*):
+- a fuzzy-matched name that is itself an invalid regex falls back to
+  escaped-literal position search (the ref raises ``re.error`` mid-chunk);
+- matched articles whose ``date_time`` cannot be parsed are skipped with a
+  warning (the ref raises inside ``append_to_csv``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass
+from datetime import datetime
+from typing import Iterable
+
+import numpy as np
+import pandas as pd
+from dateutil import parser as dateparser
+from dateutil.tz import tzutc
+
+from advanced_scrapper_tpu.config import MatchConfig
+from advanced_scrapper_tpu.cpu import native
+from advanced_scrapper_tpu.ops.match import match_screen, prepare_names
+
+ATTRIBUTES = (
+    "id_label",
+    "ticker",
+    "aliases",
+    "products",
+    "subsidiaries",
+    "owned_entities",
+    "ceos",
+    "board_members",
+)  # ref :76-85
+
+OUTPUT_FIELDS = [
+    "time_unix",
+    "date_time",
+    "text_matches",
+    "title_matches",
+    "title",
+    "url",
+    "source",
+    "source_url",
+    "article_text",
+]  # ref :134-144
+
+
+# -- reference parsing helpers ---------------------------------------------
+
+
+def is_within_period(article_date, start_date, end_date) -> bool:
+    """Date-window gate (ref :17-37); naive datetimes are promoted to UTC."""
+    if article_date is None:
+        return False
+    if article_date.tzinfo is None:
+        article_date = article_date.replace(tzinfo=tzutc())
+    if start_date is not None and start_date.tzinfo is None:
+        start_date = start_date.replace(tzinfo=tzutc())
+    if end_date is not None and end_date.tzinfo is None:
+        end_date = end_date.replace(tzinfo=tzutc())
+    if start_date and end_date:
+        return start_date <= article_date <= end_date
+    if start_date:
+        return start_date <= article_date
+    if end_date:
+        return article_date <= end_date
+    return True
+
+
+def extract_time_periods(names) -> dict[str, tuple]:
+    """``"Name (Start: …) (End: …)"`` → {name: (start, end)} (ref :40-65)."""
+    periods: dict[str, tuple] = {}
+    if isinstance(names, str):
+        names = [names]
+    for info in names:
+        parts = info.split(" (")
+        name = parts[0].strip()
+        start = end = None
+        for part in parts[1:]:
+            if "Start:" in part:
+                raw = part.replace("Start:", "").replace("T00:00:00Z)", "").strip()
+                try:
+                    start = dateparser.parse(raw)
+                except (ValueError, dateparser.ParserError):
+                    start = None
+            elif "End:" in part:
+                raw = part.replace("End:", "").replace("T00:00:00Z)", "").strip()
+                try:
+                    end = dateparser.parse(raw)
+                except (ValueError, dateparser.ParserError):
+                    end = None
+        periods[name] = (start, end)
+    return periods
+
+
+def process_json_data(json_data: list) -> dict:
+    """US-company filter + per-attribute period maps (ref :68-87)."""
+    result = {}
+    for company in json_data:
+        if (len(json_data) >= 2 and "United States" in company.get("country", [])) or len(
+            json_data
+        ) <= 1:
+            ticker = company["ticker"]
+            result[ticker] = {
+                attr: extract_time_periods(company.get(attr, [])) for attr in ATTRIBUTES
+            }
+    return result
+
+
+def read_info_dir(folder: str) -> dict:
+    """Load every info JSON with the encoding fallback chain (ref :90-120)."""
+    out: dict = {}
+    for filename in sorted(os.listdir(folder)):
+        if not filename.endswith(".json"):
+            continue
+        path = os.path.join(folder, filename)
+        data = None
+        for enc in ("utf-8", "gbk", "latin1"):
+            try:
+                with open(path, "r", encoding=enc) as f:
+                    data = json.load(f)
+                break
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                continue
+        if data is None:
+            print(f"could not read {filename}")
+            continue
+        out.update(process_json_data(data))
+    return out
+
+
+# -- flattened entity index (screen-ready) ----------------------------------
+
+
+@dataclass(frozen=True)
+class NameEntry:
+    ticker: str
+    attribute: str
+    name: str
+    start: object
+    end: object
+    is_exact_upper: bool  # ALL-CAPS word-boundary path
+    # (fuzzy otherwise; unreachable names are never stored)
+
+
+class EntityIndex:
+    """Flat, screen-ready view of the processed entity data."""
+
+    def __init__(self, processed: dict):
+        self.processed = processed
+        self.entries: list[NameEntry] = []
+        for ticker, attrs in processed.items():
+            for attribute, names in attrs.items():
+                for name, (start, end) in names.items():
+                    if name.isupper():
+                        if len(name) > 1:
+                            self.entries.append(
+                                NameEntry(ticker, attribute, name, start, end, True)
+                            )
+                        # single-char upper names never match (ref :166)
+                    elif not (name.islower() and name.replace(" ", "").isalpha()):
+                        self.entries.append(
+                            NameEntry(ticker, attribute, name, start, end, False)
+                        )
+                    # pure-lowercase-alpha names are skipped (ref :174)
+        self._grams = None
+        self._required = None
+
+    @classmethod
+    def from_info_dir(cls, folder: str) -> "EntityIndex":
+        return cls(read_info_dir(folder))
+
+    def screen_tables(self):
+        if self._grams is None:
+            names = [e.name.encode("utf-8", "replace") for e in self.entries]
+            fuzzy = np.array([not e.is_exact_upper for e in self.entries], bool)
+            self._grams, self._required = prepare_names(names, fuzzy=fuzzy)
+        return self._grams, self._required
+
+
+# -- matching ----------------------------------------------------------------
+
+
+def _find_positions(pattern: str, text: str) -> list[int]:
+    return [m.start() for m in re.finditer(pattern, text)]
+
+
+def _find_positions_literal_fallback(name: str, text: str) -> list[int]:
+    try:
+        return _find_positions(name, text)
+    except re.error:
+        return _find_positions(re.escape(name), text)
+
+
+def match_article(
+    text: str,
+    title: str,
+    article_date,
+    index: EntityIndex,
+    candidate_mask: np.ndarray | None = None,
+    threshold: float = 95.0,
+) -> dict:
+    """Exact match rules for one article → {ticker: {'text': …, 'title': …}}.
+
+    ``candidate_mask[j]`` (from the TPU screen) prunes name j; None means
+    scan everything (the pure reference path used for goldens).
+    """
+    per_ticker: dict[str, dict] = {}
+
+    def slot(ticker: str) -> dict:
+        return per_ticker.setdefault(ticker, {"text": {}, "title": {}})
+
+    for j, e in enumerate(index.entries):
+        if candidate_mask is not None and not candidate_mask[j]:
+            continue
+        if not is_within_period(article_date, e.start, e.end):
+            continue
+        if e.is_exact_upper:
+            # positions are the decision (ref :165-173)
+            pattern = r"\b" + re.escape(e.name) + r"\b"
+            text_pos = _find_positions(pattern, text)
+            title_pos = _find_positions(pattern, title)
+            if text_pos:
+                slot(e.ticker)["text"][e.name] = text_pos
+            if title_pos:
+                slot(e.ticker)["title"][e.name] = title_pos
+        else:
+            # the score is the decision; positions recorded even if empty
+            # (ref :174-180)
+            if native.partial_ratio(text, e.name) > threshold:
+                slot(e.ticker)["text"][e.name] = _find_positions_literal_fallback(
+                    e.name, text
+                )
+            if native.partial_ratio(title, e.name) > threshold:
+                slot(e.ticker)["title"][e.name] = _find_positions_literal_fallback(
+                    e.name, title
+                )
+    return {t: v for t, v in per_ticker.items() if v["text"] or v["title"]}
+
+
+def _get_col(row, *candidates, default=""):
+    for c in candidates:
+        if c in row and pd.notna(row[c]):
+            return str(row[c])
+    return default
+
+
+def match_chunk(
+    chunk: pd.DataFrame,
+    index: EntityIndex,
+    *,
+    use_screen: bool = True,
+    screen_batch: int = 128,
+    screen_block: int = 1 << 16,
+    threshold: float = 95.0,
+) -> list[tuple[str, dict, dict]]:
+    """Match a frame of articles → [(ticker, matches, row_record), …].
+
+    Accepts both the reference dataset schema (``article_text``/``date_time``)
+    and this framework's scraper schema (``article``/``datetime``).
+    """
+    from advanced_scrapper_tpu.core.tokenizer import encode_batch
+
+    rows = []
+    for _, row in chunk.iterrows():
+        text = _get_col(row, "article_text", "article")
+        title = _get_col(row, "title")
+        raw_date = _get_col(row, "date_time", "datetime", default="")
+        try:
+            adate = dateparser.parse(raw_date) if raw_date else None
+        except (ValueError, OverflowError, dateparser.ParserError):
+            adate = None
+        rows.append((text, title, adate, row))
+
+    masks: list[np.ndarray | None] = [None] * len(rows)
+    if use_screen and index.entries:
+        grams, required = index.screen_tables()
+        for start in range(0, len(rows), screen_batch):
+            batch = rows[start : start + screen_batch]
+            # screen over title+text so title-only matches can't be pruned
+            raw = [
+                (title + "\n" + text).encode("utf-8", "replace")
+                for text, title, _, _ in batch
+            ]
+            overlong = [len(r) > screen_block for r in raw]
+            tok, ln = encode_batch(raw, block_len=screen_block)
+            got = match_screen(tok, ln, grams, required)
+            for i in range(len(batch)):
+                # articles longer than the screen block fall back to full scan
+                masks[start + i] = None if overlong[i] else got[i]
+
+    out = []
+    for (text, title, adate, row), mask in zip(rows, masks):
+        matches = match_article(text, title, adate, index, mask, threshold)
+        for ticker, m in matches.items():
+            out.append((ticker, m, row))
+    return out
+
+
+# -- output writing (ref :128-146, :195-217) --------------------------------
+
+
+def append_match(out_dir: str, ticker: str, matches: dict, row) -> bool:
+    raw_date = _get_col(row, "date_time", "datetime")
+    try:
+        ts = int(dateparser.parse(raw_date).timestamp())
+    except Exception:
+        print(f"skipping row with unparseable date_time: {raw_date!r}")
+        return False
+    record = {
+        "time_unix": ts,
+        "date_time": raw_date,
+        "text_matches": json.dumps(matches["text"]),
+        "title_matches": json.dumps(matches["title"]),
+        "title": _get_col(row, "title"),
+        "url": _get_col(row, "url"),
+        "source": _get_col(row, "source"),
+        "source_url": _get_col(row, "source_url"),
+        "article_text": _get_col(row, "article_text", "article"),
+    }
+    path = os.path.join(out_dir, f"{ticker}_match.csv")
+    header = not os.path.exists(path)
+    pd.DataFrame([record]).to_csv(path, mode="a", index=False, header=header)
+    return True
+
+
+def sort_matched_csv(path: str) -> None:
+    """Final per-file time sort (ref :195-217)."""
+    try:
+        df = pd.read_csv(path)
+        if "time_unix" not in df.columns:
+            df["date_time"] = df["date_time"].apply(dateparser.parse)
+            df["time_unix"] = df["date_time"].apply(lambda x: int(x.timestamp()))
+        df = df.sort_values("time_unix", ascending=True)
+        df["time_unix"] = df["time_unix"].astype(int)
+        df.to_csv(path, index=False)
+    except Exception as e:
+        print(f"Error processing {path}: {e}")
+
+
+def run_matcher(
+    cfg: MatchConfig,
+    *,
+    use_screen: bool | None = None,
+    articles_csv: str | None = None,
+) -> int:
+    """CLI entry: full matching run (ref ``__main__`` :220-246)."""
+    articles_csv = articles_csv or cfg.articles_csv
+    if not os.path.exists(articles_csv):
+        print(f"Articles CSV '{articles_csv}' not found.")
+        return 1
+    index = EntityIndex.from_info_dir(cfg.info_dir)
+    out_dir = f"{cfg.source_name}{cfg.out_dir_suffix}"
+    os.makedirs(out_dir, exist_ok=True)
+    use_screen = cfg.use_tpu if use_screen is None else use_screen
+    n_matches = 0
+    for chunk in pd.read_csv(articles_csv, chunksize=cfg.chunk_size):
+        for ticker, matches, row in match_chunk(
+            chunk, index, use_screen=use_screen, threshold=cfg.fuzzy_threshold
+        ):
+            if append_match(out_dir, ticker, matches, row):
+                n_matches += 1
+    for f in os.listdir(out_dir):
+        sort_matched_csv(os.path.join(out_dir, f))
+    print(f"Matching complete: {n_matches} ticker-article matches → {out_dir}/")
+    return 0

@@ -110,6 +110,9 @@ class ScraperEngine:
         self.cfg = cfg
         self.extractor = extractor
         self.transport_factory = transport_factory
+        # An unstarted mux would buffer one string per URL for the whole run;
+        # when the engine owns the console it runs (and stops) the consumer.
+        self._owns_console = console is None
         self.console = console or ConsoleMux()
         self.on_success = on_success
         self.sleep = sleep
@@ -218,6 +221,8 @@ class ScraperEngine:
         summary = ScrapeSummary(
             total_urls=len(urls), already_scraped=already_scraped
         )
+        if self._owns_console and not self.console.running:
+            self.console.start()
         initial_total = initial_total or len(urls)
         url_q: queue.Queue = queue.Queue()
         result_q: queue.Queue = queue.Queue()
@@ -251,9 +256,7 @@ class ScraperEngine:
                     summary.errors.append("result timeout")
                     break
                 if kind == "success":
-                    ok_csv.write_row(
-                        {f: data.get(f, "") for f in SUCCESS_FIELDS}
-                    )
+                    ok_csv.write_row(data)  # write_row fills missing fields
                     summary.succeeded += 1
                     processed += 1
                     if self.on_success is not None:
@@ -288,6 +291,8 @@ class ScraperEngine:
         feeder.join(timeout=5)
         for w in workers:
             w.join(timeout=5)
+        if self._owns_console:
+            self.console.stop()
         self.console.drain()
         return summary
 

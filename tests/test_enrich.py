@@ -1,0 +1,169 @@
+"""Wikidata enrichment tests with a fake SPARQL session (offline)."""
+
+import json
+import os
+import random
+
+import pytest
+
+from advanced_scrapper_tpu.config import EnrichConfig
+from advanced_scrapper_tpu.pipeline.enrich import (
+    EnrichClient,
+    build_queries,
+    empty_entry,
+    run_enrich,
+    zip_results,
+)
+
+
+def _binding(**fields):
+    return {k: {"value": v} for k, v in fields.items()}
+
+
+def _resp(ok=True, status=200, bindings=None):
+    class R:
+        def __init__(self):
+            self.ok = ok
+            self.status_code = status
+
+        def json(self):
+            return {"results": {"bindings": bindings or []}}
+
+    return R()
+
+
+class FakeSession:
+    """Scripted responses: pops from a queue, records queries."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.queries = []
+
+    def get(self, url, params=None, timeout=None):
+        self.queries.append(params["query"])
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+def test_build_queries_cover_reference_properties():
+    q1, q2, q3 = build_queries("aapl")
+    assert "P414" in q1 and "P249" in q1 and "'AAPL'" in q1
+    for prop in ("P452", "P17", "P1056"):
+        assert prop in q1
+    for prop in ("P355", "P1830", "P580", "P582"):
+        assert prop in q2
+    for prop in ("P169", "P3320", "P580", "P582"):
+        assert prop in q3
+    assert "| | |" in q1  # load-bearing separator
+
+
+def test_zip_results_hardened_semantics():
+    d1 = {"results": {"bindings": [
+        _binding(idLabels="Apple Inc.", ticker="AAPL",
+                 countries="United States| | |", aliases="Apple| | |AAPL",
+                 industries="technology", products="iPhone| | |iPad"),
+    ]}}
+    d2 = {"results": {"bindings": [
+        _binding(subsidiaries="Beats (Start: 2014-01-01T00:00:00Z)",
+                 ownedEntities=""),
+    ]}}
+    d3 = {"results": {"bindings": []}}  # shorter set → padded
+    out = zip_results(d1, d2, d3, "AAPL")
+    assert len(out) == 1
+    e = out[0]
+    assert e["id_label"] == "Apple Inc." and e["ticker"] == "AAPL"
+    assert e["country"] == ["United States"]        # empty tail dropped
+    assert e["aliases"] == ["Apple", "AAPL"]
+    assert e["subsidiaries"] == ["Beats (Start: 2014-01-01T00:00:00Z)"]
+    assert e["owned_entities"] == [] and e["ceos"] == []
+
+
+def test_zip_results_empty_placeholder():
+    empty = {"results": {"bindings": []}}
+    out = zip_results(empty, empty, empty, "ZZZZ")
+    assert out == [empty_entry("ZZZZ")]
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(
+        out_dir=str(tmp_path / "info"),
+        progress_file=str(tmp_path / "progress.json"),
+        base_delay=0.0,
+        max_retries=3,
+    )
+    base.update(kw)
+    return EnrichConfig(**base)
+
+
+def test_query_symbol_success_writes_json(tmp_path):
+    ok3 = [
+        _resp(bindings=[_binding(idLabels="Apple Inc.", ticker="AAPL")]),
+        _resp(bindings=[_binding(subsidiaries="Beats")]),
+        _resp(bindings=[_binding(ceosWithTerms="Tim Cook (Start: 2011-08-24T00:00:00Z)")]),
+    ]
+    sess = FakeSession(ok3)
+    cli = EnrichClient(_cfg(tmp_path), session=sess, sleep=lambda s: None, rng=random.Random(0))
+    assert cli.query_symbol("AAPL")
+    data = json.load(open(tmp_path / "info" / "AAPL_info.json"))
+    assert data[0]["ceos"] == ["Tim Cook (Start: 2011-08-24T00:00:00Z)"]
+
+
+def test_query_symbol_429_escalation_then_success(tmp_path):
+    sleeps = []
+    script = [
+        _resp(ok=False, status=429), _resp(ok=False, status=429), _resp(ok=False, status=429),
+        _resp(bindings=[]), _resp(bindings=[]), _resp(bindings=[]),
+    ]
+    cli = EnrichClient(
+        _cfg(tmp_path, base_delay=1.0),
+        session=FakeSession(script),
+        sleep=sleeps.append,
+        rng=random.Random(0),
+    )
+    assert cli.query_symbol("MSFT")
+    # attempt 0 hit 429 → one backoff sleep of base*3^0 + U(10,20) ∈ [11, 21]
+    backoffs = [s for s in sleeps if s >= 10]
+    assert len(backoffs) == 1 and 11 <= backoffs[0] <= 21
+    # placeholder entry persisted
+    data = json.load(open(tmp_path / "info" / "MSFT_info.json"))
+    assert data[0]["ticker"] == "MSFT"
+
+
+def test_query_symbol_exhausts_retries(tmp_path):
+    import requests
+
+    script = [requests.ConnectionError("boom")] * 3
+    cli = EnrichClient(
+        _cfg(tmp_path, max_retries=3),
+        session=FakeSession(script),
+        sleep=lambda s: None,
+        rng=random.Random(0),
+    )
+    assert not cli.query_symbol("FAIL")
+    assert not os.path.exists(tmp_path / "info" / "FAIL_info.json")
+
+
+def test_run_enrich_ledger_resume_and_cooldowns(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    symbols = [f"S{i}" for i in range(12)]
+    # every symbol: 3 OK responses
+    script = [_resp(bindings=[]) for _ in range(3 * 12 + 99)]
+    sleeps = []
+    cfg = _cfg(tmp_path)
+    rc = run_enrich(cfg, session=FakeSession(script), sleep=sleeps.append,
+                    rng=random.Random(1), symbols=symbols)
+    assert rc == 0
+    assert len(os.listdir(cfg.out_dir)) == 12
+    led = json.load(open(cfg.progress_file))
+    assert sorted(led["processed"]) == sorted(symbols)
+    # cool-downs fired: every 10 → [60,120], every 3 (not multiple of 10) → [15,25]
+    big = [s for s in sleeps if 60 <= s <= 120]
+    mid = [s for s in sleeps if 15 <= s <= 25]
+    assert len(big) == 1 and len(mid) == 4  # big at done=10; mid at 3,6,9,12
+    # resume: second run touches nothing
+    sess2 = FakeSession([])
+    rc = run_enrich(cfg, session=sess2, sleep=lambda s: None,
+                    rng=random.Random(1), symbols=symbols)
+    assert rc == 0 and sess2.queries == []

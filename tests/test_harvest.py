@@ -106,3 +106,34 @@ def test_merge_shards_empty_dir(tmp_path):
     cfg = HarvestConfig(shard_dir=str(tmp_path / "none"), output_csv=str(tmp_path / "o.csv"))
     os.makedirs(cfg.shard_dir)
     assert merge_shards(cfg) == 0
+
+
+def test_failed_shard_leaves_no_checkpoint(tmp_path):
+    """A shard whose parse fails must NOT be checkpointed (retried later)."""
+    from advanced_scrapper_tpu.pipeline.harvest import process_shard
+
+    cfg = HarvestConfig(shard_dir=str(tmp_path))
+
+    class BoomTransport:
+        def fetch(self, url):
+            raise RuntimeError("boom")
+
+    assert process_shard("aa", BoomTransport(), cfg) is None
+    assert os.listdir(tmp_path) == []  # no .txt → shard_prefixes retries it
+
+
+def test_shared_transport_not_closed_by_workers(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+
+    class ClosableMock(MockTransport):
+        def __init__(self):
+            super().__init__(lambda u: "")
+            self.closed = 0
+
+        def close(self):
+            self.closed += 1
+
+    t = ClosableMock()
+    cfg = HarvestConfig(shard_dir="s", output_csv="o.csv", num_workers=4)
+    run_harvest(cfg, transport=t)
+    assert t.closed == 0  # caller-owned transport must survive the sweep

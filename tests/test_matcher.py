@@ -1,0 +1,226 @@
+"""Entity→article matcher tests: reference parsing semantics, match rules,
+and the screened-vs-unscreened byte-identical golden."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from advanced_scrapper_tpu.config import MatchConfig
+from advanced_scrapper_tpu.cpu import native
+from advanced_scrapper_tpu.pipeline.matcher import (
+    EntityIndex,
+    extract_time_periods,
+    is_within_period,
+    match_article,
+    match_chunk,
+    process_json_data,
+    read_info_dir,
+    run_matcher,
+)
+from dateutil import parser as dateparser
+
+
+def _entity(ticker="AAPL", **over):
+    base = {
+        "id_label": "Apple Inc.",
+        "ticker": ticker,
+        "country": ["United States"],
+        "industry": ["technology"],
+        "aliases": ["AAPL", "Apple"],
+        "products": ["iPhone", "iPad Pro"],
+        "subsidiaries": ["Beats Electronics (Start: 2014-08-01T00:00:00Z)"],
+        "owned_entities": [],
+        "ceos": [
+            "Tim Cook (Start: 2011-08-24T00:00:00Z)",
+            "Steve Jobs (Start: 1997-09-16T00:00:00Z) (End: 2011-08-24T00:00:00Z)",
+        ],
+        "board_members": [],
+    }
+    base.update(over)
+    return base
+
+
+def test_extract_time_periods_parsing():
+    p = extract_time_periods(
+        ["Tim Cook (Start: 2011-08-24T00:00:00Z)",
+         "Steve Jobs (Start: 1997-09-16T00:00:00Z) (End: 2011-08-24T00:00:00Z)",
+         "No Dates Co"]
+    )
+    assert p["Tim Cook"][0].year == 2011 and p["Tim Cook"][1] is None
+    assert p["Steve Jobs"][1].year == 2011
+    assert p["No Dates Co"] == (None, None)
+    # string input treated as single name (ref :42-43)
+    assert "Apple Inc." in extract_time_periods("Apple Inc.")
+
+
+def test_is_within_period_rules():
+    d = dateparser.parse("2015-01-01T00:00:00Z")
+    s = dateparser.parse("2011-08-24")  # naive → promoted to UTC
+    e = dateparser.parse("2020-01-01")
+    assert is_within_period(d, s, e)
+    assert is_within_period(d, s, None)
+    assert not is_within_period(d, None, dateparser.parse("2012-01-01"))
+    assert is_within_period(d, None, None)
+    assert not is_within_period(None, None, None)  # dateless article
+
+
+def test_process_json_data_us_filter():
+    us, de = _entity(), _entity(ticker="SAP", country=["Germany"])
+    # two companies: only US kept
+    assert set(process_json_data([us, de])) == {"AAPL"}
+    # single company: kept regardless of country
+    assert set(process_json_data([de])) == {"SAP"}
+
+
+def test_entity_index_name_classification():
+    idx = EntityIndex(process_json_data([_entity()]))
+    names = {(e.name, e.is_exact_upper) for e in idx.entries}
+    assert ("AAPL", True) in names            # ALL-CAPS → exact path
+    assert ("Tim Cook", False) in names       # mixed case → fuzzy path
+    assert ("iPhone", False) in names         # not pure-lower-alpha (capital P)
+    # pure lowercase alphabetic names are dropped (ref :174)
+    idx2 = EntityIndex(
+        process_json_data([_entity(products=["technology stuff", "iphone"])])
+    )
+    kept = {e.name for e in idx2.entries}
+    assert "technology stuff" not in kept and "iphone" not in kept
+
+
+ARTICLE = (
+    "Apple Inc. announced today that Tim Cook will present the new iPhone. "
+    "Shares of AAPL rose 3%. Beats Electronics was mentioned too."
+)
+TITLE = "AAPL leads markets as Tim Cook speaks"
+
+
+def _index():
+    return EntityIndex(process_json_data([_entity()]))
+
+
+def test_match_article_exact_and_fuzzy_paths():
+    adate = dateparser.parse("2020-06-01T00:00:00Z")
+    m = match_article(ARTICLE, TITLE, adate, _index())
+    assert "AAPL" in m
+    text_m, title_m = m["AAPL"]["text"], m["AAPL"]["title"]
+    # exact word-boundary positions
+    assert text_m["AAPL"] == [ARTICLE.index("AAPL")]
+    assert title_m["AAPL"] == [0]
+    # fuzzy names present with positions
+    assert text_m["Tim Cook"] == [ARTICLE.index("Tim Cook")]
+    assert "iPhone" in text_m
+    # period gating: Steve Jobs ended 2011 → absent in a 2020 article
+    assert "Steve Jobs" not in text_m
+
+
+def test_match_article_period_gate_allows_former_ceo_in_window():
+    adate = dateparser.parse("2005-06-01T00:00:00Z")
+    m = match_article("Steve Jobs unveiled something.", "", adate, _index())
+    assert "Steve Jobs" in m["AAPL"]["text"]
+    assert "Tim Cook" not in m["AAPL"]["text"]  # started 2011
+
+
+def test_match_article_dateless_article_matches_nothing():
+    # ref :18-20: article_date None → is_within_period False for EVERY name,
+    # so dateless articles can never match anything
+    assert match_article(ARTICLE, TITLE, None, _index()) == {}
+
+
+def test_screened_equals_unscreened_golden():
+    """The TPU screen must never change match output (no false negatives)."""
+    rng = np.random.RandomState(0)
+    fillers = [
+        "Markets were mixed today as investors weighed inflation data.",
+        "The quarterly report highlighted strong services growth.",
+        "Nothing related to any entity appears in this filler text.",
+    ]
+    rows = []
+    for i in range(40):
+        body = fillers[i % 3]
+        if i % 5 == 0:
+            body += " " + ARTICLE
+        if i % 7 == 0:
+            body += " Beats Electronics expansion continues."
+        rows.append(
+            {
+                "article_text": body,
+                "title": TITLE if i % 4 == 0 else "daily wrap",
+                "date_time": "2020-06-01T00:00:00Z",
+                "url": f"https://x/{i}.html",
+                "source": "s",
+                "source_url": "su",
+            }
+        )
+    df = pd.DataFrame(rows)
+    idx = _index()
+    screened = match_chunk(df, idx, use_screen=True, screen_batch=16)
+    unscreened = match_chunk(df, idx, use_screen=False)
+
+    def norm(res):
+        return sorted(
+            (t, json.dumps(m, sort_keys=True), r["url"]) for t, m, r in res
+        )
+
+    assert norm(screened) == norm(unscreened)
+    assert len(screened) >= 8  # planted matches found
+
+
+def test_run_matcher_end_to_end(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    os.makedirs("info_dir")
+    with open("info_dir/AAPL_info.json", "w") as f:
+        json.dump([_entity()], f)
+    rows = [
+        {
+            "article_text": ARTICLE,
+            "title": TITLE,
+            "date_time": "2020-06-01T12:00:00Z",
+            "url": "https://x/1.html",
+            "source": "yahoo",
+            "source_url": "https://y",
+        },
+        {
+            "article_text": "Unrelated piece about weather.",
+            "title": "weather",
+            "date_time": "2020-06-02T12:00:00Z",
+            "url": "https://x/2.html",
+            "source": "yahoo",
+            "source_url": "https://y",
+        },
+        {   # earlier article, to verify final time sort
+            "article_text": "AAPL had a strong day.",
+            "title": "markets",
+            "date_time": "2019-01-01T00:00:00Z",
+            "url": "https://x/0.html",
+            "source": "yahoo",
+            "source_url": "https://y",
+        },
+    ]
+    pd.DataFrame(rows).to_csv("articles.csv", index=False)
+    cfg = MatchConfig(source_name="yahoo", info_dir="info_dir", chunk_size=2)
+    rc = run_matcher(cfg, articles_csv="articles.csv")
+    assert rc == 0
+    out = pd.read_csv("yahoo_ticker_matched_articles/AAPL_match.csv")
+    assert len(out) == 2
+    # sorted ascending by time_unix (the 2019 article first)
+    assert out["url"].tolist() == ["https://x/0.html", "https://x/1.html"]
+    matches = json.loads(out.iloc[1]["text_matches"])
+    assert "AAPL" in matches and "Tim Cook" in matches
+
+
+def test_gbk_encoding_fallback(tmp_path):
+    payload = [_entity(ticker="GBK1", id_label="中文公司")]
+    raw = json.dumps(payload, ensure_ascii=False).encode("gbk")
+    with open(tmp_path / "gbk_info.json", "wb") as f:
+        f.write(raw)
+    data = read_info_dir(str(tmp_path))
+    assert "GBK1" in data
+
+
+def test_native_backend_loaded():
+    native.partial_ratio("warm", "up")
+    assert native.BACKEND in ("native", "python")
+    assert native.partial_ratio("Tim Cook", ARTICLE) > 95
+    assert native.partial_ratio("Timothy Cook", "completely unrelated") < 60
