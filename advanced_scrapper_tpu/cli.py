@@ -91,6 +91,60 @@ def _cmd_match(args: argparse.Namespace) -> int:
     return run_matcher(default_config().match)
 
 
+def _cmd_serve(args: argparse.Namespace) -> int:
+    """Lease server: resume-aware URL distribution + centralized parsing
+    (successor of experiental/server1.py)."""
+    import os
+
+    from advanced_scrapper_tpu.extractors import load_extractor
+    from advanced_scrapper_tpu.net.lease import LeaseServer
+    from advanced_scrapper_tpu.storage.csvio import read_url_column, scraped_url_set
+
+    cfg = default_config()
+    scraper = cfg.scraper
+    input_csv = args.input or scraper.input_csv
+    if not os.path.exists(input_csv):
+        print(f"Input CSV '{input_csv}' not found.")
+        return 1
+    success_csv = f"success_articles_{scraper.website}.csv"
+    failed_csv = f"failed_articles_{scraper.website}.csv"
+    urls = read_url_column(input_csv)
+    scraped = scraped_url_set(success_csv, failed_csv)
+    todo = [u for u in urls if u not in scraped]
+    print(f"Serving {len(todo)} URLs ({len(urls) - len(todo)} already scraped)")
+    feed = _with_overrides(cfg.feed, port=args.port)
+    server = LeaseServer(feed, todo).start()
+    print(f"Listening on {server.host}:{server.port} — Ctrl-C to stop")
+    try:
+        while not server.done():
+            import time
+
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    ok, bad = server.process_results(
+        load_extractor(scraper.website), success_csv, failed_csv
+    )
+    print(f"Parsed results: {ok} success, {bad} failed")
+    return 0
+
+
+def _cmd_work(args: argparse.Namespace) -> int:
+    """Lease worker node (successor of experiental/client1.py)."""
+    from advanced_scrapper_tpu.net.lease import LeaseClient
+    from advanced_scrapper_tpu.net.transport import make_transport
+
+    cfg = default_config()
+    feed = _with_overrides(cfg.feed, host=args.host, port=args.port)
+    transport = args.transport or cfg.scraper.transport
+    client = LeaseClient(feed, lambda: make_transport(transport))
+    sent = client.run(max_seconds=args.max_seconds)
+    print(f"Worker done: {sent} pages shipped")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="astpu",
@@ -119,6 +173,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     m = sub.add_parser("match", help="ticker→article entity matching")
     m.set_defaults(fn=_cmd_match)
+
+    sv = sub.add_parser("serve", help="lease server: distribute URLs to workers")
+    sv.add_argument("--input", default=None, help="URL csv (default scraper input)")
+    sv.add_argument("--port", type=int, default=None)
+    sv.set_defaults(fn=_cmd_serve)
+
+    wk = sub.add_parser("work", help="lease client: fetch for a serve node")
+    wk.add_argument("--host", default=None)
+    wk.add_argument("--port", type=int, default=None)
+    wk.add_argument("--transport", default=None)
+    wk.add_argument("--max-seconds", type=float, default=3600.0)
+    wk.set_defaults(fn=_cmd_work)
 
     return p
 

@@ -1,0 +1,384 @@
+"""Distributed pull-based work leasing over TCP + NDJSON.
+
+Re-implements the reference's only real multi-node backend
+(``experiental/server1.py`` / ``client1.py``, SURVEY.md §5.8):
+
+- newline-delimited JSON protocol:
+  ``request_tasks{num_urls}`` → ``task_batch{urls}`` (server ``:102-116``),
+  ``result{url, html_content}`` (``:117-124``),
+  ``tasks_completed`` → ``acknowledge_completion`` (``:125-130``);
+- **lease fault tolerance**: every url handed to a client is tracked in its
+  assigned set and returned to the queue if the client disconnects before
+  reporting it (``:80-84,137-138``) — pull-based work stealing;
+- client keeps its local queue topped up: request ``batch_size`` urls
+  whenever depth < ``min_queue_length``, rate-capped (client ``:209-234``);
+- clients ship raw HTML (or ``ERROR:``-prefixed strings) back; the server
+  parses centrally with the extractor plugin and writes the standard
+  success/failed CSVs (``:232-309``).
+
+This is also the host feed scheduler pattern the north star reuses at the
+CPU→TPU boundary: the server side can hand its parsed results straight to
+``extractors.tpu_batch.TpuBatchBackend``.
+
+In the TPU-native framework the *device* plane scales via jax.distributed +
+collectives (``parallel/``); this module is the *host* plane that feeds it.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+import time
+from typing import Callable
+
+from bs4 import BeautifulSoup
+
+from advanced_scrapper_tpu.config import FeedConfig
+from advanced_scrapper_tpu.obs.stats import RateStats
+
+
+def _send_json(sock: socket.socket, lock: threading.Lock, obj: dict) -> None:
+    data = (json.dumps(obj) + "\n").encode("utf-8")
+    with lock:
+        sock.sendall(data)
+
+
+class _LineReader:
+    """Reassemble newline-framed JSON from a stream socket (client ``:146-181``)."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.buf = b""
+
+    def readline(self) -> dict | None:
+        while b"\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                return None
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\n", 1)
+        if not line.strip():
+            return self.readline()
+        return json.loads(line.decode("utf-8"))
+
+
+class LeaseServer:
+    """Task server: leases url batches, collects results, survives client loss."""
+
+    def __init__(self, cfg: FeedConfig, urls: list[str], *, host: str | None = None, port: int | None = None):
+        self.cfg = cfg
+        self.host = host if host is not None else cfg.host
+        self.port = port if port is not None else cfg.port
+        self._urls: queue.SimpleQueue[str] = queue.SimpleQueue()
+        for u in urls:
+            self._urls.put(u)
+        self._pending = len(urls)
+        self._assigned: dict[int, set[str]] = {}
+        self._lock = threading.Lock()
+        self.results: list[dict] = []
+        self.stats = RateStats()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._sock: socket.socket | None = None
+        self._next_client = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "LeaseServer":
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        if self.port == 0:
+            self.port = self._sock.getsockname()[1]
+        self._sock.listen(self.cfg.max_clients)
+        self._sock.settimeout(0.5)
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            self._sock.close()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def done(self) -> bool:
+        with self._lock:
+            return self._pending <= 0
+
+    def wait_done(self, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.done():
+                return True
+            time.sleep(0.05)
+        return False
+
+    # -- accept / client handling -----------------------------------------
+
+    def _accept_loop(self) -> None:
+        assert self._sock is not None
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._lock:
+                cid = self._next_client
+                self._next_client += 1
+                self._assigned[cid] = set()
+            t = threading.Thread(
+                target=self._handle_client, args=(conn, cid), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _lease(self, cid: int, n: int) -> list[str]:
+        out = []
+        with self._lock:
+            for _ in range(n):
+                try:
+                    u = self._urls.get_nowait()
+                except queue.Empty:
+                    break
+                out.append(u)
+                self._assigned[cid].add(u)
+        return out
+
+    def _return_unprocessed(self, cid: int) -> None:
+        """Lease return on disconnect — the fault-tolerance core (ref :80-84)."""
+        with self._lock:
+            for u in self._assigned.pop(cid, ()):
+                self._urls.put(u)
+
+    def _handle_client(self, conn: socket.socket, cid: int) -> None:
+        reader = _LineReader(conn)
+        wlock = threading.Lock()
+        try:
+            while not self._stop.is_set():
+                msg = reader.readline()
+                if msg is None:
+                    return
+                kind = msg.get("type")
+                if kind == "request_tasks":
+                    self.stats.record_request()
+                    urls = self._lease(cid, int(msg.get("num_urls", 1)))
+                    _send_json(conn, wlock, {"type": "task_batch", "urls": urls})
+                elif kind == "result":
+                    self.stats.record_response()
+                    url = msg.get("url")
+                    with self._lock:
+                        self._assigned[cid].discard(url)
+                        self._pending -= 1
+                    self.results.append(
+                        {"url": url, "html_content": msg.get("html_content", "")}
+                    )
+                elif kind == "tasks_completed":
+                    _send_json(conn, wlock, {"type": "acknowledge_completion"})
+                    return
+        except (ConnectionError, json.JSONDecodeError, OSError):
+            pass
+        finally:
+            self._return_unprocessed(cid)
+            conn.close()
+
+    # -- centralized parsing (ref server1.py:232-309) ----------------------
+
+    def process_results(
+        self,
+        extractor: Callable,
+        success_csv: str,
+        failed_csv: str,
+        *,
+        on_success: Callable[[dict], None] | None = None,
+    ) -> tuple[int, int]:
+        """Parse every returned HTML with the extractor plugin → CSVs.
+
+        ``ERROR:``-prefixed payloads (the client's fetch-failure sentinel)
+        land in the failed CSV verbatim.
+        """
+        from advanced_scrapper_tpu.pipeline.scraper import (
+            FAILED_FIELDS,
+            SUCCESS_FIELDS,
+        )
+        from advanced_scrapper_tpu.storage.csvio import AppendCsv
+
+        ok = bad = 0
+        with AppendCsv(success_csv, SUCCESS_FIELDS) as okc, AppendCsv(
+            failed_csv, FAILED_FIELDS
+        ) as badc:
+            for r in self.results:
+                url, html = r["url"], r["html_content"]
+                if html.startswith("ERROR:"):
+                    badc.write_row({"url": url, "error": html[len("ERROR:") :].strip()})
+                    bad += 1
+                    continue
+                try:
+                    data = extractor(BeautifulSoup(html, "html.parser"))
+                except Exception as e:
+                    badc.write_row({"url": url, "error": str(e)})
+                    bad += 1
+                    continue
+                if not data.get("title"):
+                    badc.write_row({"url": url, "error": "Title is empty"})
+                    bad += 1
+                    continue
+                data["url"] = url
+                okc.write_row(data)
+                ok += 1
+                if on_success is not None:
+                    on_success(dict(data))
+        return ok, bad
+
+
+class LeaseClient:
+    """Worker node: fetch threads fed by a leased local queue (client1.py)."""
+
+    def __init__(
+        self,
+        cfg: FeedConfig,
+        transport_factory: Callable[[], object],
+        *,
+        host: str | None = None,
+        port: int | None = None,
+        sleep=time.sleep,
+    ):
+        self.cfg = cfg
+        self.host = host if host is not None else cfg.host
+        self.port = port if port is not None else cfg.port
+        self.transport_factory = transport_factory
+        self.sleep = sleep
+        self._tasks: queue.Queue[str] = queue.Queue()
+        self._results: queue.Queue[tuple[str, str]] = queue.Queue()
+        self._inflight = 0              # urls popped but not yet resulted
+        self._inflight_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._drained = threading.Event()  # server sent an empty batch
+        self._sock: socket.socket | None = None
+        self._wlock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+
+    def run(self, *, max_seconds: float = 60.0) -> int:
+        """Connect, pull leases, fetch, stream results; returns #fetched.
+
+        Stops when the server's queue is drained (an empty ``task_batch``)
+        and all local work is done, or after ``max_seconds``.
+        """
+        self._sock = socket.create_connection((self.host, self.port), timeout=10)
+        reader = _LineReader(self._sock)
+        fetched = 0
+
+        def receiver():
+            nonlocal fetched
+            try:
+                while not self._stop.is_set():
+                    msg = reader.readline()
+                    if msg is None:
+                        return
+                    if msg.get("type") == "task_batch":
+                        urls = msg.get("urls", [])
+                        if not urls:
+                            self._drained.set()
+                        for u in urls:
+                            self._tasks.put(u)
+                    elif msg.get("type") == "acknowledge_completion":
+                        return
+            except (ConnectionError, OSError, json.JSONDecodeError):
+                return
+
+        def worker():
+            transport = self.transport_factory()
+            try:
+                while not self._stop.is_set():
+                    try:
+                        url = self._tasks.get(timeout=0.1)
+                    except queue.Empty:
+                        continue
+                    with self._inflight_lock:
+                        self._inflight += 1
+                    try:
+                        html = transport.fetch(url)
+                    except Exception as e:
+                        html = f"ERROR: {e}"
+                    finally:
+                        self._results.put((url, html))
+                        with self._inflight_lock:
+                            self._inflight -= 1
+            finally:
+                try:
+                    transport.close()
+                except Exception:
+                    pass
+
+        def sender():
+            nonlocal fetched
+            while not (self._stop.is_set() and self._results.empty()):
+                try:
+                    url, html = self._results.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                try:
+                    _send_json(
+                        self._sock,
+                        self._wlock,
+                        {"type": "result", "url": url, "html_content": html},
+                    )
+                    fetched += 1
+                except (ConnectionError, OSError):
+                    return
+
+        threads = [threading.Thread(target=receiver, daemon=True)]
+        threads += [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(self.cfg.client_threads)
+        ]
+        threads.append(threading.Thread(target=sender, daemon=True))
+        for t in threads:
+            t.start()
+        self._threads = threads
+
+        # monitor loop: low-water refill, rate-capped (client1.py:209-234)
+        interval = 1.0 / self.cfg.client_rate
+        deadline = time.monotonic() + max_seconds
+        try:
+            while time.monotonic() < deadline:
+                with self._inflight_lock:
+                    inflight = self._inflight
+                if (
+                    self._drained.is_set()
+                    and self._tasks.empty()
+                    and self._results.empty()
+                    and inflight == 0
+                ):
+                    break
+                if self._tasks.qsize() < self.cfg.min_queue_length:
+                    try:
+                        _send_json(
+                            self._sock,
+                            self._wlock,
+                            {
+                                "type": "request_tasks",
+                                "num_urls": self.cfg.batch_size,
+                            },
+                        )
+                    except (ConnectionError, OSError):
+                        break
+                self.sleep(interval)
+            # graceful completion handshake
+            try:
+                _send_json(self._sock, self._wlock, {"type": "tasks_completed"})
+            except (ConnectionError, OSError):
+                pass
+            self.sleep(0.1)
+        finally:
+            self._stop.set()
+            for t in threads:
+                t.join(timeout=2)
+            self._sock.close()
+        return fetched

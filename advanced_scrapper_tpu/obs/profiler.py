@@ -1,0 +1,54 @@
+"""Profiling hooks: per-batch step timing + optional XLA trace export.
+
+The reference's only performance observability is the 10 Hz stats line
+(SURVEY.md §5.1); the TPU framework adds what that can't see — device step
+latency percentiles and ``jax.profiler`` traces for the kernel timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import deque
+
+
+class StepTimer:
+    """Rolling per-step duration tracker (device batches, host stages)."""
+
+    def __init__(self, maxlen: int = 512):
+        self._durations: deque[float] = deque(maxlen=maxlen)
+        self._items: deque[int] = deque(maxlen=maxlen)
+
+    @contextlib.contextmanager
+    def step(self, n_items: int = 1):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._durations.append(time.perf_counter() - t0)
+            self._items.append(n_items)
+
+    def summary(self) -> dict:
+        if not self._durations:
+            return {"steps": 0}
+        ds = sorted(self._durations)
+        total_t = sum(self._durations)
+        total_n = sum(self._items)
+        return {
+            "steps": len(ds),
+            "p50_ms": round(ds[len(ds) // 2] * 1e3, 3),
+            "p95_ms": round(ds[int(len(ds) * 0.95)] * 1e3, 3),
+            "items_per_sec": round(total_n / total_t, 1) if total_t > 0 else 0.0,
+        }
+
+
+@contextlib.contextmanager
+def xla_trace(log_dir: str | None):
+    """``jax.profiler.trace`` wrapper; no-op when ``log_dir`` is falsy."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

@@ -2,28 +2,40 @@
 
 ``match_keywords.py:159-180`` scans O(articles × tickers × names) strings on
 CPU — regex word-boundary for ALL-CAPS names, ``rapidfuzz.partial_ratio >
-95`` otherwise.  The TPU rerouting keeps the *decisions* on the host (so
-CSV outputs stay byte-identical) but eliminates almost all of the quadratic
-scanning with a device-side **no-false-negative screen**:
+threshold`` otherwise.  The TPU rerouting keeps the *decisions* on the host
+(so CSV outputs stay byte-identical) but eliminates almost all of the
+quadratic scanning with a device-side **no-false-negative screen**:
 
-1. each article's q-gram set is hashed into a 2¹⁵-bit bitmap on device
-   (one scatter per gram position);
+1. each article's q-gram set (over ``title\\ntext``) is hashed into a
+   2¹⁵-bit bitmap on device;
 2. each entity name's q-gram hash indices are gathered from every article's
    bitmap; an (article, name) pair survives only if enough name-grams are
    present.
 
-Soundness thresholds (why the screen can't drop a true match):
+Soundness ("enough" can never prune a true match).  Let ``m = |name|``,
+``D`` the length of the matched part (text or title — matching tries both,
+so the screen takes the *weakest* bound over the two), ``e = min(D, m)``,
+``t`` the fuzzy threshold, and ``d_max(e) = ⌊2e(1 - t/100)⌋`` the largest
+indel distance any window alignment can have at score > t
+(``score = 100·2·LCS/(m+|w|)`` and ``|w| ≤ e``).  By the q-gram lemma one
+indel destroys at most q gram occurrences, so:
 
-- **exact/ALL-CAPS path**: a regex word-boundary hit implies the name is a
-  substring, so ALL its ``m-q+1`` grams appear in the article → require all.
-- **fuzzy path**: ``partial_ratio(article, name) > 95`` means some window
-  ``w`` (``|w| ≤ m``) has indel distance ``d < 0.05·(m+|w|) ≤ 0.1·m``.
-  One indel edit destroys at most q of the name's grams (q-gram lemma), so
-  at least ``(m-q+1) - q·⌊0.1·m⌋`` name-grams must appear → require that.
+- **exact/ALL-CAPS path**: a word-boundary hit means the name is a substring
+  of a part with ``D ≥ m`` and ALL its grams appear → require every kept
+  gram, and prune outright when both parts are shorter than the name;
+- **fuzzy, part at least name-sized (D ≥ m)**: at most ``q·d_max(m)`` of
+  the name's gram occurrences miss the window → at least
+  ``kept - q·d_max(m)`` of the *kept* grams appear in the part;
+- **fuzzy, short part (D < m)**: the window is a ``D``-length slice of the
+  name; its ``D-q+1`` gram positions lose at most ``q·d_max(D)`` to edits →
+  require ``(D-q+1) - q·d_max(D)``.  This is only valid when no grams were
+  truncated (a tail window may avoid the kept prefix entirely), so
+  truncated names with short parts are never screened;
+- any bound ≤ 0 → the pair always survives to host verification.
 
-Bloom collisions and window-vs-whole-article relaxation only ADD candidates
-(false positives are later killed by exact host verification); they never
-remove true ones.  Names too short to carry grams are always candidates.
+Bloom collisions and part-concatenation only ADD candidates; host
+verification kills them, so screened output is golden-equal to unscreened
+(tested, including adversarial short-title and truncated-name cases).
 """
 
 from __future__ import annotations
@@ -39,69 +51,123 @@ from advanced_scrapper_tpu.ops.shingle import shingle_hash
 
 NBITS = 1 << 15
 DEFAULT_Q = 3
+MAX_GRAMS = 96
 
 
 def prepare_names(
-    names: list[bytes], q: int = DEFAULT_Q, *, fuzzy: np.ndarray | None = None,
-    nbits: int = NBITS, max_grams: int = 96,
-) -> tuple[np.ndarray, np.ndarray]:
-    """Host-side: names → (gram bit indices int32[N, max_grams] padded -1,
-    required counts int32[N]).
+    names: list[bytes],
+    q: int = DEFAULT_Q,
+    *,
+    fuzzy: np.ndarray | None = None,
+    nbits: int = NBITS,
+    max_grams: int = MAX_GRAMS,
+) -> dict:
+    """Host-side name tables for :func:`match_screen`.
 
-    ``fuzzy[i]`` selects the fuzzy threshold for name i (else exact/all
-    grams).  Names with no grams get required=0 → always candidates.
+    Returns arrays: ``grams int32[N, max_grams]`` (bit indices, -1 padded),
+    ``kept/total int32[N]`` gram counts, ``name_len int32[N]``,
+    ``fuzzy bool[N]``.
     """
     n = len(names)
     fuzzy = np.zeros(n, bool) if fuzzy is None else np.asarray(fuzzy, bool)
     grams = np.full((n, max_grams), -1, dtype=np.int32)
-    required = np.zeros(n, dtype=np.int32)
+    kept = np.zeros(n, dtype=np.int32)
+    total = np.zeros(n, dtype=np.int32)
+    name_len = np.zeros(n, dtype=np.int32)
     for i, raw in enumerate(names):
         h = gram_hashes_np(raw, q)
         g = (h % nbits).astype(np.int32)[:max_grams]
         grams[i, : len(g)] = g
-        m = len(raw)
-        total = max(0, m - q + 1)
-        if total == 0:
-            required[i] = 0
-        elif fuzzy[i]:
-            # q-gram lemma bound for ratio > 95 (see module docstring)
-            required[i] = max(1, min(len(g), total - q * int(0.1 * m)))
-        else:
-            required[i] = len(g)  # substring ⇒ every (kept) gram present
-    return grams, required
+        kept[i] = len(g)
+        total[i] = len(h)
+        name_len[i] = len(raw)
+    return {
+        "grams": grams,
+        "kept": kept,
+        "total": total,
+        "name_len": name_len,
+        "fuzzy": fuzzy.copy(),
+    }
 
 
 @partial(jax.jit, static_argnames=("nbits", "q"))
-def _screen_impl(tokens, lengths, name_grams, name_required, *, nbits: int, q: int):
-    h, valid = shingle_hash(tokens, lengths, q)
+def _screen_impl(
+    tokens,
+    text_len,
+    title_len,
+    doc_len,
+    grams,
+    kept,
+    total,
+    name_len,
+    fuzzy,
+    threshold,
+    *,
+    nbits: int,
+    q: int,
+):
+    h, valid = shingle_hash(tokens, doc_len, q)
     idx = jnp.where(valid, (h % jnp.uint32(nbits)).astype(jnp.int32), nbits)
     B = tokens.shape[0]
     bitmap = jnp.zeros((B, nbits), dtype=bool)
     bitmap = jax.vmap(lambda bm, ix: bm.at[ix].set(True, mode="drop"))(bitmap, idx)
-    # gather name gram bits from every article's bitmap: [B, N, G]
-    safe = jnp.maximum(name_grams, 0)
-    present = jax.vmap(lambda bm: bm[safe])(bitmap)
-    present = present & (name_grams >= 0)[None, :, :]
-    counts = present.sum(axis=-1).astype(jnp.int32)
-    return counts >= name_required[None, :]
+    safe = jnp.maximum(grams, 0)
+    present = jax.vmap(lambda bm: bm[safe])(bitmap)          # [B, N, G]
+    present = present & (grams >= 0)[None, :, :]
+    count = present.sum(axis=-1).astype(jnp.int32)           # [B, N]
+
+    frac = 2.0 * (1.0 - threshold / 100.0)
+    m = name_len[None, :]                                    # [1, N]
+    truncated = (kept < total)[None, :]
+
+    def fuzzy_bound(D):                                      # D: [B] part lengths
+        D = D[:, None]
+        e = jnp.minimum(D, m)
+        dmax = jnp.floor(e.astype(jnp.float32) * frac).astype(jnp.int32)
+        dmax_m = jnp.floor(m.astype(jnp.float32) * frac).astype(jnp.int32)
+        b_long = kept[None, :] - q * dmax_m                  # D >= m
+        b_short = (D - q + 1) - q * dmax                     # D <  m, untruncated
+        b_short = jnp.where(truncated, 0, b_short)
+        return jnp.where(D >= m, b_long, b_short)
+
+    req = jnp.minimum(fuzzy_bound(text_len), fuzzy_bound(title_len))
+    fuzzy_keep = (req <= 0) | (count >= jnp.maximum(req, 1))
+
+    part_max = jnp.maximum(text_len, title_len)[:, None]
+    exact_keep = (count >= kept[None, :]) & (part_max >= m)
+
+    return jnp.where(fuzzy[None, :], fuzzy_keep, exact_keep)
 
 
 def match_screen(
     tokens: np.ndarray,
-    lengths: np.ndarray,
-    name_grams: np.ndarray,
-    name_required: np.ndarray,
+    text_len: np.ndarray,
+    title_len: np.ndarray,
+    doc_len: np.ndarray,
+    tables: dict,
     *,
+    threshold: float = 95.0,
     nbits: int = NBITS,
     q: int = DEFAULT_Q,
 ) -> np.ndarray:
-    """``bool[B, N]`` — True where (article, name) survives the screen."""
+    """``bool[B, N]`` — True where (article, name) survives the screen.
+
+    ``tokens/doc_len`` describe the combined ``title\\ntext`` byte rows;
+    ``text_len``/``title_len`` are the raw part lengths the soundness bounds
+    are computed from.
+    """
     return np.asarray(
         _screen_impl(
             tokens,
-            lengths,
-            jnp.asarray(name_grams),
-            jnp.asarray(name_required),
+            jnp.asarray(text_len, jnp.int32),
+            jnp.asarray(title_len, jnp.int32),
+            jnp.asarray(doc_len, jnp.int32),
+            jnp.asarray(tables["grams"]),
+            jnp.asarray(tables["kept"]),
+            jnp.asarray(tables["total"]),
+            jnp.asarray(tables["name_len"]),
+            jnp.asarray(tables["fuzzy"]),
+            jnp.float32(threshold),
             nbits=nbits,
             q=q,
         )

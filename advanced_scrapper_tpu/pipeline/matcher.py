@@ -193,19 +193,18 @@ class EntityIndex:
                             NameEntry(ticker, attribute, name, start, end, False)
                         )
                     # pure-lowercase-alpha names are skipped (ref :174)
-        self._grams = None
-        self._required = None
+        self._tables: dict | None = None
 
     @classmethod
     def from_info_dir(cls, folder: str) -> "EntityIndex":
         return cls(read_info_dir(folder))
 
-    def screen_tables(self):
-        if self._grams is None:
+    def screen_tables(self) -> dict:
+        if self._tables is None:
             names = [e.name.encode("utf-8", "replace") for e in self.entries]
             fuzzy = np.array([not e.is_exact_upper for e in self.entries], bool)
-            self._grams, self._required = prepare_names(names, fuzzy=fuzzy)
-        return self._grams, self._required
+            self._tables = prepare_names(names, fuzzy=fuzzy)
+        return self._tables
 
 
 # -- matching ----------------------------------------------------------------
@@ -304,17 +303,25 @@ def match_chunk(
 
     masks: list[np.ndarray | None] = [None] * len(rows)
     if use_screen and index.entries:
-        grams, required = index.screen_tables()
+        tables = index.screen_tables()
         for start in range(0, len(rows), screen_batch):
             batch = rows[start : start + screen_batch]
-            # screen over title+text so title-only matches can't be pruned
+            # bitmap over title+text; part lengths drive the soundness bounds
             raw = [
                 (title + "\n" + text).encode("utf-8", "replace")
                 for text, title, _, _ in batch
             ]
+            text_len = np.array(
+                [len(t.encode("utf-8", "replace")) for t, _, _, _ in batch], np.int32
+            )
+            title_len = np.array(
+                [len(t.encode("utf-8", "replace")) for _, t, _, _ in batch], np.int32
+            )
             overlong = [len(r) > screen_block for r in raw]
             tok, ln = encode_batch(raw, block_len=screen_block)
-            got = match_screen(tok, ln, grams, required)
+            got = match_screen(
+                tok, text_len, title_len, ln, tables, threshold=threshold
+            )
             for i in range(len(batch)):
                 # articles longer than the screen block fall back to full scan
                 masks[start + i] = None if overlong[i] else got[i]

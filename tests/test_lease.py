@@ -1,0 +1,131 @@
+"""Distributed lease protocol tests — server+client on localhost, the same
+single-box topology the reference uses (server1.py:17-18)."""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from advanced_scrapper_tpu.config import FeedConfig
+from advanced_scrapper_tpu.net.lease import LeaseClient, LeaseServer, _LineReader
+from advanced_scrapper_tpu.net.transport import MockTransport
+from advanced_scrapper_tpu.storage.csvio import read_url_column
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+ARTICLE_HTML = open(os.path.join(FIXTURES, "yfin_article.html")).read()
+
+
+def _cfg(**kw):
+    base = dict(host="127.0.0.1", port=0, batch_size=4, min_queue_length=2,
+                client_threads=2, client_rate=200.0)
+    base.update(kw)
+    return FeedConfig(**base)
+
+
+def test_full_lease_roundtrip_and_central_parse(tmp_path):
+    urls = [f"https://x/{i}.html" for i in range(10)]
+    pages = {u: ARTICLE_HTML for u in urls}
+    pages[urls[3]] = None  # missing fixture → client sends ERROR: payload
+
+    cfg = _cfg()
+    server = LeaseServer(cfg, urls).start()
+    try:
+        client = LeaseClient(
+            cfg,
+            lambda: MockTransport({u: p for u, p in pages.items() if p}),
+            port=server.port,
+        )
+        sent = client.run(max_seconds=20)
+        assert sent == 10
+        assert server.wait_done(10)
+    finally:
+        server.stop()
+
+    from advanced_scrapper_tpu.extractors import load_extractor
+
+    ok_csv = str(tmp_path / "ok.csv")
+    bad_csv = str(tmp_path / "bad.csv")
+    ok, bad = server.process_results(load_extractor("yfin"), ok_csv, bad_csv)
+    assert ok == 9 and bad == 1
+    assert "no fixture" in open(bad_csv).read()
+    assert len(read_url_column(ok_csv)) == 9
+
+
+def test_disconnect_returns_leased_urls():
+    """Kill a client mid-lease: its urls must go back to the queue."""
+    urls = [f"https://x/{i}.html" for i in range(8)]
+    cfg = _cfg()
+    server = LeaseServer(cfg, urls).start()
+    try:
+        # hand-rolled client: lease 5 urls, return 1 result, vanish
+        sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        sock.sendall(b'{"type": "request_tasks", "num_urls": 5}\n')
+        reader = _LineReader(sock)
+        batch = reader.readline()
+        assert batch["type"] == "task_batch" and len(batch["urls"]) == 5
+        sock.sendall(
+            (json.dumps({"type": "result", "url": batch["urls"][0],
+                         "html_content": "<html></html>"}) + "\n").encode()
+        )
+        time.sleep(0.2)
+        sock.close()  # disconnect with 4 unprocessed leases
+        time.sleep(0.5)
+
+        # a second, healthy client must receive the returned urls
+        client = LeaseClient(
+            cfg, lambda: MockTransport(lambda u: ARTICLE_HTML), port=server.port
+        )
+        sent = client.run(max_seconds=20)
+        assert sent == 7  # 8 minus the one already resulted
+        assert server.wait_done(10)
+    finally:
+        server.stop()
+
+
+def test_completion_handshake():
+    cfg = _cfg()
+    server = LeaseServer(cfg, []).start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        sock.sendall(b'{"type": "tasks_completed"}\n')
+        msg = _LineReader(sock).readline()
+        assert msg == {"type": "acknowledge_completion"}
+        sock.close()
+    finally:
+        server.stop()
+
+
+def test_empty_batch_signals_drained():
+    cfg = _cfg()
+    server = LeaseServer(cfg, ["https://x/only.html"]).start()
+    try:
+        client = LeaseClient(
+            cfg, lambda: MockTransport(lambda u: ARTICLE_HTML), port=server.port
+        )
+        sent = client.run(max_seconds=20)
+        assert sent == 1
+    finally:
+        server.stop()
+
+
+def test_malformed_json_drops_client_and_requeues():
+    urls = ["https://x/a.html", "https://x/b.html"]
+    cfg = _cfg()
+    server = LeaseServer(cfg, urls).start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        sock.sendall(b'{"type": "request_tasks", "num_urls": 2}\n')
+        reader = _LineReader(sock)
+        assert len(reader.readline()["urls"]) == 2
+        sock.sendall(b"this is not json\n")
+        time.sleep(0.5)
+        # server dropped the client and requeued both urls
+        client = LeaseClient(
+            cfg, lambda: MockTransport(lambda u: ARTICLE_HTML), port=server.port
+        )
+        assert client.run(max_seconds=20) == 2
+    finally:
+        server.stop()

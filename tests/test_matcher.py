@@ -224,3 +224,77 @@ def test_native_backend_loaded():
     assert native.BACKEND in ("native", "python")
     assert native.partial_ratio("Tim Cook", ARTICLE) > 95
     assert native.partial_ratio("Timothy Cook", "completely unrelated") < 60
+
+
+def _mk_index(entities):
+    return EntityIndex(process_json_data(entities))
+
+
+def test_screen_sound_for_short_title_vs_long_name():
+    """partial_ratio slides the SHORTER side: a short title inside a long
+    name must survive the screen (the unsound bound pruned this)."""
+    long_name = "International Business Machines Corporation"
+    idx = _mk_index([_entity(ticker="IBM", aliases=[long_name], ceos=[], products=[],
+                             subsidiaries=[], id_label="X1")])
+    rows = [{
+        "article_text": "totally unrelated body text about the weather today",
+        "title": "International Business",  # shorter than the name, ratio 100
+        "date_time": "2020-06-01T00:00:00Z",
+        "url": "https://x/t.html", "source": "s", "source_url": "su",
+    }]
+    df = pd.DataFrame(rows)
+    screened = match_chunk(df, idx, use_screen=True)
+    unscreened = match_chunk(df, idx, use_screen=False)
+    assert len(unscreened) == 1  # reference records the title match
+    assert len(screened) == len(unscreened)
+
+
+def test_screen_sound_for_truncated_long_fuzzy_name():
+    """Names with more grams than max_grams must keep edit tolerance."""
+    long_name = "Abcdefgh Ijklmnop Qrstuvwx " * 6 + "Yz Holdings"  # ~170 bytes
+    assert not long_name.isupper()
+    idx = _mk_index([_entity(ticker="LONG", aliases=[long_name], ceos=[],
+                             products=[], subsidiaries=[], id_label="X2")])
+    body = "intro text. " + long_name[:80] + "Q" + long_name[81:] + " outro."
+    rows = [{
+        "article_text": body, "title": "wrap",
+        "date_time": "2020-06-01T00:00:00Z",
+        "url": "https://x/l.html", "source": "s", "source_url": "su",
+    }]
+    df = pd.DataFrame(rows)
+    screened = match_chunk(df, idx, use_screen=True)
+    unscreened = match_chunk(df, idx, use_screen=False)
+    assert len(screened) == len(unscreened)
+
+
+def test_screen_sound_for_nondefault_threshold():
+    """Screen bounds must follow the configured threshold, not a fixed 95."""
+    name = "Consolidated Widget Partners"
+    idx = _mk_index([_entity(ticker="CWP", aliases=[name], ceos=[], products=[],
+                             subsidiaries=[], id_label="X3")])
+    # heavily edited mention: ratio ~80 — matches at threshold 70, not 95
+    mention = "Consodated Wdget Parters"
+    rows = [{
+        "article_text": f"news about {mention} expanding operations",
+        "title": "wrap", "date_time": "2020-06-01T00:00:00Z",
+        "url": "https://x/nt.html", "source": "s", "source_url": "su",
+    }]
+    df = pd.DataFrame(rows)
+    screened = match_chunk(df, idx, use_screen=True, threshold=70.0)
+    unscreened = match_chunk(df, idx, use_screen=False, threshold=70.0)
+    assert len(unscreened) == 1
+    assert len(screened) == len(unscreened)
+
+
+def test_screen_exact_path_prunes_impossible_substrings():
+    """ALL-CAPS names longer than both parts can never match → pruned."""
+    from advanced_scrapper_tpu.ops.match import match_screen, prepare_names
+    from advanced_scrapper_tpu.core.tokenizer import encode_batch
+    import numpy as np
+
+    tables = prepare_names([b"VERYLONGTICKERNAME"], fuzzy=np.array([False]))
+    doc = b"short\nbody"
+    tok, ln = encode_batch([doc], block_len=64)
+    keep = match_screen(tok, np.array([4], np.int32), np.array([5], np.int32),
+                        ln, tables)
+    assert not keep[0, 0]

@@ -94,3 +94,23 @@ def test_progress_ledger_repair(tmp_path):
     # artifact vanished → un-mark and reprocess (ref :381-393)
     assert not led2.should_skip("AAPL", lambda: False)
     assert "AAPL" not in led2.processed
+
+
+def test_step_timer_summary():
+    from advanced_scrapper_tpu.obs.profiler import StepTimer
+
+    t = StepTimer()
+    assert t.summary() == {"steps": 0}
+    for _ in range(10):
+        with t.step(n_items=100):
+            pass
+    s = t.summary()
+    assert s["steps"] == 10 and s["items_per_sec"] > 0
+    assert s["p50_ms"] <= s["p95_ms"] + 1e-6
+
+
+def test_xla_trace_noop():
+    from advanced_scrapper_tpu.obs.profiler import xla_trace
+
+    with xla_trace(None):
+        pass  # must not require jax import/device
