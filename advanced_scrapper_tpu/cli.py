@@ -145,6 +145,32 @@ def _cmd_work(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_new_links(args: argparse.Namespace) -> int:
+    from advanced_scrapper_tpu.utils.setops import new_links
+
+    n = new_links(args.input, args.output, *args.done)
+    print(f"{n} new links → {args.output}")
+    return 0
+
+
+def _cmd_split(args: argparse.Namespace) -> int:
+    from advanced_scrapper_tpu.utils.setops import round_robin_split
+
+    paths = round_robin_split(
+        args.input, args.parts, *args.done, output_template=args.template
+    )
+    print("wrote " + ", ".join(paths))
+    return 0
+
+
+def _cmd_xdedup(args: argparse.Namespace) -> int:
+    from advanced_scrapper_tpu.pipeline.cross_source import cross_source_dedup
+
+    stats = cross_source_dedup(args.sources, args.output)
+    print(json.dumps(stats, indent=2))
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="astpu",
@@ -185,6 +211,26 @@ def build_parser() -> argparse.ArgumentParser:
     wk.add_argument("--transport", default=None)
     wk.add_argument("--max-seconds", type=float, default=3600.0)
     wk.set_defaults(fn=_cmd_work)
+
+    nl = sub.add_parser("new-links", help="anti-join: urls not yet scraped")
+    nl.add_argument("input")
+    nl.add_argument("output")
+    nl.add_argument("done", nargs="+", help="CSVs of already-scraped urls")
+    nl.set_defaults(fn=_cmd_new_links)
+
+    sp = sub.add_parser("split", help="round-robin shard split for N machines")
+    sp.add_argument("input")
+    sp.add_argument("-n", "--parts", type=int, required=True)
+    sp.add_argument("--done", nargs="*", default=[])
+    sp.add_argument("--template", default="part_{i}.csv")
+    sp.set_defaults(fn=_cmd_split)
+
+    xd = sub.add_parser(
+        "xdedup", help="cross-source dedup over CSVs and sqlite stores"
+    )
+    xd.add_argument("sources", nargs="+")
+    xd.add_argument("-o", "--output", default="xdedup_manifest.csv")
+    xd.set_defaults(fn=_cmd_xdedup)
 
     return p
 

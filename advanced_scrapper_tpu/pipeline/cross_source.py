@@ -1,0 +1,95 @@
+"""Cross-source pod-scale dedup (BASELINE.json config 5).
+
+Merges articles from heterogeneous sources — scraper success CSVs
+(``success_articles_*.csv``) and SQLite article stores
+(``crypto_news.db``-style) — into one corpus and runs exact + near-dup
+detection across ALL of them, so e.g. a Yahoo article and its syndicated
+copy in the BTC store collapse to one representative.  Per-source stats are
+reported; a merged "keep" manifest CSV is written.
+
+All corpora stream through :class:`extractors.tpu_batch.TpuBatchBackend`
+(fixed-size device batches + persistent host bucket index), so memory stays
+bounded regardless of corpus size; static in-memory corpora can instead use
+``parallel.sharded.make_sharded_dedup`` directly for an all-device join.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from advanced_scrapper_tpu.config import DedupConfig
+from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+from advanced_scrapper_tpu.storage.csvio import AppendCsv
+from advanced_scrapper_tpu.storage.stores import ArticleStore
+
+
+@dataclass
+class SourceDoc:
+    source: str
+    url: str
+    text: str
+
+
+def load_source(path: str) -> list[SourceDoc]:
+    """A source is a success CSV (url/article columns) or a sqlite DB."""
+    name = os.path.basename(path)
+    if path.endswith((".db", ".sqlite", ".sqlite3")):
+        store = ArticleStore(path)
+        return [SourceDoc(name, url, text) for url, text in store.all_texts()]
+    import csv as _csv
+
+    out = []
+    with open(path, newline="", encoding="utf-8") as f:
+        for row in _csv.DictReader(f):
+            text = row.get("article") or row.get("article_text") or ""
+            out.append(SourceDoc(name, str(row.get("url", "")), text))
+    return out
+
+
+def cross_source_dedup(
+    sources: list[str],
+    output_csv: str,
+    *,
+    cfg: DedupConfig | None = None,
+) -> dict:
+    """Dedup across sources → manifest CSV + per-source stats dict."""
+    cfg = cfg or DedupConfig()
+    docs: list[SourceDoc] = []
+    for s in sources:
+        docs.extend(load_source(s))
+
+    backend = TpuBatchBackend(cfg)
+    processed: list[dict] = []
+    for d in docs:
+        processed += backend.submit(
+            {"url": d.url, "article": d.text, "_source": d.source}
+        )
+    processed += backend.flush()
+
+    stats: dict = {"total": len(docs), "kept": 0, "exact_dups": 0, "near_dups": 0,
+                   "by_source": {}}
+    with AppendCsv(output_csv, ["url", "source", "status", "dup_of"]) as out:
+        for rec in processed:
+            src = rec.get("_source", "")
+            s = stats["by_source"].setdefault(
+                src, {"total": 0, "kept": 0, "dups": 0}
+            )
+            s["total"] += 1
+            if rec.get("dup_of"):
+                status, ref = "exact_dup", rec["dup_of"]
+                stats["exact_dups"] += 1
+                s["dups"] += 1
+            elif rec.get("near_dup_of"):
+                status, ref = "near_dup", rec["near_dup_of"]
+                stats["near_dups"] += 1
+                s["dups"] += 1
+            else:
+                status, ref = "keep", ""
+                stats["kept"] += 1
+                s["kept"] += 1
+            out.write_row(
+                {"url": rec.get("url", ""), "source": src, "status": status,
+                 "dup_of": ref}
+            )
+    return stats

@@ -1,0 +1,101 @@
+"""Live topic poller: discover new article links on a rolling basis.
+
+Re-implements the reference's live-news loops (``experiental/04_crypto_1.py``
+/ ``09_btc_links.py`` + the article side of ``05``/``10``):
+
+- poll a topic page (default the crypto feed) every ``interval`` seconds;
+- keep links passing the reference's filter — contains ``/news/`` AND
+  ``.html`` AND ``https:`` (``04:75``);
+- insert-or-ignore into the link store (``is_scraped`` flag resume);
+- optionally drain unscraped links through an extractor into the article
+  store, re-queueing whatever fails so the loop retries it forever
+  (``10:248-268``).
+
+Transport/clock/sleep are injectable; ``max_iterations`` makes the infinite
+reference loop testable and cron-able.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable
+
+from bs4 import BeautifulSoup
+
+from advanced_scrapper_tpu.storage.stores import ArticleStore, LinkStore
+
+DEFAULT_TOPIC_URL = "https://finance.yahoo.com/topic/crypto/"
+
+
+def extract_topic_links(html: str) -> list[str]:
+    """All hrefs passing the reference link filter (ref 04:74-75)."""
+    soup = BeautifulSoup(html, "html.parser")
+    out = []
+    for a in soup.find_all("a", href=True):
+        link = a["href"]
+        if "/news/" in link and ".html" in link and "https:" in link:
+            out.append(link)
+    return out
+
+
+def poll_links(
+    store: LinkStore,
+    transport,
+    *,
+    topic_url: str = DEFAULT_TOPIC_URL,
+    interval: float = 3.0,       # ref 04 polls every 3 s
+    max_iterations: int | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+    on_new: Callable[[list[str]], None] | None = None,
+) -> int:
+    """Poll loop; returns total NEW links discovered."""
+    total_new = 0
+    i = 0
+    while max_iterations is None or i < max_iterations:
+        i += 1
+        try:
+            html = transport.fetch(topic_url)
+            links = extract_topic_links(html)
+            before = set(store.unscraped())
+            new = store.add_links(links)
+            total_new += new
+            if new and on_new is not None:
+                fresh = [u for u in store.unscraped() if u not in before]
+                on_new(fresh)
+        except Exception as e:
+            print(f"poll error: {e}")
+        if max_iterations is None or i < max_iterations:
+            sleep(interval)
+    return total_new
+
+
+def drain_unscraped(
+    link_store: LinkStore,
+    article_store: ArticleStore,
+    transport,
+    extractor: Callable,
+    *,
+    max_rounds: int = 1,
+    sleep: Callable[[float], None] = time.sleep,
+    round_interval: float = 15.0,  # ref 10 re-queues unscraped every pass
+) -> int:
+    """Scrape every unscraped link into the article store; failed links stay
+    flagged unscraped and are retried next round (ref 10:248-268)."""
+    stored = 0
+    for r in range(max_rounds):
+        todo = link_store.unscraped()
+        if not todo:
+            break
+        for url in todo:
+            try:
+                html = transport.fetch(url)
+                data = extractor(BeautifulSoup(html, "html.parser"))
+                if not data.get("title"):
+                    continue  # stays unscraped → retried
+                article_store.store(url, data)
+                stored += 1
+            except Exception as e:
+                print(f"drain error for {url}: {e}")
+        if r < max_rounds - 1 and link_store.unscraped():
+            sleep(round_interval)
+    return stored

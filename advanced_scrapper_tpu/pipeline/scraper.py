@@ -134,7 +134,17 @@ class ScraperEngine:
         data["url"] = url
         return ("success", data)
 
-    def _worker(self, url_q: queue.Queue, result_q: queue.Queue) -> None:
+    def _worker(
+        self,
+        url_q: queue.Queue,
+        result_q: queue.Queue,
+        worker_stop: threading.Event | None = None,
+    ) -> None:
+        def stopped() -> bool:
+            return self._stop.is_set() or (
+                worker_stop is not None and worker_stop.is_set()
+            )
+
         try:
             transport = self.transport_factory()
         except Exception as e:
@@ -142,11 +152,15 @@ class ScraperEngine:
             self._stop.set()
             return
         try:
-            while not self._stop.is_set():
+            while not stopped():
                 try:
                     url = url_q.get(timeout=0.1)
                 except queue.Empty:
                     continue
+                # honour the circuit breaker at the worker too: in elastic
+                # modes there is no feeder to gate admission, so this is the
+                # only place the pause can take effect
+                self.pause.wait(sleep=self.sleep, should_stop=stopped)
                 try:
                     html = transport.fetch(url)
                     kind, payload = self._classify(url, html)
@@ -217,6 +231,7 @@ class ScraperEngine:
         initial_total: int | None = None,
         already_scraped: int = 0,
         show_stats: bool = False,
+        mode: str = "fixed",
     ) -> ScrapeSummary:
         summary = ScrapeSummary(
             total_urls=len(urls), already_scraped=already_scraped
@@ -227,14 +242,51 @@ class ScraperEngine:
         url_q: queue.Queue = queue.Queue()
         result_q: queue.Queue = queue.Queue()
 
-        workers = [
-            threading.Thread(target=self._worker, args=(url_q, result_q), daemon=True)
-            for _ in range(self.cfg.max_threads)
-        ]
-        for w in workers:
-            w.start()
-        feeder = threading.Thread(target=self._feeder, args=(urls, url_q), daemon=True)
-        feeder.start()
+        workers: list[threading.Thread] = []
+        feeder = None
+        pool = None
+        if mode == "fixed":
+            # production design: fixed pool + rate-paced feeder (ref C1)
+            workers = [
+                threading.Thread(
+                    target=self._worker, args=(url_q, result_q), daemon=True
+                )
+                for _ in range(self.cfg.max_threads)
+            ]
+            for w in workers:
+                w.start()
+            feeder = threading.Thread(
+                target=self._feeder, args=(urls, url_q), daemon=True
+            )
+            feeder.start()
+        else:
+            # elastic designs: pre-filled queue, controller-driven pool size
+            # (ref experiental/local_dynamic.py / local_pid.py)
+            from advanced_scrapper_tpu.pipeline.controllers import (
+                ElasticWorkerPool,
+                PController,
+                PIDController,
+                PoolLimits,
+            )
+
+            for u in urls:
+                url_q.put(u)
+            if mode == "elastic-p":
+                controller = PController(self.cfg.desired_request_rate)
+                interval = 0.5  # ref local_dynamic.py:233
+            elif mode == "elastic-pid":
+                controller = PIDController(self.cfg.desired_request_rate)
+                interval = 0.8  # ref local_pid.py:279
+            else:
+                raise ValueError(f"unknown mode '{mode}'")
+            pool = ElasticWorkerPool(
+                controller,
+                self.stats,
+                lambda ev: self._worker(url_q, result_q, ev),
+                limits=PoolLimits(1, self.cfg.max_threads),
+                interval=interval,
+                sleep=self.sleep,
+            ).start()
 
         stats_stop = threading.Event()
         if show_stats:
@@ -288,7 +340,10 @@ class ScraperEngine:
         summary.rate_limit_trips = self.pause.trips
         self._stop.set()
         stats_stop.set()
-        feeder.join(timeout=5)
+        if feeder is not None:
+            feeder.join(timeout=5)
+        if pool is not None:
+            pool.stop()
         for w in workers:
             w.join(timeout=5)
         if self._owns_console:

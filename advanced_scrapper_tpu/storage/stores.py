@@ -1,0 +1,156 @@
+"""SQLite link/article stores with DB-flag resume.
+
+Re-implements the reference's live-poller persistence
+(``experiental/09_btc_links.py:15-27``, ``10_btc_articles.py:48-112``):
+
+- ``links(url PRIMARY KEY, first_seen_utc, first_seen_unix,
+  is_scraped DEFAULT 0)`` — insert-or-ignore discovery; the ``is_scraped``
+  flag is the resume checkpoint (SURVEY.md §5.4 flavor 4);
+- ``articles(url PRIMARY KEY, title, author, datetime_utc, datetime_unix,
+  content, ticker_symbols)`` — upsert + flag flip in one transaction.
+
+A Postgres twin of the link store exists in the reference
+(``04_crypto_1.py:14-34``, ``INSERT … ON CONFLICT DO NOTHING``); psycopg2
+is not available in this environment, so :class:`LinkStore` exposes the same
+interface over SQLite and a Postgres URL raises a clear error.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import time
+from datetime import datetime, timezone
+
+from dateutil import parser as dateparser
+
+
+class LinkStore:
+    """links table: discovery + is_scraped checkpoint."""
+
+    def __init__(self, db_path: str):
+        if db_path.startswith(("postgres://", "postgresql://")):
+            raise RuntimeError(
+                "Postgres link store requires psycopg2, which is not "
+                "installed; use a sqlite path"
+            )
+        self.db_path = db_path
+        with self._conn() as conn:
+            conn.execute(
+                """
+                CREATE TABLE IF NOT EXISTS links (
+                    url TEXT PRIMARY KEY,
+                    first_seen_utc TIMESTAMP,
+                    first_seen_unix INTEGER,
+                    is_scraped INTEGER DEFAULT 0
+                )
+                """
+            )
+
+    def _conn(self) -> sqlite3.Connection:
+        return sqlite3.connect(self.db_path)
+
+    def add_links(self, urls: list[str], now: float | None = None) -> int:
+        """Insert-or-ignore; returns the number of NEW links."""
+        ts = now if now is not None else time.time()
+        utc = datetime.fromtimestamp(ts, timezone.utc).strftime("%Y-%m-%d %H:%M:%S")
+        new = 0
+        with self._conn() as conn:
+            for u in urls:
+                cur = conn.execute(
+                    "INSERT OR IGNORE INTO links (url, first_seen_utc, first_seen_unix)"
+                    " VALUES (?, ?, ?)",
+                    (u, utc, int(ts)),
+                )
+                new += cur.rowcount
+        return new
+
+    def unscraped(self) -> list[str]:
+        with self._conn() as conn:
+            rows = conn.execute("SELECT url FROM links WHERE is_scraped = 0").fetchall()
+        return [r[0] for r in rows]
+
+    def mark_scraped(self, url: str) -> None:
+        with self._conn() as conn:
+            conn.execute("UPDATE links SET is_scraped = 1 WHERE url = ?", (url,))
+
+    def counts(self) -> tuple[int, int]:
+        with self._conn() as conn:
+            total = conn.execute("SELECT COUNT(*) FROM links").fetchone()[0]
+            done = conn.execute(
+                "SELECT COUNT(*) FROM links WHERE is_scraped = 1"
+            ).fetchone()[0]
+        return total, done
+
+
+class ArticleStore:
+    """articles table: extractor-record upsert + link flag flip."""
+
+    def __init__(self, db_path: str):
+        self.db_path = db_path
+        with self._conn() as conn:
+            conn.execute(
+                """
+                CREATE TABLE IF NOT EXISTS articles (
+                    url TEXT PRIMARY KEY,
+                    title TEXT,
+                    author TEXT,
+                    datetime_utc TIMESTAMP,
+                    datetime_unix INTEGER,
+                    content TEXT,
+                    ticker_symbols TEXT
+                )
+                """
+            )
+
+    def _conn(self) -> sqlite3.Connection:
+        return sqlite3.connect(self.db_path)
+
+    def store(self, url: str, data: dict) -> None:
+        """Upsert one extracted record and flip the link flag (ref 10:81-112)."""
+        raw_dt = data.get("datetime") or None
+        dt_utc = dt_unix = None
+        if raw_dt:
+            try:
+                parsed = dateparser.parse(str(raw_dt))
+                dt_utc = parsed.strftime("%Y-%m-%d %H:%M:%S")
+                dt_unix = int(parsed.timestamp())
+            except (ValueError, OverflowError):
+                pass
+        with self._conn() as conn:
+            conn.execute(
+                "INSERT OR REPLACE INTO articles "
+                "(url, title, author, content, datetime_utc, datetime_unix, ticker_symbols)"
+                " VALUES (?, ?, ?, ?, ?, ?, ?)",
+                (
+                    url,
+                    str(data.get("title")) if data.get("title") is not None else None,
+                    str(data.get("author")) if data.get("author") is not None else None,
+                    str(data.get("article")) if data.get("article") is not None else None,
+                    dt_utc,
+                    dt_unix,
+                    json.dumps(data.get("ticker_symbols"))
+                    if data.get("ticker_symbols") is not None
+                    else None,
+                ),
+            )
+            # flip the link flag only when this DB also hosts a links table
+            # (the reference shares one file; independent files are legal here
+            # and must not roll back the article insert)
+            has_links = conn.execute(
+                "SELECT 1 FROM sqlite_master WHERE type='table' AND name='links'"
+            ).fetchone()
+            if has_links:
+                conn.execute("UPDATE links SET is_scraped = 1 WHERE url = ?", (url,))
+
+    def all_texts(self) -> list[tuple[str, str]]:
+        """(url, content) pairs — the cross-source dedup feed."""
+        with self._conn() as conn:
+            rows = conn.execute(
+                "SELECT url, COALESCE(content, '') FROM articles"
+            ).fetchall()
+        return [(r[0], r[1]) for r in rows]
+
+    def count(self) -> int:
+        with self._conn() as conn:
+            return conn.execute("SELECT COUNT(*) FROM articles").fetchone()[0]

@@ -1,0 +1,7 @@
+from advanced_scrapper_tpu.utils.setops import (
+    anti_join_csv,
+    round_robin_split,
+    new_links,
+)
+
+__all__ = ["anti_join_csv", "round_robin_split", "new_links"]

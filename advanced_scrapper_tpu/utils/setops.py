@@ -1,0 +1,62 @@
+"""Dataset set-algebra utilities.
+
+Re-implements the reference's CSV maintenance trio (``experiental/drop.py``,
+``new_links.py``, ``split.py`` — SURVEY.md §2.2 E14):
+
+- :func:`anti_join_csv` — drop urls already present in other CSVs
+  (``drop.py:1-11``, ``new_links.py:23-35``);
+- :func:`round_robin_split` — split a URL list into N worker shards
+  round-robin, after pre-dropping done urls (``split.py:10-31``) — the
+  reference's manual multi-machine data parallelism;
+- :func:`new_links` — write the anti-join result to a new CSV.
+
+Membership checks run through :class:`pipeline.dedup.ExactDedup`'s
+byte-identical guarantee when deduping within the list itself.
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+from advanced_scrapper_tpu.storage.csvio import scraped_url_set
+
+
+def anti_join_csv(
+    input_csv: str, *done_csvs: str, column: str = "url"
+) -> pd.DataFrame:
+    """Rows of ``input_csv`` whose url is in none of ``done_csvs``."""
+    df = pd.read_csv(input_csv)
+    done = scraped_url_set(*done_csvs, column=column)
+    return df[~df[column].astype(str).isin(done)]
+
+
+def new_links(
+    input_csv: str, output_csv: str, *done_csvs: str, column: str = "url"
+) -> int:
+    out = anti_join_csv(input_csv, *done_csvs, column=column)
+    out.to_csv(output_csv, index=False)
+    return len(out)
+
+
+def round_robin_split(
+    input_csv: str,
+    n_parts: int,
+    *done_csvs: str,
+    column: str = "url",
+    output_template: str = "part_{i}.csv",
+) -> list[str]:
+    """Round-robin shard split with pre-drop (ref split.py:18-28).
+
+    Returns the written paths; shard i gets rows i, i+n, i+2n, … of the
+    remaining work list, preserving order within each shard.
+    """
+    if n_parts < 1:
+        raise ValueError("n_parts must be >= 1")
+    df = anti_join_csv(input_csv, *done_csvs, column=column).reset_index(drop=True)
+    paths = []
+    for i in range(n_parts):
+        part = df.iloc[i::n_parts]
+        path = output_template.format(i=i)
+        part.to_csv(path, index=False)
+        paths.append(path)
+    return paths

@@ -1,0 +1,157 @@
+"""HTTP control plane + subprocess pipe pool tests (reference E1/E2/E3/E5)."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from advanced_scrapper_tpu.net.control import ControlPlane, ControlServer
+from advanced_scrapper_tpu.net.transport import MockTransport
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+ARTICLE_HTML = open(os.path.join(FIXTURES, "yfin_article.html")).read()
+
+TEMPLATE = {
+    "title": "div.cover-title",
+    "date": {"selector": "time", "attribute": "datetime", "index": [0]},
+    "author": "div.byline-attr-author",
+    "article": "div.body",
+}
+
+
+def _post(url, obj):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(), headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture()
+def server(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    plane = ControlPlane(
+        lambda: MockTransport(lambda u: ARTICLE_HTML),
+        templates_path=str(tmp_path / "templates.json"),
+        out_root=str(tmp_path),
+    )
+    srv = ControlServer(plane).start()
+    yield srv
+    srv.stop()
+
+
+def test_add_template_and_sync_extract(server, tmp_path):
+    base = f"http://127.0.0.1:{server.port}"
+    code, resp = _post(f"{base}/add_template", {"name": "ysite", "template": TEMPLATE})
+    assert code == 200 and resp["message"] == "Template added successfully"
+    assert os.path.isdir(tmp_path / "ysite")               # output folder (ref :38)
+    assert json.load(open(tmp_path / "templates.json"))["ysite"] == TEMPLATE
+
+    url = "https://finance.yahoo.com/news/apple-q3.html"
+    code, data = _post(
+        f"{base}/extract_and_get_article", {"url": url, "template": "ysite"}
+    )
+    assert code == 200
+    assert data["title"] == "Apple Reports Record Q3 iPhone Revenue"
+    assert data["date"] == ["2024-05-14T13:30:00.000Z"]
+    assert "html_source" not in data                       # persisted, not returned
+    saved = tmp_path / "ysite" / "apple-q3.html.html"
+    assert saved.exists() and "cover-title" in saved.read_text()
+
+
+def test_process_url_returns_html_source(server):
+    base = f"http://127.0.0.1:{server.port}"
+    _post(f"{base}/add_template", {"name": "t2", "template": TEMPLATE})
+    code, data = _post(
+        f"{base}/process_url", {"url": "https://x/a.html", "template": "t2"}
+    )
+    assert code == 200 and "cover-title" in data["html_source"]  # ref 00_worker:66
+
+
+def test_async_submit_poll_flow(server):
+    base = f"http://127.0.0.1:{server.port}"
+    _post(f"{base}/add_template", {"name": "t3", "template": TEMPLATE})
+    code, resp = _post(
+        f"{base}/extract_and_get_article",
+        {"url": "https://x/b.html", "template": "t3", "async": True},
+    )
+    assert code == 200 and "request_id" in resp            # ref 08_test:55-57
+    rid = resp["request_id"]
+    for _ in range(100):
+        code, result = _get(f"{base}/get_result/{rid}")
+        if code == 200:
+            break
+        assert code == 202
+        time.sleep(0.05)
+    assert result["title"].startswith("Apple")
+    assert _get(f"{base}/get_result/nope")[0] == 404
+
+
+def test_http_error_paths(server):
+    base = f"http://127.0.0.1:{server.port}"
+    code, resp = _post(f"{base}/extract_and_get_article", {"url": "https://x"})
+    assert code == 400                                      # missing template field
+    code, resp = _post(f"{base}/nope", {})
+    assert code == 404
+
+
+def test_pipe_pool_end_to_end():
+    from advanced_scrapper_tpu.net.pipe_pool import PipePool
+
+    urls = [f"https://x/{i}.html" for i in range(5)]
+    pages = {u: ARTICLE_HTML for u in urls[:4]}  # one url has no fixture → error
+    pool = PipePool(
+        num_workers=2,
+        config={"transport": "mock", "pages": pages, "website": "yfin"},
+    ).start()
+    try:
+        for u in urls:
+            assert pool.dispatch(u, timeout=30)
+        out = pool.drain(5, timeout=60)
+    finally:
+        pool.stop()
+    oks = [o for o in out if "title" in o]
+    errs = [o for o in out if "error" in o]
+    assert len(oks) == 4 and len(errs) == 1
+    assert all(o["title"].startswith("Apple") for o in oks)
+    assert "no fixture" in errs[0]["error"]
+
+
+def test_template_name_traversal_rejected(server, tmp_path):
+    base = f"http://127.0.0.1:{server.port}"
+    code, resp = _post(
+        f"{base}/add_template", {"name": "../evil", "template": TEMPLATE}
+    )
+    assert code == 400
+    assert not (tmp_path.parent / "evil").exists()
+
+
+def test_shutdown_closes_transports(tmp_path):
+    closed = []
+
+    class T(MockTransport):
+        def __init__(self):
+            super().__init__(lambda u: ARTICLE_HTML)
+
+        def close(self):
+            closed.append(1)
+
+    plane = ControlPlane(T, templates_path=str(tmp_path / "t.json"),
+                         out_root=str(tmp_path))
+    plane.add_template("x", TEMPLATE)
+    plane.extract("https://a/b.html", "x")
+    plane.shutdown()
+    assert closed == [1]

@@ -1,0 +1,135 @@
+"""Elastic rate-controller tests (reference E11/E12 semantics)."""
+
+import os
+import threading
+import time
+
+from advanced_scrapper_tpu.config import ScraperConfig
+from advanced_scrapper_tpu.net.transport import MockTransport
+from advanced_scrapper_tpu.obs.stats import StatsTracker
+from advanced_scrapper_tpu.pipeline.controllers import (
+    ElasticWorkerPool,
+    PController,
+    PIDController,
+    PoolLimits,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+ARTICLE_HTML = open(os.path.join(FIXTURES, "yfin_article.html")).read()
+
+
+def test_p_controller_gain():
+    c = PController(setpoint=7.0, kp=0.5)  # ref local_dynamic.py:19,200
+    assert c.compute(actual_rate=1.0) == 3.0
+    assert c.compute(actual_rate=9.0) == -1.0
+
+
+def test_pid_asymmetric_gains():
+    clk = iter([0.0, 1.0, 2.0, 3.0]).__next__
+    c = PIDController(setpoint=8.0, kp_accel=0.5, kp_decel=1.0, clock=clk)
+    # below target → accel gains (ref local_pid.py:62-66)
+    assert c.compute(actual_rate=4.0) == 0.5 * 4.0
+    # above target → decel gains push back twice as hard (ref :68-72)
+    assert c.compute(actual_rate=10.0) == 1.0 * -2.0
+
+
+def test_pid_integral_accumulates_wall_time():
+    clk = iter([0.0, 2.0]).__next__
+    c = PIDController(setpoint=5.0, kp_accel=0.0, ki_accel=1.0, clock=clk)
+    c.compute(actual_rate=5.0)           # error 0, dt 0 → integral 0
+    assert c.compute(actual_rate=3.0) == 2.0 * 2.0  # error 2 · dt 2
+
+
+def test_elastic_pool_grows_and_caps():
+    stats = StatsTracker(window=10.0, clock=lambda: 100.0)  # rate always 0
+    pool = ElasticWorkerPool(
+        PController(setpoint=20.0, kp=0.5),
+        stats,
+        lambda ev: ev.wait(5),
+        limits=PoolLimits(1, 4),
+    )
+    pool._spawn_initial = None
+    with pool._lock:
+        pool._spawn()
+    assert pool.size == 1
+    pool.step()  # error 20 → +10 threads, capped at 4
+    assert pool.size == 4
+    pool.stop()
+    assert pool.size == 0
+
+
+def test_elastic_pool_shrinks_to_floor():
+    class Hot:
+        def get_actual_rate(self):
+            return 100.0
+
+    pool = ElasticWorkerPool(
+        PController(setpoint=1.0, kp=0.5),
+        Hot(),
+        lambda ev: ev.wait(5),
+        limits=PoolLimits(1, 8),
+    )
+    with pool._lock:
+        for _ in range(6):
+            pool._spawn()
+    pool.step()  # error -99 → huge negative, floored at 1
+    assert pool.size == 1
+    pool.stop()
+
+
+def test_engine_elastic_pid_mode_end_to_end(tmp_path):
+    from advanced_scrapper_tpu.extractors import load_extractor
+    from advanced_scrapper_tpu.pipeline.scraper import ScraperEngine
+
+    urls = [f"https://x/{i}.html" for i in range(12)]
+    pages = {u: ARTICLE_HTML for u in urls}
+    cfg = ScraperConfig(
+        desired_request_rate=500.0, max_threads=4, rate_limit_wait=0.2,
+        result_timeout=10.0,
+    )
+    transport = MockTransport(pages)
+    eng = ScraperEngine(cfg, load_extractor("yfin"), lambda: transport)
+    s = eng.run(
+        urls,
+        str(tmp_path / "ok.csv"),
+        str(tmp_path / "bad.csv"),
+        mode="elastic-pid",
+    )
+    assert s.succeeded == 12 and s.failed == 0
+
+
+def test_engine_rejects_unknown_mode(tmp_path):
+    import pytest
+
+    from advanced_scrapper_tpu.extractors import load_extractor
+    from advanced_scrapper_tpu.pipeline.scraper import ScraperEngine
+
+    cfg = ScraperConfig(result_timeout=1.0)
+    eng = ScraperEngine(cfg, load_extractor("yfin"), lambda: MockTransport({}))
+    with pytest.raises(ValueError):
+        eng.run(["u"], str(tmp_path / "a.csv"), str(tmp_path / "b.csv"), mode="warp")
+
+
+def test_elastic_mode_honours_rate_limit_pause(tmp_path):
+    """Workers must gate on the circuit breaker in elastic modes too."""
+    from advanced_scrapper_tpu.extractors import load_extractor
+    from advanced_scrapper_tpu.pipeline.scraper import ScraperEngine
+
+    RATE_LIMIT_HTML = open(
+        os.path.join(FIXTURES, "yfin_rate_limited.html")
+    ).read()
+    urls = [f"https://x/{i}.html" for i in range(6)]
+    pages = {u: ARTICLE_HTML for u in urls}
+    pages[urls[0]] = RATE_LIMIT_HTML
+    cfg = ScraperConfig(
+        desired_request_rate=500.0, max_threads=2, rate_limit_wait=0.5,
+        result_timeout=15.0,
+    )
+    transport = MockTransport(pages)
+    eng = ScraperEngine(cfg, load_extractor("yfin"), lambda: transport)
+    t0 = time.time()
+    s = eng.run(urls, str(tmp_path / "o.csv"), str(tmp_path / "b.csv"),
+                mode="elastic-p")
+    assert s.rate_limit_trips >= 1
+    assert s.succeeded == 5
+    assert time.time() - t0 >= 0.5  # the pause actually held the workers

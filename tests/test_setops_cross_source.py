@@ -1,0 +1,98 @@
+"""CSV set-algebra (reference E14) + cross-source dedup (BASELINE config 5)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+
+from advanced_scrapper_tpu.config import DedupConfig
+from advanced_scrapper_tpu.pipeline.cross_source import cross_source_dedup, load_source
+from advanced_scrapper_tpu.storage.stores import ArticleStore, LinkStore
+from advanced_scrapper_tpu.utils.setops import (
+    anti_join_csv,
+    new_links,
+    round_robin_split,
+)
+
+
+def _urls_csv(path, urls, extra_col=False):
+    df = pd.DataFrame({"url": urls})
+    if extra_col:
+        df["date_time"] = range(len(urls))
+    df.to_csv(path, index=False)
+
+
+def test_anti_join_and_new_links(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    _urls_csv("all.csv", [f"u{i}" for i in range(10)], extra_col=True)
+    _urls_csv("done1.csv", ["u1", "u3"])
+    _urls_csv("done2.csv", ["u5"])
+    out = anti_join_csv("all.csv", "done1.csv", "done2.csv")
+    assert out["url"].tolist() == ["u0", "u2", "u4", "u6", "u7", "u8", "u9"]
+    n = new_links("all.csv", "fresh.csv", "done1.csv", "done2.csv")
+    assert n == 7
+    assert pd.read_csv("fresh.csv")["date_time"].tolist() == [0, 2, 4, 6, 7, 8, 9]
+
+
+def test_round_robin_split_with_predrop(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    _urls_csv("all.csv", [f"u{i}" for i in range(9)])
+    _urls_csv("done.csv", ["u0"])
+    paths = round_robin_split("all.csv", 3, "done.csv")
+    assert paths == ["part_0.csv", "part_1.csv", "part_2.csv"]
+    parts = [pd.read_csv(p)["url"].tolist() for p in paths]
+    # remaining u1..u8 dealt round-robin (ref split.py:22-28)
+    assert parts[0] == ["u1", "u4", "u7"]
+    assert parts[1] == ["u2", "u5", "u8"]
+    assert parts[2] == ["u3", "u6"]
+    # shards are disjoint and cover everything
+    flat = sorted(u for p in parts for u in p)
+    assert flat == [f"u{i}" for i in range(1, 9)]
+
+
+def test_load_source_csv_and_sqlite(tmp_path):
+    csv_path = str(tmp_path / "success_articles_yfin.csv")
+    pd.DataFrame(
+        [{"url": "https://a/1.html", "article": "csv body text"}]
+    ).to_csv(csv_path, index=False)
+    db_path = str(tmp_path / "crypto_news.db")
+    LinkStore(db_path).add_links(["https://b/2.html"], now=1.0)
+    ArticleStore(db_path).store(
+        "https://b/2.html", {"title": "t", "article": "db body text"}
+    )
+    docs_csv = load_source(csv_path)
+    docs_db = load_source(db_path)
+    assert docs_csv[0].text == "csv body text"
+    assert docs_db[0].text == "db body text"
+
+
+def test_cross_source_dedup_collapses_across_sources(tmp_path):
+    rng = np.random.RandomState(0)
+    body = bytes(rng.randint(32, 127, size=400, dtype=np.uint8)).decode()
+    other = bytes(rng.randint(32, 127, size=400, dtype=np.uint8)).decode()
+    near = body[:390] + "EDITEDXYZ!"
+    csv_path = str(tmp_path / "yahoo.csv")
+    pd.DataFrame(
+        [
+            {"url": "https://y/1.html", "article": body},
+            {"url": "https://y/2.html", "article": other},
+        ]
+    ).to_csv(csv_path, index=False)
+    db_path = str(tmp_path / "btc.db")
+    LinkStore(db_path)
+    arts = ArticleStore(db_path)
+    arts.store("https://b/syndicated.html", {"title": "t", "article": near})
+    arts.store("https://y/1.html", {"title": "t", "article": body})  # exact url dup
+
+    out_csv = str(tmp_path / "manifest.csv")
+    stats = cross_source_dedup(
+        [csv_path, db_path], out_csv, cfg=DedupConfig(batch_size=2, block_len=512)
+    )
+    assert stats["total"] == 4
+    assert stats["kept"] == 2
+    assert stats["exact_dups"] == 1      # same url in csv and db
+    assert stats["near_dups"] == 1       # syndicated copy caught across sources
+    manifest = pd.read_csv(out_csv)
+    syndicated = manifest[manifest.url == "https://b/syndicated.html"].iloc[0]
+    assert syndicated["status"] == "near_dup"
+    assert syndicated["dup_of"] == "https://y/1.html"

@@ -1,0 +1,120 @@
+"""SQLite store + live poller tests (reference E6/E7 semantics)."""
+
+import json
+import os
+import sqlite3
+
+import pytest
+
+from advanced_scrapper_tpu.extractors import load_extractor
+from advanced_scrapper_tpu.net.transport import FetchError, MockTransport
+from advanced_scrapper_tpu.pipeline.poller import (
+    drain_unscraped,
+    extract_topic_links,
+    poll_links,
+)
+from advanced_scrapper_tpu.storage.stores import ArticleStore, LinkStore
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+ARTICLE_HTML = open(os.path.join(FIXTURES, "yfin_article.html")).read()
+
+TOPIC_HTML = """
+<html><body><div id="Fin-Stream">
+  <a href="https://finance.yahoo.com/news/btc-surges-123.html">BTC surges</a>
+  <a href="https://finance.yahoo.com/news/eth-dips-456.html?src=rss">ETH dips</a>
+  <a href="/news/relative-link.html">relative (no https)</a>
+  <a href="https://finance.yahoo.com/videos/not-news.html">video</a>
+  <a href="https://finance.yahoo.com/news/no-extension">no .html</a>
+</div></body></html>
+"""
+
+
+def test_extract_topic_links_reference_filter():
+    links = extract_topic_links(TOPIC_HTML)
+    assert links == [
+        "https://finance.yahoo.com/news/btc-surges-123.html",
+        "https://finance.yahoo.com/news/eth-dips-456.html?src=rss",
+    ]
+
+
+def test_link_store_insert_ignore_and_flag(tmp_path):
+    db = str(tmp_path / "news.db")
+    store = LinkStore(db)
+    assert store.add_links(["u1", "u2"], now=1000.0) == 2
+    assert store.add_links(["u2", "u3"], now=1001.0) == 1  # u2 ignored
+    assert sorted(store.unscraped()) == ["u1", "u2", "u3"]
+    store.mark_scraped("u2")
+    assert sorted(store.unscraped()) == ["u1", "u3"]
+    assert store.counts() == (3, 1)
+    # schema matches the reference (09_btc_links.py:19-25)
+    cols = [r[1] for r in sqlite3.connect(db).execute("PRAGMA table_info(links)")]
+    assert cols == ["url", "first_seen_utc", "first_seen_unix", "is_scraped"]
+
+
+def test_link_store_rejects_postgres_url():
+    with pytest.raises(RuntimeError):
+        LinkStore("postgresql://localhost/crypto")
+
+
+def test_poll_links_accumulates_and_notifies(tmp_path):
+    db = str(tmp_path / "news.db")
+    store = LinkStore(db)
+    calls = []
+    t = MockTransport(lambda u: TOPIC_HTML)
+    new = poll_links(
+        store, t, max_iterations=3, sleep=lambda s: calls.append(s),
+        on_new=lambda fresh: calls.append(tuple(sorted(fresh))),
+    )
+    assert new == 2                      # discovered once, ignored afterwards
+    assert len(t.fetched) == 3           # polled 3 times
+    assert any(isinstance(c, tuple) for c in calls)
+
+
+def test_poll_links_survives_fetch_errors(tmp_path):
+    store = LinkStore(str(tmp_path / "n.db"))
+    flaky = iter([FetchError("boom"), TOPIC_HTML])
+
+    def pages(url):
+        item = next(flaky)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    new = poll_links(store, MockTransport(pages), max_iterations=2, sleep=lambda s: None)
+    assert new == 2
+
+
+def test_drain_unscraped_stores_articles_and_retries(tmp_path):
+    db = str(tmp_path / "news.db")
+    links = LinkStore(db)
+    arts = ArticleStore(db)
+    links.add_links(["https://x/good.html", "https://x/bad.html"], now=1.0)
+    pages = {"https://x/good.html": ARTICLE_HTML}  # bad.html missing → error
+    stored = drain_unscraped(
+        links, arts, MockTransport(pages), load_extractor("yfin"),
+        max_rounds=2, sleep=lambda s: None,
+    )
+    assert stored == 1
+    assert links.unscraped() == ["https://x/bad.html"]  # retried forever
+    rows = arts.all_texts()
+    assert rows[0][0] == "https://x/good.html"
+    assert "record revenue" in rows[0][1]
+    # ticker symbols stored as JSON (ref 10:90)
+    conn = sqlite3.connect(db)
+    ts = conn.execute("SELECT ticker_symbols FROM articles").fetchone()[0]
+    assert json.loads(ts) == ["AAPL", "MSFT"]
+    assert conn.execute("SELECT datetime_unix FROM articles").fetchone()[0] > 0
+
+
+def test_article_store_independent_db_files(tmp_path):
+    """ArticleStore in its own file (no links table) must still store."""
+    links = LinkStore(str(tmp_path / "links.db"))
+    arts = ArticleStore(str(tmp_path / "articles.db"))
+    links.add_links(["https://x/a.html"], now=1.0)
+    stored = drain_unscraped(
+        links, arts, MockTransport({"https://x/a.html": ARTICLE_HTML}),
+        load_extractor("yfin"), max_rounds=1, sleep=lambda s: None,
+    )
+    assert stored == 1 and arts.count() == 1
+    # link flag lives in the other DB: stays unscraped there (documented)
+    assert links.unscraped() == ["https://x/a.html"]
