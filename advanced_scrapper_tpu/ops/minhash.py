@@ -87,7 +87,18 @@ def minhash_signatures(
 
     Rows with fewer than k valid bytes yield all-``U32_MAX`` signatures;
     callers must mask them out of LSH (``lsh.duplicate_reps(valid=...)``).
+
+    ``ASTPU_MINHASH_BACKEND=pallas`` swaps in the fused Pallas kernel
+    (``ops/pallas_minhash.py``) — bit-identical output, measured slower on
+    v5e, kept as the hand-written reference for the op.
     """
+    from advanced_scrapper_tpu.ops.pallas_minhash import (
+        minhash_signatures_pallas,
+        pallas_enabled,
+    )
+
+    if pallas_enabled() and params.num_perm == 128:
+        return minhash_signatures_pallas(tokens, lengths, params)
     return _signatures_impl(
         tokens,
         lengths,

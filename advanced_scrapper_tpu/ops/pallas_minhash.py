@@ -1,0 +1,198 @@
+"""Fused Pallas TPU kernel for the MinHash hot loop.
+
+The XLA path (``ops/minhash.py``) expresses shingle-hash → permute → min as a
+``lax.scan`` and relies on fusion.  This kernel fuses the whole signature
+computation for a batch tile inside VMEM: the k-byte rolling FNV-1a hash, the
+128-lane multiply-add permutation family, the validity mask, and the running
+per-permutation minimum — one HBM read of the byte tile, one HBM write of the
+``uint32[Bt, 128]`` signature tile, nothing materialised in between.
+
+Layout notes (see /opt/skills/guides/pallas_guide.md):
+- the permutation axis is exactly 128 — one full VPU lane dimension; the
+  running minimum ``[Bt, 128]`` is a stack of native (8, 128) vregs.
+- tokens arrive as ``uint8[Bt, L + LANE]`` (callers pad the byte axis by one
+  128-lane so every k-window read is in bounds); uint8 VMEM tiles are
+  (32, 128), hence the default batch tile of 32 rows.
+- the shingle axis is processed in ``chunk``-sized pieces; the peak live
+  intermediate is ``uint32[Bt, chunk, 128]`` which the VPU reduces along the
+  sublane-tiled middle axis.
+
+This replaces the CPU hot loop the reference runs inside pandas/rapidfuzz
+(``yahoo_links_selenium.py:79``, ``match_keywords.py:165-180``) — see
+SURVEY.md §6 (north-star 50k articles/s) for why this is the framework's
+flagship op.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from advanced_scrapper_tpu.core.hashing import MinHashParams
+
+# Python-int twins of ops.shingle's constants: pallas kernels may not capture
+# traced jnp scalars, so the kernel builds its constants from literals.
+_FNV_OFFSET = 0x811C9DC5
+_FNV_PRIME = 0x01000193
+_U32_MAX = 0xFFFFFFFF
+
+LANE = 128
+_NUM_PERM = 128
+
+
+def _fmix32(h):
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def _minhash_kernel(len_ref, tok_ref, a_ref, b_ref, sig_ref, h_ref, big_ref, *, k, chunk, L):
+    """One batch tile: tokens ``uint8[Bt, L+LANE]`` → sig ``uint32[Bt, 128]``."""
+    Bt = tok_ref.shape[0]
+    tok = tok_ref[:, :].astype(jnp.uint32)  # [Bt, L+LANE]
+
+    # Rolling FNV-1a over the k-byte window at every position 0..L-1.  The
+    # window is unrolled (k static, tiny); positions past the text end are
+    # killed by the validity mask below.
+    h = jnp.full((Bt, L), _FNV_OFFSET, dtype=jnp.uint32)
+    for j in range(k):
+        h = (h ^ jax.lax.slice(tok, (0, j), (Bt, j + L))) * jnp.uint32(_FNV_PRIME)
+    h_ref[:, :] = _fmix32(h)
+
+    lens = len_ref[:, 0]  # int32[Bt]
+    n_valid = jnp.maximum(lens - (k - 1), 0)  # shingle count per row
+    pos = jax.lax.broadcasted_iota(jnp.int32, (Bt, L), 1)
+    # 0/1 validity as int32: Mosaic cannot broadcast an i1 mask into a new
+    # minor dim, so the loop body masks arithmetically.
+    big_ref[:, :] = (pos < n_valid[:, None]).astype(jnp.int32)
+
+    a = a_ref[0, :]  # uint32[128]
+    b = b_ref[0, :]
+
+    # Chunked min-reduction.  Staging h/valid through VMEM scratch lets the
+    # loop body slice them dynamically (ref indexing supports dynamic starts
+    # where value-level dynamic_slice does not) and bounds live intermediates
+    # to one [Bt, chunk, 128] block.  Mosaic lacks unsigned reductions, so
+    # minima run sign-flipped (x ^ 0x80000000 maps unsigned order to signed
+    # order); the flip is undone on the final store.
+    sign = jnp.uint32(0x80000000)
+    i32_max = jnp.iinfo(jnp.int32).max
+
+    def body(c, sig):
+        off = c * chunk
+        hc = h_ref[:, pl.ds(off, chunk)]
+        vci = big_ref[:, pl.ds(off, chunk)]  # int32 0/1
+        ph = hc[:, :, None] * a[None, None, :] + b[None, None, :]
+        phs = jax.lax.bitcast_convert_type(ph ^ sign, jnp.int32)
+        # valid → phs, invalid → INT32_MAX (identity of min)
+        phs = phs * vci[:, :, None] + ((1 - vci) * i32_max)[:, :, None]
+        return jnp.minimum(sig, phs.min(axis=1))
+
+    sig = jnp.full((Bt, _NUM_PERM), i32_max, dtype=jnp.int32)
+    sig = jax.lax.fori_loop(0, L // chunk, body, sig)
+    sig_ref[:, :] = jax.lax.bitcast_convert_type(sig, jnp.uint32) ^ sign
+
+
+@partial(jax.jit, static_argnames=("k", "chunk", "block_b", "interpret"))
+def _pallas_signatures(
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    *,
+    k: int,
+    chunk: int,
+    block_b: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    B, Lp = tokens.shape
+    L = Lp - LANE
+    grid = (B // block_b,)
+    kernel = partial(_minhash_kernel, k=k, chunk=chunk, L=L)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_b, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block_b, Lp), lambda i: (i, 0)),
+            pl.BlockSpec((1, _NUM_PERM), lambda i: (0, 0)),
+            pl.BlockSpec((1, _NUM_PERM), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_b, _NUM_PERM), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, _NUM_PERM), jnp.uint32),
+        scratch_shapes=[
+            pltpu.VMEM((block_b, L), jnp.uint32),
+            pltpu.VMEM((block_b, L), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(lengths.reshape(B, 1).astype(jnp.int32), tokens, a.reshape(1, -1), b.reshape(1, -1))
+
+
+def minhash_signatures_pallas(
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    params: MinHashParams,
+    *,
+    chunk: int = 128,
+    block_b: int = 32,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Pallas twin of :func:`ops.minhash.minhash_signatures`.
+
+    Pads the batch up to a ``block_b`` multiple and the byte axis by one
+    128-lane (so every k-window read is in bounds), launches the fused
+    kernel, and slices the padding back off.  Bit-identical to the XLA path.
+    """
+    if params.num_perm != _NUM_PERM:
+        raise ValueError(f"pallas kernel is specialised to 128 perms, got {params.num_perm}")
+    B, L = tokens.shape
+    if L % LANE:
+        tokens = jnp.pad(tokens, ((0, 0), (0, LANE - L % LANE)))
+        L = tokens.shape[1]
+    # Largest LANE-multiple divisor of L not exceeding the requested chunk.
+    m = L // LANE
+    d = min(chunk // LANE, m)
+    while m % d:
+        d -= 1
+    chunk = d * LANE
+    pb = -(-B // block_b) * block_b - B
+    if pb:
+        tokens = jnp.pad(tokens, ((0, pb), (0, 0)))
+        lengths = jnp.pad(lengths, ((0, pb),))
+    tokens = jnp.pad(tokens, ((0, 0), (0, LANE)))
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    sig = _pallas_signatures(
+        tokens,
+        lengths,
+        jnp.asarray(params.a32),
+        jnp.asarray(params.b32),
+        k=params.shingle_k,
+        chunk=chunk,
+        block_b=block_b,
+        interpret=interpret,
+    )
+    return sig[:B] if pb else sig
+
+
+def pallas_enabled() -> bool:
+    """Whether the fused kernel is the preferred signature backend.
+
+    Off by default: on v5e the XLA scan path measures faster (the fused
+    kernel pays a lane-broadcast relayout per chunk that XLA's fusion
+    avoids; see 2026-07 measurements in the repo docs) — the kernel is kept
+    as a measured alternative and a Pallas reference for the op.  Force with
+    ``ASTPU_MINHASH_BACKEND=pallas``.
+    """
+    return os.environ.get("ASTPU_MINHASH_BACKEND", "").lower() == "pallas"
