@@ -97,7 +97,12 @@ class ControlPlane:
     def _persist_html(self, url: str, template_name: str, data: dict) -> dict:
         html = data.pop("html_source", "")
         slug = os.path.basename(url.split("?")[0].rstrip("/")) or "index"
-        path = os.path.join(self.out_root, template_name, f"{slug}.html")
+        out_dir = os.path.join(self.out_root, template_name)
+        # Templates loaded from a pre-existing templates.json (register_all on
+        # restart) never went through add_template, so their folder may not
+        # exist yet.
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, f"{slug}.html")
         with open(path, "w", encoding="utf-8") as f:
             f.write(html)
         return data

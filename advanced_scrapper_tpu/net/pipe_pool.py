@@ -66,6 +66,18 @@ class PipePool:
                 if self._busy[idx]:
                     self._busy[idx] = False
                     self._free.release()
+        # Stream EOF: the worker died (or stop() closed it).  Only the stdout
+        # reader reclaims the slot — doing it from both readers would release
+        # the semaphore twice.  A worker that crashed mid-task surfaces as an
+        # error result so drain() callers are not left one item short.
+        if sink is self.results:
+            with self._lock:
+                if self._busy[idx]:
+                    self._busy[idx] = False
+                    self._free.release()
+                    self.errors.put(
+                        {"error": "worker exited mid-task", "worker": idx}
+                    )
 
     def dispatch(self, url: str, timeout: float = 60.0) -> bool:
         """Hand one URL to an idle worker (blocks for one to free up)."""
@@ -91,6 +103,8 @@ class PipePool:
         while len(out) < n and time.monotonic() < deadline:
             got = False
             for q in (self.results, self.errors):
+                if len(out) >= n:
+                    break
                 try:
                     out.append(q.get(timeout=0.05))
                     got = True

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
+from typing import Iterator
 
 from advanced_scrapper_tpu.config import DedupConfig
 from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
@@ -31,20 +32,44 @@ class SourceDoc:
     text: str
 
 
-def load_source(path: str) -> list[SourceDoc]:
-    """A source is a success CSV (url/article columns) or a sqlite DB."""
+def load_source(path: str) -> Iterator[SourceDoc]:
+    """A source is a success CSV (url/article columns) or a sqlite DB.
+
+    Yields lazily so the host never materialises a whole corpus.
+    """
     name = os.path.basename(path)
     if path.endswith((".db", ".sqlite", ".sqlite3")):
         store = ArticleStore(path)
-        return [SourceDoc(name, url, text) for url, text in store.all_texts()]
+        for url, text in store.all_texts():
+            yield SourceDoc(name, url, text)
+        return
     import csv as _csv
 
-    out = []
     with open(path, newline="", encoding="utf-8") as f:
         for row in _csv.DictReader(f):
             text = row.get("article") or row.get("article_text") or ""
-            out.append(SourceDoc(name, str(row.get("url", "")), text))
-    return out
+            yield SourceDoc(name, str(row.get("url", "")), text)
+
+
+def _write_rec(rec: dict, stats: dict, out: AppendCsv) -> None:
+    src = rec.get("_source", "")
+    s = stats["by_source"].setdefault(src, {"total": 0, "kept": 0, "dups": 0})
+    s["total"] += 1
+    if rec.get("dup_of"):
+        status, ref = "exact_dup", rec["dup_of"]
+        stats["exact_dups"] += 1
+        s["dups"] += 1
+    elif rec.get("near_dup_of"):
+        status, ref = "near_dup", rec["near_dup_of"]
+        stats["near_dups"] += 1
+        s["dups"] += 1
+    else:
+        status, ref = "keep", ""
+        stats["kept"] += 1
+        s["kept"] += 1
+    out.write_row(
+        {"url": rec.get("url", ""), "source": src, "status": status, "dup_of": ref}
+    )
 
 
 def cross_source_dedup(
@@ -53,43 +78,28 @@ def cross_source_dedup(
     *,
     cfg: DedupConfig | None = None,
 ) -> dict:
-    """Dedup across sources → manifest CSV + per-source stats dict."""
+    """Dedup across sources → manifest CSV + per-source stats dict.
+
+    Documents stream source-by-source into the batch backend and manifest
+    rows are written as each device batch resolves, so host memory is
+    O(batch), not O(corpus).  The manifest describes exactly this run: a
+    stale file at ``output_csv`` is truncated, not appended to.
+    """
     cfg = cfg or DedupConfig()
-    docs: list[SourceDoc] = []
-    for s in sources:
-        docs.extend(load_source(s))
+    if os.path.exists(output_csv):
+        os.remove(output_csv)
 
     backend = TpuBatchBackend(cfg)
-    processed: list[dict] = []
-    for d in docs:
-        processed += backend.submit(
-            {"url": d.url, "article": d.text, "_source": d.source}
-        )
-    processed += backend.flush()
-
-    stats: dict = {"total": len(docs), "kept": 0, "exact_dups": 0, "near_dups": 0,
+    stats: dict = {"total": 0, "kept": 0, "exact_dups": 0, "near_dups": 0,
                    "by_source": {}}
     with AppendCsv(output_csv, ["url", "source", "status", "dup_of"]) as out:
-        for rec in processed:
-            src = rec.get("_source", "")
-            s = stats["by_source"].setdefault(
-                src, {"total": 0, "kept": 0, "dups": 0}
-            )
-            s["total"] += 1
-            if rec.get("dup_of"):
-                status, ref = "exact_dup", rec["dup_of"]
-                stats["exact_dups"] += 1
-                s["dups"] += 1
-            elif rec.get("near_dup_of"):
-                status, ref = "near_dup", rec["near_dup_of"]
-                stats["near_dups"] += 1
-                s["dups"] += 1
-            else:
-                status, ref = "keep", ""
-                stats["kept"] += 1
-                s["kept"] += 1
-            out.write_row(
-                {"url": rec.get("url", ""), "source": src, "status": status,
-                 "dup_of": ref}
-            )
+        for src_path in sources:
+            for d in load_source(src_path):
+                stats["total"] += 1
+                for rec in backend.submit(
+                    {"url": d.url, "article": d.text, "_source": d.source}
+                ):
+                    _write_rec(rec, stats, out)
+        for rec in backend.flush():
+            _write_rec(rec, stats, out)
     return stats

@@ -56,7 +56,9 @@ def poll_links(
         try:
             html = transport.fetch(topic_url)
             links = extract_topic_links(html)
-            before = set(store.unscraped())
+            # the before/after table scans exist only to tell on_new which
+            # urls were fresh — skip both when nobody is listening
+            before = set(store.unscraped()) if on_new is not None else set()
             new = store.add_links(links)
             total_new += new
             if new and on_new is not None:

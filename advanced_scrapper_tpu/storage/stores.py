@@ -143,13 +143,15 @@ class ArticleStore:
             if has_links:
                 conn.execute("UPDATE links SET is_scraped = 1 WHERE url = ?", (url,))
 
-    def all_texts(self) -> list[tuple[str, str]]:
-        """(url, content) pairs — the cross-source dedup feed."""
+    def all_texts(self):
+        """Yield (url, content) pairs — the cross-source dedup feed.
+
+        Lazy: rows stream off the sqlite cursor so a multi-GB store never
+        materialises on the host at once.
+        """
         with self._conn() as conn:
-            rows = conn.execute(
-                "SELECT url, COALESCE(content, '') FROM articles"
-            ).fetchall()
-        return [(r[0], r[1]) for r in rows]
+            for r in conn.execute("SELECT url, COALESCE(content, '') FROM articles"):
+                yield (r[0], r[1])
 
     def count(self) -> int:
         with self._conn() as conn:

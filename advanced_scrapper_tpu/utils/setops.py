@@ -52,6 +52,11 @@ def round_robin_split(
     """
     if n_parts < 1:
         raise ValueError("n_parts must be >= 1")
+    if n_parts > 1 and output_template.format(i=0) == output_template.format(i=1):
+        raise ValueError(
+            f"output_template {output_template!r} has no '{{i}}' placeholder — "
+            "all shards would overwrite the same file"
+        )
     df = anti_join_csv(input_csv, *done_csvs, column=column).reset_index(drop=True)
     paths = []
     for i in range(n_parts):

@@ -155,3 +155,33 @@ def test_shutdown_closes_transports(tmp_path):
     plane.extract("https://a/b.html", "x")
     plane.shutdown()
     assert closed == [1]
+
+
+def test_pipe_pool_reclaims_slot_on_worker_crash():
+    """A worker that dies mid-task must free its pool slot (busy cleared,
+    semaphore released) and surface an error record, not leak the slot."""
+    import time as _time
+
+    from advanced_scrapper_tpu.net.pipe_pool import PipePool
+
+    pool = PipePool(
+        num_workers=1,
+        config={"transport": "mock", "pages": {}, "website": "yfin"},
+    ).start()
+    try:
+        # Occupy the only slot, then kill the worker before it can answer.
+        # (The mock transport errors instantly, so pre-kill the process and
+        # dispatch into the doomed pipe instead.)
+        proc = pool._procs[0]
+        assert pool.dispatch("https://x/a.html", timeout=10)
+        proc.kill()
+        proc.wait(timeout=10)
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline and pool._busy[0]:
+            _time.sleep(0.05)
+        assert not pool._busy[0], "slot still marked busy after worker death"
+        # the freed permit must be re-acquirable without the full timeout
+        assert pool._free.acquire(timeout=5)
+        pool._free.release()
+    finally:
+        pool.stop()

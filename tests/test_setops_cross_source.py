@@ -60,8 +60,8 @@ def test_load_source_csv_and_sqlite(tmp_path):
     ArticleStore(db_path).store(
         "https://b/2.html", {"title": "t", "article": "db body text"}
     )
-    docs_csv = load_source(csv_path)
-    docs_db = load_source(db_path)
+    docs_csv = list(load_source(csv_path))
+    docs_db = list(load_source(db_path))
     assert docs_csv[0].text == "csv body text"
     assert docs_db[0].text == "db body text"
 
@@ -96,3 +96,31 @@ def test_cross_source_dedup_collapses_across_sources(tmp_path):
     syndicated = manifest[manifest.url == "https://b/syndicated.html"].iloc[0]
     assert syndicated["status"] == "near_dup"
     assert syndicated["dup_of"] == "https://y/1.html"
+
+
+def test_round_robin_split_rejects_template_without_placeholder(tmp_path):
+    import pandas as pd
+    import pytest as _pytest
+
+    from advanced_scrapper_tpu.utils.setops import round_robin_split
+
+    src = str(tmp_path / "in.csv")
+    pd.DataFrame([{"url": f"https://x/{i}"} for i in range(4)]).to_csv(src, index=False)
+    with _pytest.raises(ValueError, match="placeholder"):
+        round_robin_split(src, 2, output_template=str(tmp_path / "parts.csv"))
+
+
+def test_cross_source_dedup_manifest_is_truncated_on_rerun(tmp_path):
+    import pandas as pd
+
+    from advanced_scrapper_tpu.pipeline.cross_source import cross_source_dedup
+
+    csv_path = str(tmp_path / "yahoo.csv")
+    pd.DataFrame(
+        [{"url": "https://a/1.html", "article": "x" * 300}]
+    ).to_csv(csv_path, index=False)
+    out = str(tmp_path / "manifest.csv")
+    cross_source_dedup([csv_path], out)
+    first = open(out).read()
+    cross_source_dedup([csv_path], out)
+    assert open(out).read() == first  # no stale appended rows

@@ -96,7 +96,7 @@ def test_drain_unscraped_stores_articles_and_retries(tmp_path):
     )
     assert stored == 1
     assert links.unscraped() == ["https://x/bad.html"]  # retried forever
-    rows = arts.all_texts()
+    rows = list(arts.all_texts())
     assert rows[0][0] == "https://x/good.html"
     assert "record revenue" in rows[0][1]
     # ticker symbols stored as JSON (ref 10:90)
