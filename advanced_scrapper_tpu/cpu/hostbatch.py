@@ -1,0 +1,318 @@
+"""Host feed queue + batcher bindings (``native/hostbatch.cpp``).
+
+:class:`HostBatcher` is the CPU→TPU boundary of the streaming pipelines: the
+fetch/extract side pushes variable-length byte documents (with a uint64 tag
+the caller uses to map rows back to records), the device side pops
+zero-padded ``uint8[batch, block]`` tiles ready for ``jax.device_put``.
+Assembly is native C++ (memcpy/memset under one mutex) per SURVEY.md §7.3's
+"host queue + batcher implemented in C++"; a pure-Python twin with the same
+API keeps the framework importable without a compiler, and
+:data:`hostbatch_backend` reports which is live.
+
+Backpressure: ``push`` returns False when the doc or arena cap is hit —
+producers block/drop by policy, the queue never grows unbounded (the
+reference's unbounded ``queue.Queue`` at ``constant_rate_scrapper.py:146``
+could).
+"""
+
+from __future__ import annotations
+
+import collections
+import ctypes
+import os
+import subprocess
+import threading
+import time
+from typing import Iterable
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native", "hostbatch.cpp")
+_LIB = os.path.join(os.path.dirname(_SRC), "libhostbatch.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_backend = "unloaded"
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _backend
+    with _lock:
+        if _backend != "unloaded":
+            return _lib
+        needs_build = (not os.path.exists(_LIB)) or (
+            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        )
+        if needs_build and not _build():
+            _backend = "python"
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _backend = "python"
+            return None
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.hb_create.restype = ctypes.c_void_p
+        lib.hb_create.argtypes = [ctypes.c_long, ctypes.c_long]
+        lib.hb_push.restype = ctypes.c_int
+        lib.hb_push.argtypes = [ctypes.c_void_p, u8p, ctypes.c_long, ctypes.c_uint64]
+        lib.hb_pop_batch.restype = ctypes.c_long
+        lib.hb_pop_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+            u8p, ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        for name in ("hb_size", "hb_arena_used"):
+            getattr(lib, name).restype = ctypes.c_long
+            getattr(lib, name).argtypes = [ctypes.c_void_p]
+        lib.hb_closed.restype = ctypes.c_int
+        lib.hb_closed.argtypes = [ctypes.c_void_p]
+        for name in ("hb_stat_pushed", "hb_stat_popped", "hb_stat_rejected"):
+            getattr(lib, name).restype = ctypes.c_uint64
+            getattr(lib, name).argtypes = [ctypes.c_void_p]
+        lib.hb_close.restype = None
+        lib.hb_close.argtypes = [ctypes.c_void_p]
+        lib.hb_destroy.restype = None
+        lib.hb_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        _backend = "native"
+        return lib
+
+
+def hostbatch_backend() -> str:
+    """'native' or 'python' (after first use)."""
+    _load()
+    return _backend
+
+
+def _enc(doc: str | bytes) -> bytes:
+    return doc if isinstance(doc, bytes) else doc.encode("utf-8", "replace")
+
+
+class _NativeBatcher:
+    def __init__(self, lib: ctypes.CDLL, max_docs: int, arena_bytes: int):
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.hb_create(max_docs, arena_bytes))
+
+    def push(self, doc: bytes, tag: int) -> bool:
+        buf = (ctypes.c_uint8 * len(doc)).from_buffer_copy(doc) if doc else None
+        return bool(self._lib.hb_push(self._h, buf, len(doc), tag))
+
+    def pop_batch(self, batch: int, block: int, timeout_ms: int):
+        tokens = np.zeros((batch, block), dtype=np.uint8)
+        lengths = np.zeros((batch,), dtype=np.int32)
+        tags = np.zeros((batch,), dtype=np.uint64)
+        n = self._lib.hb_pop_batch(
+            self._h, batch, block, timeout_ms,
+            tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            tags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        )
+        return int(n), tokens, lengths, tags
+
+    def size(self) -> int:
+        return int(self._lib.hb_size(self._h))
+
+    def arena_used(self) -> int:
+        return int(self._lib.hb_arena_used(self._h))
+
+    def stats(self) -> dict:
+        return {
+            "pushed": int(self._lib.hb_stat_pushed(self._h)),
+            "popped": int(self._lib.hb_stat_popped(self._h)),
+            "rejected": int(self._lib.hb_stat_rejected(self._h)),
+        }
+
+    def closed(self) -> bool:
+        return bool(self._lib.hb_closed(self._h))
+
+    def close(self) -> None:
+        self._lib.hb_close(self._h)
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.hb_destroy(self._h)
+            self._h = None
+
+
+class _PyBatcher:
+    """Pure-Python twin of the native queue (same semantics, for fallback
+    and as the behavioural oracle in tests)."""
+
+    def __init__(self, max_docs: int, arena_bytes: int):
+        self._max_docs = max_docs if max_docs > 0 else float("inf")
+        self._arena_cap = arena_bytes if arena_bytes > 0 else float("inf")
+        self._q: collections.deque[tuple[bytes, int]] = collections.deque()
+        self._arena = 0
+        self._cv = threading.Condition()
+        self._closed = False
+        self._pushed = self._popped = self._rejected = 0
+
+    def push(self, doc: bytes, tag: int) -> bool:
+        with self._cv:
+            if (
+                self._closed
+                or len(self._q) >= self._max_docs
+                or self._arena + len(doc) > self._arena_cap
+            ):
+                self._rejected += 1
+                return False
+            self._q.append((doc, tag))
+            self._arena += len(doc)
+            self._pushed += 1
+            self._cv.notify()
+            return True
+
+    def pop_batch(self, batch: int, block: int, timeout_ms: int):
+        tokens = np.zeros((batch, block), dtype=np.uint8)
+        lengths = np.zeros((batch,), dtype=np.int32)
+        tags = np.zeros((batch,), dtype=np.uint64)
+        with self._cv:
+            if not self._q and not self._closed and timeout_ms != 0:
+                deadline = None if timeout_ms < 0 else time.monotonic() + timeout_ms / 1e3
+                while not self._q and not self._closed:
+                    remaining = None if deadline is None else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        return 0, tokens, lengths, tags
+                    self._cv.wait(remaining)
+            n = 0
+            while n < batch and self._q:
+                doc, tag = self._q.popleft()
+                self._arena -= len(doc)
+                self._popped += 1
+                copy = min(len(doc), block)
+                if copy:
+                    tokens[n, :copy] = np.frombuffer(doc[:copy], dtype=np.uint8)
+                lengths[n] = copy
+                tags[n] = tag
+                n += 1
+        return n, tokens, lengths, tags
+
+    def size(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    def arena_used(self) -> int:
+        with self._cv:
+            return self._arena
+
+    def stats(self) -> dict:
+        with self._cv:
+            return {
+                "pushed": self._pushed,
+                "popped": self._popped,
+                "rejected": self._rejected,
+            }
+
+    def closed(self) -> bool:
+        with self._cv:
+            return self._closed
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    def destroy(self) -> None:
+        pass
+
+
+class HostBatcher:
+    """The CPU→TPU feed queue (native C++ when a compiler is available).
+
+    Args:
+      block: byte length of each token row (documents truncate here).
+      max_docs: queue capacity in documents (<=0 → unbounded).
+      arena_bytes: total buffered-byte cap (<=0 → unbounded).
+      prefer_native: force the pure-Python twin with False.
+    """
+
+    def __init__(
+        self,
+        block: int,
+        *,
+        max_docs: int = 65536,
+        arena_bytes: int = 1 << 30,
+        prefer_native: bool = True,
+    ):
+        self.block = block
+        lib = _load() if prefer_native else None
+        if lib is not None:
+            self._impl = _NativeBatcher(lib, max_docs, arena_bytes)
+            self.backend = "native"
+        else:
+            self._impl = _PyBatcher(max_docs, arena_bytes)
+            self.backend = "python"
+
+    def push(self, doc: str | bytes, tag: int) -> bool:
+        """Queue one document; False = backpressure (caller retries/drops)."""
+        return self._impl.push(_enc(doc), tag)
+
+    def push_blocking(
+        self, doc: str | bytes, tag: int, *, poll_s: float = 0.005, timeout_s: float = 60.0
+    ) -> bool:
+        """Push with bounded blocking backpressure."""
+        data = _enc(doc)
+        deadline = time.monotonic() + timeout_s
+        while not self._impl.push(data, tag):
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+        return True
+
+    def pop_batch(
+        self, batch: int, *, timeout_ms: int = -1
+    ) -> tuple[int, np.ndarray, np.ndarray, np.ndarray]:
+        """Pop ≤``batch`` docs as ``(n, tokens[batch, block], lengths, tags)``.
+
+        Blocks up to ``timeout_ms`` for the first document (−1 = forever,
+        0 = no wait) then drains greedily; rows past ``n`` are zero padding.
+        ``n == 0`` means timeout or closed-and-empty.
+        """
+        return self._impl.pop_batch(batch, self.block, timeout_ms)
+
+    def feed(
+        self, docs: Iterable[str | bytes], *, start_tag: int = 0, timeout_s: float = 60.0
+    ) -> int:
+        """Convenience: push an iterable with sequential tags; returns count."""
+        n = 0
+        for i, doc in enumerate(docs, start=start_tag):
+            if not self.push_blocking(doc, i, timeout_s=timeout_s):
+                break
+            n += 1
+        return n
+
+    def size(self) -> int:
+        return self._impl.size()
+
+    def arena_used(self) -> int:
+        return self._impl.arena_used()
+
+    def stats(self) -> dict:
+        return self._impl.stats()
+
+    def closed(self) -> bool:
+        return self._impl.closed()
+
+    def close(self) -> None:
+        """Stop accepting pushes; wake blocked pops (they drain then return 0)."""
+        self._impl.close()
+
+    def __enter__(self) -> "HostBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+        self._impl.destroy()

@@ -1,0 +1,158 @@
+// Host-side feed queue + fixed-shape batch assembler.
+//
+// The TPU-era successor of the reference's url_queue/result_queue plumbing
+// (constant_rate_scrapper.py:146,437-469) and the C++ "host queue + batcher"
+// SURVEY.md §7.3 mandates: producers (fetch/extract threads) push
+// variable-length byte documents; the consumer pops fixed-shape
+// uint8[batch, block] tiles with lengths + caller tags, zero-padded, ready
+// for jax.device_put.  Batch assembly is memset+memcpy here so the Python
+// feed thread does no per-document work at pop time.
+//
+// Concurrency: MPMC under one mutex (the critical sections are memcpys of
+// ~1 KB documents — far from contended at the 50k docs/s north star);
+// condvar wakeups for blocking pops; a byte-arena cap bounds host memory and
+// gives natural backpressure (push returns 0; callers decide to block/drop).
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Doc {
+  std::vector<uint8_t> bytes;
+  uint64_t tag;
+};
+
+struct HostBatch {
+  std::mutex mu;
+  std::condition_variable not_empty;
+  std::deque<Doc> q;
+  size_t max_docs;
+  size_t arena_cap;
+  size_t arena_used = 0;
+  uint64_t pushed = 0;
+  uint64_t popped = 0;
+  uint64_t rejected = 0;
+  bool closed = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hb_create(long max_docs, long arena_bytes) {
+  auto* h = new HostBatch();
+  h->max_docs = max_docs > 0 ? static_cast<size_t>(max_docs) : SIZE_MAX;
+  h->arena_cap = arena_bytes > 0 ? static_cast<size_t>(arena_bytes) : SIZE_MAX;
+  return h;
+}
+
+// 1 = accepted; 0 = queue full (backpressure) or closed.
+int hb_push(void* hp, const uint8_t* data, long len, uint64_t tag) {
+  auto* h = static_cast<HostBatch*>(hp);
+  if (len < 0) return 0;
+  std::lock_guard<std::mutex> lk(h->mu);
+  if (h->closed || h->q.size() >= h->max_docs ||
+      h->arena_used + static_cast<size_t>(len) > h->arena_cap) {
+    h->rejected++;
+    return 0;
+  }
+  h->q.push_back(Doc{std::vector<uint8_t>(data, data + len), tag});
+  h->arena_used += static_cast<size_t>(len);
+  h->pushed++;
+  h->not_empty.notify_one();
+  return 1;
+}
+
+// Fill up to `batch` rows of out_tokens (uint8[batch, block_len], zero-padded),
+// out_lengths (int32[batch], truncated at block_len), out_tags
+// (uint64[batch]).  Blocks up to timeout_ms for the FIRST document (0 = no
+// wait, <0 = wait forever), then drains without waiting.  Returns rows
+// filled; 0 means timeout or closed-and-empty.
+long hb_pop_batch(void* hp, long batch, long block_len, long timeout_ms,
+                  uint8_t* out_tokens, int32_t* out_lengths,
+                  uint64_t* out_tags) {
+  auto* h = static_cast<HostBatch*>(hp);
+  if (batch <= 0 || block_len <= 0) return 0;
+  std::unique_lock<std::mutex> lk(h->mu);
+  if (h->q.empty() && !h->closed) {
+    if (timeout_ms == 0) return 0;
+    auto ready = [h] { return !h->q.empty() || h->closed; };
+    if (timeout_ms < 0) {
+      h->not_empty.wait(lk, ready);
+    } else if (!h->not_empty.wait_for(
+                   lk, std::chrono::milliseconds(timeout_ms), ready)) {
+      return 0;
+    }
+  }
+  long n = 0;
+  const size_t block = static_cast<size_t>(block_len);
+  while (n < batch && !h->q.empty()) {
+    Doc& d = h->q.front();
+    const size_t len = d.bytes.size();
+    const size_t copy = len < block ? len : block;
+    uint8_t* row = out_tokens + static_cast<size_t>(n) * block;
+    if (copy) std::memcpy(row, d.bytes.data(), copy);
+    if (copy < block) std::memset(row + copy, 0, block - copy);
+    out_lengths[n] = static_cast<int32_t>(copy);
+    out_tags[n] = d.tag;
+    h->arena_used -= len;
+    h->popped++;
+    h->q.pop_front();
+    n++;
+  }
+  return n;
+}
+
+long hb_size(void* hp) {
+  auto* h = static_cast<HostBatch*>(hp);
+  std::lock_guard<std::mutex> lk(h->mu);
+  return static_cast<long>(h->q.size());
+}
+
+long hb_arena_used(void* hp) {
+  auto* h = static_cast<HostBatch*>(hp);
+  std::lock_guard<std::mutex> lk(h->mu);
+  return static_cast<long>(h->arena_used);
+}
+
+uint64_t hb_stat_pushed(void* hp) {
+  auto* h = static_cast<HostBatch*>(hp);
+  std::lock_guard<std::mutex> lk(h->mu);
+  return h->pushed;
+}
+
+uint64_t hb_stat_popped(void* hp) {
+  auto* h = static_cast<HostBatch*>(hp);
+  std::lock_guard<std::mutex> lk(h->mu);
+  return h->popped;
+}
+
+uint64_t hb_stat_rejected(void* hp) {
+  auto* h = static_cast<HostBatch*>(hp);
+  std::lock_guard<std::mutex> lk(h->mu);
+  return h->rejected;
+}
+
+int hb_closed(void* hp) {
+  auto* h = static_cast<HostBatch*>(hp);
+  std::lock_guard<std::mutex> lk(h->mu);
+  return h->closed ? 1 : 0;
+}
+
+// After close: pushes fail, blocked pops wake, pops drain the remainder.
+void hb_close(void* hp) {
+  auto* h = static_cast<HostBatch*>(hp);
+  std::lock_guard<std::mutex> lk(h->mu);
+  h->closed = true;
+  h->not_empty.notify_all();
+}
+
+void hb_destroy(void* hp) { delete static_cast<HostBatch*>(hp); }
+
+}  // extern "C"

@@ -1,0 +1,150 @@
+"""Native host batcher (C++ ring/batcher) + streaming device feed tests.
+
+The C++ queue (``native/hostbatch.cpp``) and its pure-Python twin must agree
+on semantics: fixed-shape zero-padded tiles, truncation at the block length,
+tag passthrough, backpressure on doc/arena caps, and close-then-drain.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from advanced_scrapper_tpu.cpu.hostbatch import HostBatcher, hostbatch_backend
+
+
+@pytest.fixture(params=[True, False], ids=["native", "python"])
+def batcher_factory(request):
+    if request.param and hostbatch_backend() != "native":
+        pytest.skip("no C++ toolchain")
+
+    def make(block=64, **kw):
+        return HostBatcher(block, prefer_native=request.param, **kw)
+
+    return make
+
+
+def test_fixed_shape_zero_padded_tiles(batcher_factory):
+    b = batcher_factory(block=16)
+    assert b.push(b"hello", 7)
+    assert b.push("x" * 40, 8)  # truncates at block
+    assert b.push(b"", 9)       # empty doc is a valid row
+    n, tok, lens, tags = b.pop_batch(4, timeout_ms=0)
+    assert n == 3
+    assert tok.shape == (4, 16) and tok.dtype == np.uint8
+    assert lens.tolist() == [5, 16, 0, 0]
+    assert tags.tolist() == [7, 8, 9, 0]
+    assert bytes(tok[0, :5]) == b"hello"
+    assert (tok[0, 5:] == 0).all() and (tok[2] == 0).all()
+    assert bytes(tok[1]) == b"x" * 16
+
+
+def test_fifo_order_and_partial_drain(batcher_factory):
+    b = batcher_factory(block=8)
+    for i in range(5):
+        assert b.push(f"doc{i}", i)
+    n1, _, _, tags1 = b.pop_batch(3, timeout_ms=0)
+    n2, _, _, tags2 = b.pop_batch(3, timeout_ms=0)
+    assert (n1, n2) == (3, 2)
+    assert tags1[:3].tolist() == [0, 1, 2] and tags2[:2].tolist() == [3, 4]
+    assert b.size() == 0
+
+
+def test_backpressure_doc_and_arena_caps(batcher_factory):
+    b = batcher_factory(block=8, max_docs=2, arena_bytes=1 << 20)
+    assert b.push(b"a", 0) and b.push(b"b", 1)
+    assert not b.push(b"c", 2)  # doc cap
+    assert b.stats()["rejected"] == 1
+
+    b2 = batcher_factory(block=8, max_docs=100, arena_bytes=10)
+    assert b2.push(b"12345", 0)
+    assert not b2.push(b"123456", 1)  # would exceed 10-byte arena
+    assert b2.push(b"12345", 2)
+    assert b2.arena_used() == 10
+    b2.pop_batch(2, timeout_ms=0)
+    assert b2.arena_used() == 0
+
+
+def test_close_wakes_and_drains(batcher_factory):
+    b = batcher_factory(block=8)
+    b.push(b"last", 1)
+    b.close()
+    assert b.closed()
+    assert not b.push(b"late", 2)  # closed rejects
+    n, _, _, tags = b.pop_batch(4, timeout_ms=-1)
+    assert n == 1 and tags[0] == 1
+    # closed + empty: blocking pop returns 0 immediately instead of hanging
+    n, *_ = b.pop_batch(4, timeout_ms=-1)
+    assert n == 0
+
+
+def test_blocking_pop_wakes_on_push(batcher_factory):
+    b = batcher_factory(block=8)
+    got = {}
+
+    def consumer():
+        got["res"] = b.pop_batch(2, timeout_ms=5000)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    b.push(b"wake", 42)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    n, _, _, tags = got["res"]
+    assert n == 1 and tags[0] == 42
+
+
+def test_concurrent_producers_no_loss(batcher_factory):
+    b = batcher_factory(block=8, max_docs=10000)
+    N, P = 500, 4
+
+    def produce(base):
+        for i in range(N):
+            assert b.push_blocking(f"d{base + i}", base + i)
+
+    threads = [threading.Thread(target=produce, args=(p * N,)) for p in range(P)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    seen = []
+    while True:
+        n, _, _, tags = b.pop_batch(128, timeout_ms=0)
+        if n == 0:
+            break
+        seen.extend(tags[:n].tolist())
+    assert sorted(seen) == list(range(N * P))
+
+
+def test_stream_signatures_matches_direct_path():
+    """The firehose path must produce the same signatures as the direct
+    kernel on the same (truncated) bytes, with tags mapping rows back."""
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.core.hashing import make_params
+    from advanced_scrapper_tpu.core.tokenizer import encode_batch
+    from advanced_scrapper_tpu.ops.minhash import minhash_signatures
+    from advanced_scrapper_tpu.pipeline.feed import stream_signatures
+
+    rng = np.random.RandomState(0)
+    docs = [
+        bytes(rng.randint(32, 127, size=rng.randint(0, 300), dtype=np.uint8))
+        for _ in range(70)
+    ]
+    cfg = DedupConfig(block_len=128, batch_size=16)
+    params = make_params(
+        num_perm=cfg.num_perm, num_bands=cfg.num_bands,
+        shingle_k=cfg.shingle_k, seed=cfg.seed,
+    )
+    out = {}
+    for tags, sigs, keys in stream_signatures(docs, cfg=cfg):
+        assert keys.shape[1] == cfg.num_bands
+        for t, s in zip(tags.tolist(), sigs):
+            out[t] = s
+    assert sorted(out) == list(range(len(docs)))
+
+    tok, lens = encode_batch(docs, 128)
+    ref = np.asarray(minhash_signatures(tok, lens, params))
+    for i in range(len(docs)):
+        assert np.array_equal(out[i], ref[i]), f"doc {i} signature mismatch"
