@@ -163,6 +163,55 @@ def _cmd_split(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_smoke(args: argparse.Namespace) -> int:
+    """Environment sanity check (ref experiental/gdriver_test.py:1-13):
+    device backend, native host kernels, transport, and one tiny dedup."""
+    import numpy as np
+
+    report: dict = {}
+    ok = True
+    try:
+        import jax
+
+        devs = jax.devices()
+        report["jax"] = {
+            "version": jax.__version__,
+            "platform": devs[0].platform,
+            "devices": len(devs),
+        }
+    except Exception as e:
+        report["jax"] = {"error": str(e)}
+        ok = False
+    from advanced_scrapper_tpu.cpu.hostbatch import hostbatch_backend
+    from advanced_scrapper_tpu.cpu.native import _load as _fm_load
+    from advanced_scrapper_tpu.cpu import native as _fm
+
+    _fm_load()
+    report["native"] = {"fastmatch": _fm.BACKEND, "hostbatch": hostbatch_backend()}
+    try:
+        from advanced_scrapper_tpu.net.transport import make_transport
+
+        t = make_transport(args.transport, pages={"https://smoke/x": "<html></html>"})
+        t.fetch("https://smoke/x") if args.transport == "mock" else None
+        t.close()
+        report["transport"] = {args.transport: "ok"}
+    except Exception as e:
+        report["transport"] = {args.transport: f"error: {e}"}
+        ok = False
+    try:
+        from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+        reps = NearDupEngine().dedup_reps(["smoke test article body", "smoke test article body", "other"])
+        assert reps.tolist()[1] == 0
+        report["dedup"] = {"reps": np.asarray(reps).tolist()}
+    except Exception as e:
+        report["dedup"] = {"error": str(e)}
+        ok = False
+    report["ok"] = ok
+    print(json.dumps(report, indent=2))
+    return 0 if ok else 1
+
+
 def _cmd_xdedup(args: argparse.Namespace) -> int:
     from advanced_scrapper_tpu.pipeline.cross_source import cross_source_dedup
 
@@ -231,6 +280,10 @@ def build_parser() -> argparse.ArgumentParser:
     xd.add_argument("sources", nargs="+")
     xd.add_argument("-o", "--output", default="xdedup_manifest.csv")
     xd.set_defaults(fn=_cmd_xdedup)
+
+    sm = sub.add_parser("smoke", help="environment sanity check (device, native, transport)")
+    sm.add_argument("--transport", default="mock")
+    sm.set_defaults(fn=_cmd_smoke)
 
     return p
 

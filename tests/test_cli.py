@@ -1,0 +1,56 @@
+"""CLI surface tests (``astpu`` subcommands).
+
+The reference has no CLI (SURVEY.md §5.6 — module constants only); these
+cover the subcommand wiring end-to-end with mock transports and tmp files.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pandas as pd
+import pytest
+
+from advanced_scrapper_tpu.cli import main
+
+
+def test_version_and_config(capsys):
+    assert main(["version"]) == 0
+    out = capsys.readouterr().out.strip()
+    assert out.count(".") >= 1
+    assert main(["config"]) == 0
+    cfg = json.loads(capsys.readouterr().out)
+    assert cfg["dedup"]["num_perm"] == 128
+    assert cfg["scraper"]["desired_request_rate"] == pytest.approx(5.8)  # ref operating point
+
+
+def test_smoke_mock_transport(capsys):
+    assert main(["smoke", "--transport", "mock"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["ok"] is True
+    assert report["dedup"]["reps"][1] == 0  # planted duplicate collapsed
+
+
+def test_dedup_command(tmp_path, capsys):
+    src = tmp_path / "docs.txt"
+    body = "the quick brown fox jumps over the lazy dog " * 5
+    src.write_text(f"{body}\n{body}\nsomething completely different\n")
+    out = tmp_path / "kept.txt"
+    assert main(["dedup", str(src), "-o", str(out)]) == 0
+    kept = out.read_text().splitlines()
+    assert len(kept) == 2  # duplicate line dropped, first-seen kept
+
+
+def test_split_and_new_links(tmp_path, capsys):
+    src = tmp_path / "urls.csv"
+    pd.DataFrame({"url": [f"https://x/{i}" for i in range(6)]}).to_csv(src, index=False)
+    done = tmp_path / "done.csv"
+    pd.DataFrame({"url": ["https://x/0"]}).to_csv(done, index=False)
+    tpl = str(tmp_path / "part_{i}.csv")
+    assert main(["split", str(src), "-n", "2", "--done", str(done), "--template", tpl]) == 0
+    parts = [pd.read_csv(tpl.format(i=i)) for i in range(2)]
+    assert sum(len(p) for p in parts) == 5  # done url pre-dropped
+
+    out = tmp_path / "new.csv"
+    assert main(["new-links", str(src), str(out), str(done)]) == 0
+    assert len(pd.read_csv(out)) == 5
