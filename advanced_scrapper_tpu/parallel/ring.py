@@ -1,0 +1,132 @@
+"""Ring-pass cross-shard dedup — memory-scalable resolution over ICI.
+
+The all-gather path (``parallel/sharded.py``) replicates every shard's band
+keys + signatures on every device: fine at batch sizes where 576 B/article
+× B fits HBM, but the footprint grows with the *global* batch.  This module
+is the ring formulation (the ring-attention pattern applied to dedup): each
+device keeps only its local block and a same-sized transit block that
+rotates around the mesh's data axis via ``lax.ppermute``; after
+``n_shards`` hops every pair of blocks has met.  Peak per-device payload is
+O(local batch) regardless of global batch — only the final 4-byte/row
+representative array is ever globally resolved.
+
+Matching at each hop is sort + searchsorted (the XLA-idiomatic hash join):
+for every band, the transit block's (key, global-index) pairs are sorted so
+the run head at the searchsorted position is the *earliest* global row with
+that band key; signature agreement is verified at meet time, so a
+candidate is only accepted when it is a true near-duplicate
+(``agreement >= threshold``) with a smaller global index.
+
+Semantics match the all-gather path on well-separated corpora (documents
+either near-identical or dissimilar); on borderline-similarity chains the
+two paths may pick different-but-valid representatives, since this path
+verifies every met candidate while the gather path verifies only the
+band-proposed one.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from advanced_scrapper_tpu.core.hashing import MinHashParams
+from advanced_scrapper_tpu.ops.lsh import band_keys
+from advanced_scrapper_tpu.ops.minhash import minhash_signatures
+from advanced_scrapper_tpu.ops.shingle import U32_MAX
+
+
+def _best_match_against_block(
+    keys_l: jnp.ndarray,   # uint32[Bl, nb]  local band keys (invalid → U32_MAX)
+    sig_l: jnp.ndarray,    # uint32[Bl, P]
+    gidx_l: jnp.ndarray,   # int32[Bl]   local global row indices
+    keys_b: jnp.ndarray,   # uint32[Bt, nb]  transit block
+    sig_b: jnp.ndarray,
+    gidx_b: jnp.ndarray,
+    valid_b: jnp.ndarray,  # bool[Bt]
+    threshold: float,
+) -> jnp.ndarray:
+    """int32[Bl]: smallest transit global index that band-collides with the
+    local row AND verifies by signature agreement; own index otherwise."""
+    Bl = keys_l.shape[0]
+    Bt, nb = keys_b.shape
+    big = jnp.iinfo(jnp.int32).max
+    # invalid transit rows can never be representatives
+    gidx_b_eff = jnp.where(valid_b, gidx_b, big)
+    best = jnp.full((Bl,), big, dtype=jnp.int32)
+    rowpos = jnp.arange(Bt, dtype=jnp.int32)
+    for b in range(nb):
+        # sort transit rows by (band key, global idx): the run head at the
+        # searchsorted position is the earliest row with that key
+        sk, sg, sp = jax.lax.sort(
+            (keys_b[:, b], gidx_b_eff, rowpos), dimension=0, num_keys=2
+        )
+        pos = jnp.searchsorted(sk, keys_l[:, b], side="left")
+        pos = jnp.clip(pos, 0, Bt - 1)
+        hit = sk[pos] == keys_l[:, b]
+        cand_gidx = sg[pos]
+        cand_sig = jnp.take(sig_b, sp[pos], axis=0)      # [Bl, P]
+        agree = (sig_l == cand_sig).mean(axis=1)
+        ok = hit & (agree >= threshold) & (cand_gidx < gidx_l)
+        best = jnp.minimum(best, jnp.where(ok, cand_gidx, big))
+    return jnp.where(best == big, gidx_l, best)
+
+
+def make_ring_dedup(
+    mesh: Mesh,
+    params: MinHashParams,
+    *,
+    threshold: float = 0.7,
+    jump_rounds: int = 20,
+):
+    """Build the jitted ring-resolution dedup step for ``mesh``.
+
+    Returns ``step(tokens, lengths) -> rep`` with ``tokens`` sharded on the
+    data axis and ``rep`` the replicated ``int32[B]`` global first-seen
+    representative array (union-find roots after pointer jumping).
+    """
+    data = mesh.axis_names[0]
+    n = mesh.shape[data]
+    salt = jnp.asarray(params.band_salt)
+    k = params.shingle_k
+
+    def local_step(tokens, lengths):
+        # tokens: uint8[Bl, L] local shard
+        Bl = tokens.shape[0]
+        sig = minhash_signatures(tokens, lengths, params)
+        keys = band_keys(sig, salt)
+        valid = lengths >= k
+        keys = jnp.where(valid[:, None], keys, U32_MAX)
+        shard = jax.lax.axis_index(data)
+        gidx = (shard * Bl + jnp.arange(Bl)).astype(jnp.int32)
+
+        perm = [(s, (s + 1) % n) for s in range(n)]
+
+        def hop(_, carry):
+            rep, blk = carry
+            bkeys, bsig, bgidx, bvalid = blk
+            cand = _best_match_against_block(
+                keys, sig, gidx, bkeys, bsig, bgidx, bvalid, threshold
+            )
+            rep = jnp.minimum(rep, cand)
+            blk = tuple(jax.lax.ppermute(x, data, perm) for x in blk)
+            return rep, blk
+
+        init = (gidx, (keys, sig, gidx, valid))
+        rep, _ = jax.lax.fori_loop(0, n, hop, init)
+
+        # Chain resolution on the 4-byte/row rep array only — the heavy
+        # payloads (keys 64 B, sigs 512 B per row) never left the ring.
+        g_rep = jax.lax.all_gather(rep, data, axis=0, tiled=True)
+        for _ in range(jump_rounds):
+            g_rep = jnp.take(g_rep, g_rep)
+        return g_rep
+
+    sharded = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(data, None), P(data)),
+        out_specs=P(None),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
