@@ -11,10 +11,14 @@ wrap-around 32-bit integer multiply, so no 64-bit emulation is needed.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
-FNV_OFFSET = jnp.uint32(0x811C9DC5)
-FNV_PRIME = jnp.uint32(0x01000193)
-U32_MAX = jnp.uint32(0xFFFFFFFF)
+# numpy scalars, not jnp: creating a device array at import time would
+# initialise the XLA backend before jax.distributed.initialize can run
+# (multi-host entry points import this module first).
+FNV_OFFSET = np.uint32(0x811C9DC5)
+FNV_PRIME = np.uint32(0x01000193)
+U32_MAX = np.uint32(0xFFFFFFFF)
 
 
 def fmix32(h: jnp.ndarray) -> jnp.ndarray:

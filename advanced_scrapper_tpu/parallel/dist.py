@@ -1,11 +1,18 @@
-"""Multi-host initialisation.
+"""Multi-host world: initialisation + the global-mesh dedup path.
 
 The reference reaches multiple machines with a hand-rolled TCP protocol and
 manual CSV splits (``server1.py``, ``experiental/split.py``).  The TPU-native
 equivalent is ``jax.distributed``: one process per host, XLA collectives over
 ICI within a slice and DCN across slices.  The host-side work distribution
-(URL leases, requeue-on-disconnect — planned in ``net/``) is separate; this
-module only brings up the device world.
+(URL leases, requeue-on-disconnect — ``net/lease.py``) is separate; this
+module brings up the device world and runs the sharded dedup step over the
+*global* mesh: every host contributes its local batch shard, cross-host
+candidate resolution rides the same ``all_gather``/``psum`` collectives as
+the single-host path (``parallel/sharded.py``), and the replicated outputs
+are addressable on every host.  Exercised for real by
+``tests/test_multihost.py``: two ``jax.distributed`` processes on one box
+(the reference tests its distributed stack the same way — server and client
+both default to localhost, ``server1.py:17-18``).
 """
 
 from __future__ import annotations
@@ -13,6 +20,7 @@ from __future__ import annotations
 import os
 
 import jax
+import numpy as np
 
 
 def initialize_multihost(
@@ -49,3 +57,70 @@ def world_info() -> dict:
         "local_devices": len(jax.local_devices()),
         "global_devices": len(jax.devices()),
     }
+
+
+def global_mesh(n_seq: int = 1):
+    """Mesh over every device in the world (all hosts), data × seq.
+
+    ``jax.devices()`` lists process 0's devices first, so the data axis is
+    process-major: host *p*'s local batch occupies global rows
+    ``[p*B_local, (p+1)*B_local)`` — the index space representative ids
+    refer to.
+    """
+    from advanced_scrapper_tpu.core.mesh import build_mesh
+
+    return build_mesh(-1, n_seq)
+
+
+def distribute_global_batch(tokens, lengths, mesh):
+    """Per-host local batch → global arrays sharded over the data axis.
+
+    Each process passes only its own ``uint8[B_local, L]`` shard; the global
+    batch (``B_local × process_count`` rows, process-major) is assembled
+    without any host ever holding it — the multi-host successor of
+    ``shard_batch`` (and of the reference's manual ``split.py`` sharding).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    data = mesh.axis_names[0]
+    t = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(data, None)), np.asarray(tokens)
+    )
+    l = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P(data)), np.asarray(lengths)
+    )
+    return t, l
+
+
+# step-function cache: keyed on mesh (hashable) + params identity (held
+# strongly via the cached tuple, so the id cannot be recycled while cached)
+# + kwargs — same pattern as parallel.sharded._SEQ_KERNEL_CACHE.
+_DEDUP_STEP_CACHE: dict = {}
+
+
+def multihost_dedup(local_tokens, local_lengths, params, mesh=None, **kw):
+    """Global first-seen dedup across all hosts' local batches.
+
+    Runs ``parallel.sharded.make_sharded_dedup`` over the global mesh:
+    signatures/band keys are computed shard-local, candidate resolution
+    ``all_gather``\\ s the compact summaries across hosts (DCN), and the
+    bucket histogram merges with ``psum``.  Returns host-local numpy
+    ``(rep, hist)`` — identical on every host (replicated outputs).
+    ``rep[i]`` indexes the process-major global batch (see
+    :func:`global_mesh`).
+    """
+    from advanced_scrapper_tpu.parallel.sharded import make_sharded_dedup
+
+    if mesh is None:
+        mesh = global_mesh()
+    t, l = distribute_global_batch(local_tokens, local_lengths, mesh)
+    key = (mesh, id(params), tuple(sorted(kw.items())))
+    entry = _DEDUP_STEP_CACHE.get(key)
+    if entry is None:
+        entry = (make_sharded_dedup(mesh, params, **kw), params)
+        _DEDUP_STEP_CACHE[key] = entry
+    rep, hist = entry[0](t, l)
+    return (
+        np.asarray(jax.device_get(rep.addressable_data(0))),
+        np.asarray(jax.device_get(hist.addressable_data(0))),
+    )

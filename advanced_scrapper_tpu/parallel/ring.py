@@ -15,7 +15,11 @@ for every band, the transit block's (key, global-index) pairs are sorted so
 the run head at the searchsorted position is the *earliest* global row with
 that band key; signature agreement is verified at meet time, so a
 candidate is only accepted when it is a true near-duplicate
-(``agreement >= threshold``) with a smaller global index.
+(``agreement >= threshold``) with a smaller global index.  Sort order is a
+property of the block and invariant under rotation, so each block is sorted
+*once* (one batched multi-operand ``lax.sort`` over all bands) before
+entering the ring and the sorted arrays rotate — hops do only searchsorted
+joins, no sorting.
 
 Semantics match the all-gather path on well-separated corpora (documents
 either near-identical or dissimilar); on borderline-similarity chains the
@@ -36,39 +40,55 @@ from advanced_scrapper_tpu.ops.minhash import minhash_signatures
 from advanced_scrapper_tpu.ops.shingle import U32_MAX
 
 
-def _best_match_against_block(
+def _presort_bands(keys: jnp.ndarray, gidx_eff: jnp.ndarray):
+    """Per-band sort of a block's (key, global-index, row) triples.
+
+    ``keys`` is ``uint32[Bt, nb]`` (invalid rows already ``U32_MAX``),
+    ``gidx_eff`` is ``int32[Bt]`` with invalid rows set to int32-max so they
+    sort last and can never head a run.  One batched multi-operand sort over
+    the band axis; returns ``(sk, sg, sp)`` each ``[nb, Bt]`` where the run
+    head at a searchsorted position is the *earliest* global row with that
+    band key.
+    """
+    Bt, nb = keys.shape
+    rowpos = jnp.broadcast_to(jnp.arange(Bt, dtype=jnp.int32), (nb, Bt))
+    g = jnp.broadcast_to(gidx_eff, (nb, Bt))
+    return jax.lax.sort((keys.T, g, rowpos), dimension=1, num_keys=2)
+
+
+def _best_match_against_sorted(
     keys_l: jnp.ndarray,   # uint32[Bl, nb]  local band keys (invalid → U32_MAX)
     sig_l: jnp.ndarray,    # uint32[Bl, P]
     gidx_l: jnp.ndarray,   # int32[Bl]   local global row indices
-    keys_b: jnp.ndarray,   # uint32[Bt, nb]  transit block
-    sig_b: jnp.ndarray,
-    gidx_b: jnp.ndarray,
-    valid_b: jnp.ndarray,  # bool[Bt]
+    sk: jnp.ndarray,       # uint32[nb, Bt]  transit keys, per-band sorted
+    sg: jnp.ndarray,       # int32[nb, Bt]   global idx in sort order
+    sp: jnp.ndarray,       # int32[nb, Bt]   block row in sort order
+    sig_b: jnp.ndarray,    # uint32[Bt, P]   transit signatures (block order)
     threshold: float,
 ) -> jnp.ndarray:
     """int32[Bl]: smallest transit global index that band-collides with the
-    local row AND verifies by signature agreement; own index otherwise."""
-    Bl = keys_l.shape[0]
-    Bt, nb = keys_b.shape
+    local row AND verifies by signature agreement; own index otherwise.
+
+    Bands reduce inside a ``lax.scan`` so the per-hop transient stays at
+    O(Bl·P) — one band's candidate-signature gather at a time — instead of
+    materialising the [nb, Bl, P] gather all at once (which would be ~16×
+    the ring payload this module exists to avoid).
+    """
+    Bt = sk.shape[1]
     big = jnp.iinfo(jnp.int32).max
-    # invalid transit rows can never be representatives
-    gidx_b_eff = jnp.where(valid_b, gidx_b, big)
-    best = jnp.full((Bl,), big, dtype=jnp.int32)
-    rowpos = jnp.arange(Bt, dtype=jnp.int32)
-    for b in range(nb):
-        # sort transit rows by (band key, global idx): the run head at the
-        # searchsorted position is the earliest row with that key
-        sk, sg, sp = jax.lax.sort(
-            (keys_b[:, b], gidx_b_eff, rowpos), dimension=0, num_keys=2
-        )
-        pos = jnp.searchsorted(sk, keys_l[:, b], side="left")
-        pos = jnp.clip(pos, 0, Bt - 1)
-        hit = sk[pos] == keys_l[:, b]
-        cand_gidx = sg[pos]
-        cand_sig = jnp.take(sig_b, sp[pos], axis=0)      # [Bl, P]
+
+    def band_body(best, xs):
+        skb, sgb, spb, klb = xs  # uint32[Bt], int32[Bt], int32[Bt], uint32[Bl]
+        pos = jnp.clip(jnp.searchsorted(skb, klb, side="left"), 0, Bt - 1)
+        hit = skb[pos] == klb
+        cand_gidx = sgb[pos]
+        cand_sig = sig_b[spb[pos]]                        # [Bl, P]
         agree = (sig_l == cand_sig).mean(axis=1)
         ok = hit & (agree >= threshold) & (cand_gidx < gidx_l)
-        best = jnp.minimum(best, jnp.where(ok, cand_gidx, big))
+        return jnp.minimum(best, jnp.where(ok, cand_gidx, big)), None
+
+    init = jnp.full_like(gidx_l, big)
+    best, _ = jax.lax.scan(band_body, init, (sk, sg, sp, keys_l.T))
     return jnp.where(best == big, gidx_l, best)
 
 
@@ -102,17 +122,20 @@ def make_ring_dedup(
 
         perm = [(s, (s + 1) % n) for s in range(n)]
 
+        # Sort once before entering the ring; the sorted triples (plus the
+        # block-order signatures sp indexes into) are what rotates.
+        big = jnp.iinfo(jnp.int32).max
+        gidx_eff = jnp.where(valid, gidx, big)
+        sk, sg, sp = _presort_bands(keys, gidx_eff)
+
         def hop(_, carry):
             rep, blk = carry
-            bkeys, bsig, bgidx, bvalid = blk
-            cand = _best_match_against_block(
-                keys, sig, gidx, bkeys, bsig, bgidx, bvalid, threshold
-            )
+            cand = _best_match_against_sorted(keys, sig, gidx, *blk, threshold)
             rep = jnp.minimum(rep, cand)
             blk = tuple(jax.lax.ppermute(x, data, perm) for x in blk)
             return rep, blk
 
-        init = (gidx, (keys, sig, gidx, valid))
+        init = (gidx, (sk, sg, sp, sig))
         rep, _ = jax.lax.fori_loop(0, n, hop, init)
 
         # Chain resolution on the 4-byte/row rep array only — the heavy

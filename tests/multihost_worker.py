@@ -1,0 +1,73 @@
+"""Subprocess worker for tests/test_multihost.py.
+
+One jax.distributed process of a two-process CPU world (4 virtual devices
+per process → 8 global).  Builds a local batch with one article that
+duplicates an article held by the *other* process, runs the global-mesh
+dedup, and prints the replicated result as one JSON line.
+
+Usage: python multihost_worker.py <process_id> <coordinator_port>
+"""
+
+import json
+import os
+import sys
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Force exactly 4 local devices even if the parent (pytest conftest) already
+# exported a different xla_force_host_platform_device_count.
+_flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+]
+_flags.append("--xla_force_host_platform_device_count=4")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = int(sys.argv[2])
+
+    from advanced_scrapper_tpu.parallel.dist import (
+        initialize_multihost,
+        multihost_dedup,
+        world_info,
+    )
+
+    assert initialize_multihost(f"localhost:{port}", 2, pid)
+    info = world_info()
+
+    from advanced_scrapper_tpu.core.hashing import make_params
+
+    params = make_params()
+    B_local, L = 8, 256
+    rng = np.random.RandomState(7)  # same seed on both hosts
+    corpus = rng.randint(32, 127, size=(2 * B_local, L)).astype(np.uint8)
+    # cross-host duplicate: global row 12 (host 1) copies global row 3 (host 0)
+    corpus[12] = corpus[3]
+    tokens = corpus[pid * B_local : (pid + 1) * B_local]
+    lengths = np.full((B_local,), L, dtype=np.int32)
+
+    rep, hist = multihost_dedup(tokens, lengths, params)
+    print(
+        json.dumps(
+            {
+                "process_id": pid,
+                "world": info,
+                "rep": rep.tolist(),
+                "hist_sum": int(hist.sum()),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -27,16 +27,14 @@ def _corpus(B=64, L=256, seed=0, dup_pairs=((0, 9), (3, 40), (17, 63), (20, 21))
     rng = np.random.RandomState(seed)
     tok = rng.randint(32, 127, size=(B, L)).astype(np.uint8)
     lens = np.full((B,), L, dtype=np.int32)
-    near_edit = {}
     for a, b in dup_pairs:
         tok[b] = tok[a]
         if (a + b) % 2:  # make half the pairs near (not exact) duplicates
             tok[b, -4:] = rng.randint(32, 127, size=4)
-            near_edit[b] = a
     # edge rows: empty and shorter-than-shingle
     lens[5] = 0
     lens[6] = 3
-    return tok, lens, dict(dup_pairs)
+    return tok, lens, tuple(dup_pairs)
 
 
 def test_ring_matches_all_gather_clusters(devices8, params):
@@ -57,12 +55,12 @@ def test_ring_first_seen_wins_across_shards(devices8, params):
     rep = np.asarray(make_ring_dedup(mesh, params, jump_rounds=8)(
         *shard_batch(tok, lens, mesh)
     ))
-    for a, b in [(0, 9), (3, 40), (17, 63), (20, 21)]:
+    for a, b in pairs:
         assert rep[b] == a, f"row {b} should resolve to first-seen {a}, got {rep[b]}"
     # short/empty rows never merge
     assert rep[5] == 5 and rep[6] == 6
     # non-duplicates stay themselves
-    planted = {b for _, b in [(0, 9), (3, 40), (17, 63), (20, 21)]}
+    planted = {b for _, b in pairs}
     for i in range(64):
         if i not in planted:
             assert rep[i] == i
