@@ -61,8 +61,8 @@ def _best_match_against_sorted(
     sig_l: jnp.ndarray,    # uint32[Bl, P]
     gidx_l: jnp.ndarray,   # int32[Bl]   local global row indices
     sk: jnp.ndarray,       # uint32[nb, Bt]  transit keys, per-band sorted
-    sg: jnp.ndarray,       # int32[nb, Bt]   global idx in sort order
     sp: jnp.ndarray,       # int32[nb, Bt]   block row in sort order
+    gidx_eff: jnp.ndarray,  # int32[Bt]  transit global idx, block order (invalid → max)
     sig_b: jnp.ndarray,    # uint32[Bt, P]   transit signatures (block order)
     threshold: float,
 ) -> jnp.ndarray:
@@ -72,23 +72,26 @@ def _best_match_against_sorted(
     Bands reduce inside a ``lax.scan`` so the per-hop transient stays at
     O(Bl·P) — one band's candidate-signature gather at a time — instead of
     materialising the [nb, Bl, P] gather all at once (which would be ~16×
-    the ring payload this module exists to avoid).
+    the ring payload this module exists to avoid).  The per-band global
+    indices are recovered as ``gidx_eff[sp]`` rather than rotated as their
+    own [nb, Bt] matrix, keeping the ring payload minimal.
     """
     Bt = sk.shape[1]
     big = jnp.iinfo(jnp.int32).max
 
     def band_body(best, xs):
-        skb, sgb, spb, klb = xs  # uint32[Bt], int32[Bt], int32[Bt], uint32[Bl]
+        skb, spb, klb = xs  # uint32[Bt], int32[Bt], uint32[Bl]
         pos = jnp.clip(jnp.searchsorted(skb, klb, side="left"), 0, Bt - 1)
         hit = skb[pos] == klb
-        cand_gidx = sgb[pos]
-        cand_sig = sig_b[spb[pos]]                        # [Bl, P]
+        row = spb[pos]
+        cand_gidx = gidx_eff[row]
+        cand_sig = sig_b[row]                             # [Bl, P]
         agree = (sig_l == cand_sig).mean(axis=1)
         ok = hit & (agree >= threshold) & (cand_gidx < gidx_l)
         return jnp.minimum(best, jnp.where(ok, cand_gidx, big)), None
 
     init = jnp.full_like(gidx_l, big)
-    best, _ = jax.lax.scan(band_body, init, (sk, sg, sp, keys_l.T))
+    best, _ = jax.lax.scan(band_body, init, (sk, sp, keys_l.T))
     return jnp.where(best == big, gidx_l, best)
 
 
@@ -122,11 +125,13 @@ def make_ring_dedup(
 
         perm = [(s, (s + 1) % n) for s in range(n)]
 
-        # Sort once before entering the ring; the sorted triples (plus the
-        # block-order signatures sp indexes into) are what rotates.
+        # Sort once before entering the ring; what rotates is the sorted
+        # (key, row) pairs plus the block-order gidx vector and signatures
+        # that sp indexes into — the sorted global indices are derivable as
+        # gidx_eff[sp], so they are never carried as their own matrix.
         big = jnp.iinfo(jnp.int32).max
         gidx_eff = jnp.where(valid, gidx, big)
-        sk, sg, sp = _presort_bands(keys, gidx_eff)
+        sk, _sg, sp = _presort_bands(keys, gidx_eff)
 
         def hop(_, carry):
             rep, blk = carry
@@ -135,7 +140,7 @@ def make_ring_dedup(
             blk = tuple(jax.lax.ppermute(x, data, perm) for x in blk)
             return rep, blk
 
-        init = (gidx, (sk, sg, sp, sig))
+        init = (gidx, (sk, sp, gidx_eff, sig))
         rep, _ = jax.lax.fori_loop(0, n, hop, init)
 
         # Chain resolution on the 4-byte/row rep array only — the heavy

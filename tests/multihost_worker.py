@@ -41,7 +41,9 @@ def main() -> None:
         world_info,
     )
 
-    assert initialize_multihost(f"localhost:{port}", 2, pid)
+    ok = initialize_multihost(f"localhost:{port}", 2, pid)
+    if not ok:
+        raise RuntimeError("jax.distributed initialization did not run")
     info = world_info()
 
     from advanced_scrapper_tpu.core.hashing import make_params
