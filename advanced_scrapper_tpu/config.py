@@ -122,6 +122,7 @@ class DedupConfig:
     batch_size: int = 1024
     sim_threshold: float = 0.70  # signature-agreement verification threshold
     seed: int = 1            # datasketch's default seed for oracle parity
+    backend: str = "scan"    # scan (dense, datasketch-parity) | oph | pallas
 
 
 @dataclass(frozen=True)

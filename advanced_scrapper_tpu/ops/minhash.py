@@ -81,12 +81,19 @@ def minhash_signatures(
     lengths: jnp.ndarray,
     params: MinHashParams,
     *,
-    chunk: int = 512,
+    chunk: int = 128,
 ) -> jnp.ndarray:
     """Compute ``uint32[B, num_perm]`` MinHash signatures.
 
     Rows with fewer than k valid bytes yield all-``U32_MAX`` signatures;
     callers must mask them out of LSH (``lsh.duplicate_reps(valid=...)``).
+
+    ``chunk=128`` is the measured-best scan granularity on v5e (2026-07
+    sweep: ~845k articles/s full-step at [32768, 1024] vs ~715k at 512).
+    The kernel runs at VPU int-multiply saturation — the multiply-add per
+    (shingle, permutation) is irreducible for the dense formulation, and
+    the MXU cannot help (min-reduce is not a matmul); see ``ops/oph.py``
+    for the measured alternative that trades multiplies for a sort.
 
     ``ASTPU_MINHASH_BACKEND=pallas`` swaps in the fused Pallas kernel
     (``ops/pallas_minhash.py``) — bit-identical output, measured slower on
@@ -107,6 +114,30 @@ def minhash_signatures(
         k=params.shingle_k,
         chunk=chunk,
     )
+
+
+def resolve_signature_fn(backend: str):
+    """Single dispatch point for the signature backend.
+
+    ``scan`` — the dense kernel (measured fastest on v5e); ``pallas`` —
+    the fused hand-written kernel; ``oph`` — one-permutation hashing
+    (densified; whole-document rows only — block/shard-split callers must
+    use ``ops.oph.oph_raw_signatures`` and densify after the min-combine).
+    Unknown names raise instead of silently running scan.
+    """
+    if backend == "scan":
+        return minhash_signatures
+    if backend == "pallas":
+        from advanced_scrapper_tpu.ops.pallas_minhash import (
+            minhash_signatures_pallas,
+        )
+
+        return minhash_signatures_pallas
+    if backend == "oph":
+        from advanced_scrapper_tpu.ops.oph import oph_signatures
+
+        return oph_signatures
+    raise ValueError(f"unknown signature backend {backend!r}; use scan|pallas|oph")
 
 
 @partial(jax.jit, static_argnames=("num_articles",))

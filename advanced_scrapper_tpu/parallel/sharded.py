@@ -34,7 +34,11 @@ from advanced_scrapper_tpu.ops.lsh import (
     duplicate_reps,
     resolve_reps,
 )
-from advanced_scrapper_tpu.ops.minhash import minhash_signatures, scan_min_signature
+from advanced_scrapper_tpu.ops.minhash import (
+    minhash_signatures,
+    resolve_signature_fn,
+    scan_min_signature,
+)
 from advanced_scrapper_tpu.ops.shingle import shingle_hash
 
 
@@ -53,21 +57,25 @@ def make_sharded_dedup(
     threshold: float = 0.7,
     jump_rounds: int = 16,
     hist_bins: int = 1 << 16,
+    backend: str = "scan",
 ):
     """Build the jitted batch-sharded dedup step for ``mesh``.
 
     Returns ``step(tokens, lengths) -> (rep, hist)`` where ``tokens`` is
     ``uint8[B, L]`` sharded on the data axis, ``rep`` is the replicated
     ``int32[B]`` global first-seen representative array, and ``hist`` the
-    psum-merged bucket histogram.
+    psum-merged bucket histogram.  ``backend="oph"`` swaps the dense
+    signature kernel for one-permutation hashing (``ops/oph.py``) — data
+    shards own whole rows, so densification is safe shard-local.
     """
     data = _data_axis(mesh)
     salt = jnp.asarray(params.band_salt)
     k = params.shingle_k
+    _sig_fn = resolve_signature_fn(backend)
 
     def local_step(tokens, lengths):
         # tokens: uint8[B/n, L] local shard
-        sig = minhash_signatures(tokens, lengths, params)
+        sig = _sig_fn(tokens, lengths, params)
         keys = band_keys(sig, salt)
         valid = lengths >= k
         # Cross-shard candidate resolution: gather the compact per-article

@@ -26,7 +26,10 @@ from advanced_scrapper_tpu.core.tokenizer import (
 )
 from advanced_scrapper_tpu.ops.exact import ExactHasher
 from advanced_scrapper_tpu.ops.lsh import band_keys, duplicate_reps, keep_mask, resolve_reps
-from advanced_scrapper_tpu.ops.minhash import combine_block_signatures, minhash_signatures
+from advanced_scrapper_tpu.ops.minhash import (
+    combine_block_signatures,
+    resolve_signature_fn,
+)
 
 
 def _jump_rounds(n: int) -> int:
@@ -54,10 +57,23 @@ class NearDupEngine:
         )
 
     def signatures(self, texts: Sequence[str | bytes]) -> np.ndarray:
-        """uint32[N, num_perm] MinHash signatures (blockwise, batched)."""
+        """uint32[N, num_perm] MinHash signatures (blockwise, batched).
+
+        With ``cfg.backend == "oph"`` block signatures are the *raw* OPH
+        form (empty bins ``U32_MAX``) so the per-article segment-min combine
+        stays exact; densification runs once after the combine (see
+        ``ops/oph.py`` for why that order is load-bearing).
+        """
         cfg, params = self.cfg, self.params
         if len(texts) == 0:
             return np.zeros((0, params.num_perm), np.uint32)
+        block_fn = resolve_signature_fn(cfg.backend)  # validates the name
+        use_oph = cfg.backend == "oph"
+        if use_oph:
+            from advanced_scrapper_tpu.ops.oph import densify, oph_raw_signatures
+
+            block_fn = oph_raw_signatures  # densify AFTER the block combine
+
         tok, lens, owners = encode_blocks(
             texts, cfg.block_len, overlap=params.shingle_k - 1
         )
@@ -71,12 +87,14 @@ class NearDupEngine:
                 pad = bs - t.shape[0]
                 t = np.concatenate([t, np.zeros((pad, t.shape[1]), np.uint8)])
                 l = np.concatenate([l, np.zeros((pad,), np.int32)])
-            sig_parts.append(np.asarray(minhash_signatures(t, l, params)))
+            sig_parts.append(np.asarray(block_fn(t, l, params)))
         sigs = np.concatenate(sig_parts)[:n_blocks]
         # Bucket the article count so combine compiles O(log N) variants, not
         # one per corpus size (same trick as the block-length axis).
         n_bucket = bucket_len(len(texts), min_bucket=64)
         combined = combine_block_signatures(sigs, owners, num_articles=n_bucket)
+        if use_oph:
+            combined = densify(combined)
         return np.asarray(combined)[: len(texts)]
 
     def dedup_reps(self, texts: Sequence[str | bytes]) -> np.ndarray:

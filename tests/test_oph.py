@@ -1,0 +1,125 @@
+"""One-permutation hashing backend: estimator quality + combine algebra.
+
+OPH must pass the same north-star recall bar as the dense kernel
+(BASELINE.json: ≥0.95 vs the datasketch-parity oracle) and must compose
+with the blockwise/sequence-parallel min-combine *in the raw form only*
+(densification does not commute with min — see ``ops/oph.py``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from advanced_scrapper_tpu.config import DedupConfig
+from advanced_scrapper_tpu.core.tokenizer import encode_batch
+from advanced_scrapper_tpu.cpu.oracle import oracle_near_dup_pairs
+from advanced_scrapper_tpu.ops.lsh import band_keys, duplicate_reps, resolve_reps
+from advanced_scrapper_tpu.ops.oph import (
+    densify,
+    oph_raw_signatures,
+    oph_signatures,
+)
+from advanced_scrapper_tpu.ops.shingle import U32_MAX
+from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+from test_recall_vs_oracle import PARAMS, _corpus, _mutate
+
+
+def _oph_clusters(texts, threshold=0.7):
+    tok, ln = encode_batch(texts, block_len=512)
+    sig = oph_signatures(tok, ln, PARAMS)
+    keys = band_keys(sig, PARAMS.band_salt)
+    valid = np.asarray(ln) >= PARAMS.shingle_k
+    rep = duplicate_reps(keys, valid)
+    return np.asarray(resolve_reps(rep, sig, valid, threshold, jump_rounds=8))
+
+
+def test_oph_recall_vs_oracle():
+    texts = _corpus()
+    oracle_pairs = oracle_near_dup_pairs(texts, PARAMS, threshold=0.7)
+    assert len(oracle_pairs) >= 30
+    rep = _oph_clusters(texts)
+    hit = sum(1 for i, j in oracle_pairs if rep[i] == rep[j])
+    recall = hit / len(oracle_pairs)
+    assert recall >= 0.95, f"OPH recall {recall:.3f} < 0.95"
+
+
+def test_oph_no_false_merges():
+    rng = np.random.RandomState(11)
+    texts = [bytes(rng.randint(32, 127, size=300, dtype=np.uint8)) for _ in range(64)]
+    rep = _oph_clusters(texts)
+    assert (rep == np.arange(64)).all()
+    # short docs densify heavily — they must still never merge
+    short = [bytes(rng.randint(32, 127, size=12, dtype=np.uint8)) for _ in range(32)]
+    assert (_oph_clusters(short) == np.arange(32)).all()
+
+
+def test_oph_empty_and_subshingle_rows():
+    tok, ln = encode_batch([b"", b"abc", b"a perfectly normal document body"], block_len=64)
+    sig = np.asarray(oph_signatures(tok, ln, PARAMS))
+    assert (sig[0] == U32_MAX).all() and (sig[1] == U32_MAX).all()
+    assert (sig[2] != U32_MAX).any()
+
+
+def test_raw_combine_equals_whole_doc():
+    """Splitting a doc into (k-1)-overlap blocks and min-combining the RAW
+    signatures must reproduce the whole-doc signature exactly — the algebra
+    the blockwise and sequence-parallel paths rely on."""
+    rng = np.random.RandomState(5)
+    doc = bytes(rng.randint(32, 127, size=1000, dtype=np.uint8))
+    k = PARAMS.shingle_k
+    whole_tok, whole_ln = encode_batch([doc], block_len=1024)
+    whole = np.asarray(oph_raw_signatures(whole_tok, whole_ln, PARAMS))[0]
+
+    # two overlapping halves: [0, 504+k-1) and [504, 1000)
+    cut = 504
+    blocks = [doc[: cut + k - 1], doc[cut:]]
+    tok, ln = encode_batch(blocks, block_len=1024)
+    parts = np.asarray(oph_raw_signatures(tok, ln, PARAMS))
+    combined = np.minimum(parts[0], parts[1])
+    assert np.array_equal(combined, whole)
+    assert np.array_equal(
+        np.asarray(densify(combined)),
+        np.asarray(densify(whole)),
+    )
+
+
+def test_densify_fills_from_right_circularly():
+    sig = np.full((1, 8), U32_MAX, dtype=np.uint32)
+    sig[0, 5] = 42
+    out = np.asarray(densify(sig))
+    assert (out == 42).all()
+    # all-empty row stays the sentinel
+    empty = np.full((1, 8), U32_MAX, dtype=np.uint32)
+    assert (np.asarray(densify(empty)) == U32_MAX).all()
+
+
+def test_engine_backend_oph():
+    """NearDupEngine with cfg.backend='oph' clusters exact + near dups,
+    including docs long enough to split into multiple blocks."""
+    rng = np.random.RandomState(9)
+    base = bytes(rng.randint(32, 127, size=6000, dtype=np.uint8))  # > block_len
+    near = _mutate(rng, base, 10)
+    other = bytes(rng.randint(32, 127, size=6000, dtype=np.uint8))
+    eng = NearDupEngine(DedupConfig(backend="oph", block_len=4096, batch_size=8))
+    reps = eng.dedup_reps([base, near, other, base])
+    assert reps[1] == 0 and reps[3] == 0 and reps[2] == 2
+
+
+def test_unknown_backend_rejected():
+    """Typos must raise, not silently run the scan kernel."""
+    from advanced_scrapper_tpu.ops.minhash import resolve_signature_fn
+
+    with pytest.raises(ValueError, match="unknown signature backend"):
+        resolve_signature_fn("ohp")
+    with pytest.raises(ValueError, match="unknown signature backend"):
+        NearDupEngine(DedupConfig(backend="ohp")).dedup_reps(["a doc", "b doc"])
+
+
+def test_oph_requires_power_of_two_perms():
+    from advanced_scrapper_tpu.core.hashing import make_params
+
+    with pytest.raises(ValueError):
+        tok, ln = encode_batch([b"some document"], block_len=64)
+        oph_raw_signatures(tok, ln, make_params(num_perm=96))
