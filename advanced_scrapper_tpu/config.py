@@ -123,6 +123,10 @@ class DedupConfig:
     sim_threshold: float = 0.70  # signature-agreement verification threshold
     seed: int = 1            # datasketch's default seed for oracle parity
     backend: str = "scan"    # scan (dense, datasketch-parity) | oph | pallas
+    stream_index: str = "exact"  # exact (attributed, grows with stream) |
+    #                              bloom (LSHBloom: fixed memory, no attribution)
+    bloom_bits: int = 1 << 24    # bits per band filter (bloom mode)
+    bloom_hashes: int = 4
 
 
 @dataclass(frozen=True)

@@ -23,6 +23,7 @@ XLA-idiomatic split.  For *static* corpora the all-device path
 
 from __future__ import annotations
 
+import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Sequence
 
@@ -32,6 +33,21 @@ from advanced_scrapper_tpu.config import DedupConfig
 from advanced_scrapper_tpu.core.hashing import make_params
 from advanced_scrapper_tpu.ops.lsh import band_keys
 from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+
+# dup marks in bloom stream-index mode: membership is known, the target is
+# not (no per-document state exists to attribute against)
+BLOOM_SENTINEL = "(bloom)"
+
+
+def _key_of(rec: dict, field: str) -> str:
+    """Single key-normalisation point: missing/None/empty all mean keyless.
+
+    Both stream indexes and both stages share this so their keep/drop
+    decisions agree (a record with ``url=None`` must not be a key "None"
+    in one stage and keyless in another).
+    """
+    return str(rec.get(field) or "")
 
 
 @dataclass
@@ -67,7 +83,29 @@ class TpuBatchBackend:
         self.sink = sink
         self.stats = BatchStats()
         self._buffer: list[dict] = []
-        # cross-batch state: exact keys seen, and band-bucket → (key, sig row)
+        # cross-batch state — two interchangeable stream indexes:
+        #   exact: attributed dup targets, host memory grows with the stream;
+        #   bloom: LSHBloom (utils/bloom.py) — fixed memory forever, dup
+        #   marks carry the sentinel BLOOM_SENTINEL instead of a target key.
+        self._bloom_mode = self.cfg.stream_index == "bloom"
+        if self._bloom_mode:
+            from advanced_scrapper_tpu.utils.bloom import BloomBandIndex
+
+            self._bloom = BloomBandIndex(
+                self.cfg.num_bands,
+                bits=self.cfg.bloom_bits,
+                num_hashes=self.cfg.bloom_hashes,
+                seed=self.cfg.seed,
+            )
+            # exact-url stage as a 1-band filter over a url hash: bounded too
+            self._bloom_urls = BloomBandIndex(
+                1, bits=self.cfg.bloom_bits, num_hashes=self.cfg.bloom_hashes,
+                seed=self.cfg.seed + 1,
+            )
+        elif self.cfg.stream_index != "exact":
+            raise ValueError(
+                f"unknown stream_index {self.cfg.stream_index!r}; use exact|bloom"
+            )
         self._seen_keys: set[str] = set()
         self._buckets: dict[tuple[int, int], int] = {}  # (band, key) -> sig idx
         self._kept_sigs: list[np.ndarray] = []
@@ -94,27 +132,54 @@ class TpuBatchBackend:
         records, self._buffer = self._buffer, []
         self.stats.batches += 1
 
-        # exact stage: host dict over record keys (urls)
-        for rec in records:
-            key = str(rec.get(self.key_field, ""))
-            if key and key in self._seen_keys:
-                rec["dup_of"] = key
-                self.stats.exact_dups += 1
-            else:
-                rec["dup_of"] = None
-                if key:
-                    self._seen_keys.add(key)
+        # exact stage: host dict over record keys (urls); bloom mode uses a
+        # fixed-size 1-band filter over a url hash instead of the growing set
+        if self._bloom_mode:
+            url_hash = np.array(
+                [
+                    [zlib.crc32(_key_of(rec, self.key_field).encode("utf-8", "replace"))]
+                    for rec in records
+                ],
+                dtype=np.uint32,
+            )
+            keyed = np.array(
+                [bool(_key_of(rec, self.key_field)) for rec in records]
+            )
+            url_dup = np.zeros(len(records), dtype=bool)
+            if keyed.any():
+                # cross-batch via the filter, intra-batch via hash equality
+                url_dup[keyed] = self._bloom_urls.check_and_add_batch(
+                    url_hash[keyed]
+                )
+            for i, rec in enumerate(records):
+                if url_dup[i]:
+                    rec["dup_of"] = BLOOM_SENTINEL
+                    self.stats.exact_dups += 1
+                else:
+                    rec["dup_of"] = None
+        else:
+            for rec in records:
+                key = _key_of(rec, self.key_field)
+                if key and key in self._seen_keys:
+                    rec["dup_of"] = key
+                    self.stats.exact_dups += 1
+                else:
+                    rec["dup_of"] = None
+                    if key:
+                        self._seen_keys.add(key)
 
         # near-dup stage: device signatures + band keys, host bucket join
         texts = [str(r.get(self.text_field, "") or "") for r in records]
         sigs = self.engine.signatures(texts)
         keys = np.asarray(band_keys(sigs, self.params.band_salt))
         thresh = self.cfg.sim_threshold
+        if self._bloom_mode:
+            return self._near_dup_bloom(records, texts, keys)
         for i, rec in enumerate(records):
             rec["near_dup_of"] = None
             if rec["dup_of"] is not None:
                 continue  # already an exact dup
-            if not str(rec.get(self.key_field, "") or ""):
+            if not _key_of(rec, self.key_field):
                 continue  # keyless records cannot be referenced as dup targets
             if len(texts[i].encode("utf-8", "replace")) < self.params.shingle_k:
                 continue  # no shingles: never bucket
@@ -133,11 +198,40 @@ class TpuBatchBackend:
                 sig_idx = len(self._kept_sigs)
                 # copy: a row view would pin the whole batch array forever
                 self._kept_sigs.append(sigs[i].copy())
-                self._kept_keys.append(str(rec.get(self.key_field, "")))
+                self._kept_keys.append(_key_of(rec, self.key_field))
                 for b in range(self.params.num_bands):
                     self._buckets.setdefault((b, int(keys[i, b])), sig_idx)
                 self.stats.kept += 1
 
+        if self.sink is not None:
+            for rec in records:
+                self.sink(rec)
+        return records
+
+    def _near_dup_bloom(self, records, texts, keys) -> list[dict]:
+        """Bounded-memory near-dup stage: LSHBloom membership per band.
+
+        Rows ineligible for bucketing (exact dups, keyless, sub-shingle
+        texts) are neither probed nor inserted — same eligibility rules as
+        the exact index.  Hits are marked with ``BLOOM_SENTINEL``.
+        """
+        eligible = np.array(
+            [
+                rec["dup_of"] is None
+                and bool(_key_of(rec, self.key_field))
+                and len(texts[i].encode("utf-8", "replace")) >= self.params.shingle_k
+                for i, rec in enumerate(records)
+            ]
+        )
+        dup = np.zeros(len(records), dtype=bool)
+        if eligible.any():
+            dup[eligible] = self._bloom.check_and_add_batch(keys[eligible])
+        for i, rec in enumerate(records):
+            rec["near_dup_of"] = BLOOM_SENTINEL if dup[i] else None
+            if dup[i]:
+                self.stats.near_dups += 1
+            elif eligible[i]:
+                self.stats.kept += 1
         if self.sink is not None:
             for rec in records:
                 self.sink(rec)

@@ -65,6 +65,75 @@ class MockTransport:
         pass
 
 
+class ChaosTransport:
+    """Deliberate fault injection around any inner transport.
+
+    The reference has **no** fault injection anywhere (SURVEY.md §5.3) —
+    its failure handling is only ever exercised by real outages.  This
+    wrapper makes the failure paths testable on demand: seeded, reproducible
+    injection of fetch errors, rate-limit fingerprints (the
+    ``about:neterror`` string the engine's circuit breaker keys on, ref
+    ``constant_rate_scrapper.py:190-193``), rate-limit sentinel *pages*
+    (the extractor-detected flavour, ref ``extractors/yfin.py:18-21``),
+    and latency spikes.  Fault assignment is a pure function of
+    ``(seed, url)`` — NOT a shared random stream — so injection is
+    reproducible even when the engine fetches from many worker threads in
+    nondeterministic order (a given url faults identically on every run
+    and every retry with the same seed).  A url that faults is not retried
+    here — failure capture, resume and the pause circuit downstream are
+    exactly what is under test.
+    """
+
+    def __init__(
+        self,
+        inner,
+        *,
+        seed: int = 0,
+        error_rate: float = 0.0,
+        neterror_rate: float = 0.0,
+        rate_limit_page_rate: float = 0.0,
+        latency_spike: tuple[float, float] = (0.0, 0.0),
+        rate_limit_page: str | None = None,
+    ):
+        self._inner = inner
+        self._seed = seed
+        self._error_rate = error_rate
+        self._neterror_rate = neterror_rate
+        self._rl_page_rate = rate_limit_page_rate
+        self._spike_rate, self._spike_secs = latency_spike
+        self._rl_page = rate_limit_page or (
+            "<html><body><p>Thank you for your patience.</p>"
+            "<p>Our engineers are working quickly to resolve the issue.</p>"
+            "</body></html>"
+        )
+        self.injected: dict[str, int] = {
+            "error": 0, "neterror": 0, "rate_limit_page": 0, "spike": 0
+        }
+
+    def fetch(self, url: str) -> str:
+        import random
+
+        # seeding Random with a string hashes its bytes (sha512) — stable
+        # across processes and threads, unlike the builtin str hash
+        r = random.Random(f"{self._seed}|{url}").random
+        if self._spike_rate and r() < self._spike_rate:
+            self.injected["spike"] += 1
+            time.sleep(self._spike_secs)
+        if self._error_rate and r() < self._error_rate:
+            self.injected["error"] += 1
+            raise FetchError(f"injected fault for {url}")
+        if self._neterror_rate and r() < self._neterror_rate:
+            self.injected["neterror"] += 1
+            raise FetchError(f"about:neterror (injected) for {url}")
+        if self._rl_page_rate and r() < self._rl_page_rate:
+            self.injected["rate_limit_page"] += 1
+            return self._rl_page
+        return self._inner.fetch(url)
+
+    def close(self) -> None:
+        self._inner.close()
+
+
 class RequestsTransport:
     def __init__(self, timeout: float = 30.0, user_agent: str = USER_AGENT):
         import requests

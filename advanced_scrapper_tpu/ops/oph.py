@@ -84,17 +84,34 @@ def oph_raw_signatures(tokens, lengths, params: MinHashParams):
     )
 
 
+_DENSIFY_C = jnp.uint32(0x9E3779B1)  # odd ⇒ bijective mix per hop distance
+
+
 @jax.jit
 def densify(sig):
-    """Rotation densification: each empty bin borrows the nearest filled
-    bin to its right (circular).  All-empty rows stay all-``U32_MAX`` —
-    the same "no shingles" sentinel contract as the dense kernel."""
+    """Rotation densification with distance offsetting (Shrivastava & Li,
+    ICML 2014): each empty bin borrows the nearest filled bin to its right
+    (circular), and the borrowed value is offset by ``distance × C`` so two
+    documents' jointly-empty bins only agree when they borrowed the *same*
+    value from the *same relative position* — without the offset, one
+    shared shingle replicates across both documents' empty runs and
+    inflates signature agreement for sparse (short) documents.  All-empty
+    rows stay all-``U32_MAX`` (the "no shingles" sentinel contract)."""
     P = sig.shape[-1]
+    big = jnp.uint32(0xFFFFFFFF)
+    filled = sig != U32_MAX
+    dist = jnp.where(filled, jnp.uint32(0), big)
+    val = sig
     shift = 1
     while shift < P:
-        sig = jnp.where(sig == U32_MAX, jnp.roll(sig, -shift, axis=-1), sig)
+        nd_raw = jnp.roll(dist, -shift, axis=-1)
+        nd = jnp.where(nd_raw == big, big, nd_raw + jnp.uint32(shift))
+        better = nd < dist
+        dist = jnp.where(better, nd, dist)
+        val = jnp.where(better, jnp.roll(val, -shift, axis=-1), val)
         shift <<= 1
-    return sig
+    dense = val + dist * _DENSIFY_C
+    return jnp.where(dist == big, U32_MAX, jnp.where(filled, sig, dense))
 
 
 def oph_signatures(tokens, lengths, params: MinHashParams):

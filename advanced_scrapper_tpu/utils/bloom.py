@@ -1,0 +1,154 @@
+"""Bounded-memory streaming LSH index: per-band Bloom filters.
+
+The default streaming index (``extractors/tpu_batch.py``) stores every kept
+document's signature and 16 band keys on the host — ~1 KB per kept document,
+unbounded over an unbounded stream (the reference's live pollers,
+``experiental/04..10``, run forever).  The LSHBloom construction (Khan et
+al., arXiv:2411.04257) replaces the key→doc dict with one Bloom filter per
+LSH band: membership of a band key marks a near-duplicate, memory is fixed
+at ``num_bands × bits/8`` bytes forever, and the false-positive rate is set
+by the filter sizing instead of growing with the corpus.
+
+Trade-offs vs the exact index (both are first-class; pick per workload):
+
+- **no attribution** — a Bloom hit says "a previously seen document shared
+  this band", not *which* one, and no stored signature exists to verify
+  agreement against; precision is the LSH banding precision minus the
+  Bloom false-positive rate ``ε ≈ (1 - e^(-k·n/m))^k``.  At the default
+  2²⁴ bits/band with k=4 hashes, ε < 1e-4 past ten million insertions.
+- **bounded memory** — 32 MiB total at defaults, forever.
+- **mergeable** — Bloom filters combine with bitwise OR, so per-shard /
+  per-host indexes union exactly (the collective analogue of the band-key
+  ``psum`` merge in ``parallel/sharded.py``).
+
+Within a batch the filter alone cannot order insertions, so the batch probe
+uses *true key equality* intra-batch (first-seen wins, exactly) and the
+filters only across batches — stream semantics match the exact index.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_MIX_A = np.uint64(0xBF58476D1CE4E5B9)
+_MIX_B = np.uint64(0x94D049BB133111EB)
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    x = (x + np.uint64(0x9E3779B97F4A7C15)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+    x = ((x ^ (x >> np.uint64(30))) * _MIX_A) & np.uint64(0xFFFFFFFFFFFFFFFF)
+    x = ((x ^ (x >> np.uint64(27))) * _MIX_B) & np.uint64(0xFFFFFFFFFFFFFFFF)
+    return x ^ (x >> np.uint64(31))
+
+
+class BloomBandIndex:
+    """One Bloom filter per LSH band over uint32 band keys.
+
+    ``bits`` must be a power of two.  All batch operations are vectorised
+    numpy; nothing grows with the stream.
+    """
+
+    def __init__(
+        self,
+        num_bands: int,
+        *,
+        bits: int = 1 << 24,
+        num_hashes: int = 4,
+        seed: int = 0,
+    ):
+        if bits & (bits - 1):
+            raise ValueError(f"bits must be a power of two, got {bits}")
+        self.num_bands = num_bands
+        self.bits = bits
+        self.num_hashes = num_hashes
+        self.seed = seed
+        self._words = np.zeros((num_bands, bits // 64), dtype=np.uint64)
+        self.inserted = 0
+
+    # -- core --------------------------------------------------------------
+
+    def _positions(self, keys: np.ndarray) -> np.ndarray:
+        """uint64[B, nb, k] bit positions for ``uint32[B, nb]`` band keys."""
+        B, nb = keys.shape
+        base = keys.astype(np.uint64) ^ (
+            (np.arange(nb, dtype=np.uint64) + np.uint64(self.seed + 1)) << np.uint64(32)
+        )[None, :]
+        hs = np.stack(
+            [
+                _splitmix64(base + (np.uint64(h) << np.uint64(56)))
+                for h in range(self.num_hashes)
+            ],
+            axis=-1,
+        )
+        return hs & np.uint64(self.bits - 1)
+
+    def contains_batch(self, keys: np.ndarray) -> np.ndarray:
+        """bool[B]: any band of the row fully present in that band's filter."""
+        pos = self._positions(np.asarray(keys, dtype=np.uint32))
+        word = (pos >> np.uint64(6)).astype(np.int64)
+        bit = np.uint64(1) << (pos & np.uint64(63))
+        nb = self.num_bands
+        band_ix = np.arange(nb)[None, :, None]
+        present = (self._words[band_ix, word] & bit) != 0
+        return present.all(axis=2).any(axis=1)
+
+    def add_batch(self, keys: np.ndarray, mask: np.ndarray | None = None) -> None:
+        """Insert rows (optionally only where ``mask``) into every band filter."""
+        keys = np.asarray(keys, dtype=np.uint32)
+        if mask is not None:
+            keys = keys[np.asarray(mask, dtype=bool)]
+        if keys.size == 0:
+            return
+        pos = self._positions(keys)
+        word = (pos >> np.uint64(6)).astype(np.int64)
+        bit = np.uint64(1) << (pos & np.uint64(63))
+        band_ix = np.broadcast_to(
+            np.arange(self.num_bands)[None, :, None], word.shape
+        )
+        np.bitwise_or.at(self._words, (band_ix.ravel(), word.ravel()), bit.ravel())
+        self.inserted += keys.shape[0]
+
+    def check_and_add_batch(self, keys: np.ndarray) -> np.ndarray:
+        """Stream step: ``dup[B]`` per row, then insert the non-dup rows.
+
+        Cross-batch membership via the filters; intra-batch via true key
+        equality (vectorised first-occurrence per band) — so a batch of
+        identical documents yields one kept row, like the exact index.
+        Intra-batch matching is against *any* earlier row sharing the band
+        key, including rows themselves marked duplicate — marginally more
+        conservative than the exact index (which only matches kept rows);
+        a Bloom index cannot attribute representatives anyway.
+        """
+        keys = np.asarray(keys, dtype=np.uint32)
+        dup = self.contains_batch(keys)
+        B, nb = keys.shape
+        rows = np.arange(B)
+        for b in range(nb):
+            _, first_ix, inverse = np.unique(
+                keys[:, b], return_index=True, return_inverse=True
+            )
+            dup |= first_ix[inverse] < rows
+        self.add_batch(keys, mask=~dup)
+        return dup
+
+    # -- distribution ------------------------------------------------------
+
+    def merge(self, other: "BloomBandIndex") -> None:
+        """Exact union: bitwise OR (the cross-shard/cross-host merge)."""
+        if (self.bits, self.num_bands, self.num_hashes, self.seed) != (
+            other.bits,
+            other.num_bands,
+            other.num_hashes,
+            other.seed,
+        ):
+            raise ValueError("cannot merge differently-configured indexes")
+        np.bitwise_or(self._words, other._words, out=self._words)
+        self.inserted += other.inserted
+
+    @property
+    def memory_bytes(self) -> int:
+        return self._words.nbytes
+
+    def fill_ratio(self) -> float:
+        """Fraction of set bits (FP rate grows as this approaches 1)."""
+        return float(np.unpackbits(self._words.view(np.uint8)).mean())

@@ -1,0 +1,115 @@
+"""Bounded-memory streaming index (LSHBloom, arXiv:2411.04257).
+
+The bloom stream index must make the same keep/drop decisions as the exact
+index on realistic streams (attribution excepted — hits carry a sentinel),
+stay at fixed memory regardless of stream length, and merge exactly with
+bitwise OR (the cross-shard story).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from advanced_scrapper_tpu.config import DedupConfig
+from advanced_scrapper_tpu.extractors.tpu_batch import BLOOM_SENTINEL, TpuBatchBackend
+from advanced_scrapper_tpu.utils.bloom import BloomBandIndex
+
+
+def _keys(rows, nb=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 2**32, size=(rows, nb), dtype=np.uint32)
+
+
+def test_membership_and_intra_batch_first_seen():
+    ix = BloomBandIndex(16, bits=1 << 16)
+    k = _keys(8)
+    k[5] = k[2]  # intra-batch duplicate
+    dup = ix.check_and_add_batch(k)
+    assert dup.tolist() == [False] * 5 + [True, False, False]
+    # next batch: cross-batch membership of a previously kept row
+    k2 = _keys(4, seed=1)
+    k2[3] = k[0]
+    dup2 = ix.check_and_add_batch(k2)
+    assert dup2.tolist() == [False, False, False, True]
+
+
+def test_single_band_match_is_enough():
+    ix = BloomBandIndex(16, bits=1 << 16)
+    a = _keys(1)
+    ix.check_and_add_batch(a)
+    b = _keys(1, seed=9)
+    b[0, 7] = a[0, 7]  # share exactly one band
+    assert ix.check_and_add_batch(b).tolist() == [True]
+
+
+def test_memory_fixed_and_merge_is_union():
+    ix = BloomBandIndex(16, bits=1 << 16)
+    before = ix.memory_bytes
+    for seed in range(5):
+        ix.check_and_add_batch(_keys(64, seed=seed))
+    assert ix.memory_bytes == before == 16 * (1 << 16) // 8
+
+    left = BloomBandIndex(16, bits=1 << 16)
+    right = BloomBandIndex(16, bits=1 << 16)
+    ka, kb = _keys(32, seed=3), _keys(32, seed=4)
+    left.check_and_add_batch(ka)
+    right.check_and_add_batch(kb)
+    left.merge(right)
+    assert left.contains_batch(ka).all() and left.contains_batch(kb).all()
+    with pytest.raises(ValueError):
+        left.merge(BloomBandIndex(16, bits=1 << 17))
+
+
+def test_false_positive_rate_reasonable():
+    ix = BloomBandIndex(16, bits=1 << 16, num_hashes=4)
+    ix.check_and_add_batch(_keys(500, seed=0))
+    probe = _keys(2000, seed=99)
+    fp = ix.contains_batch(probe).mean()
+    assert fp < 0.01, f"FP rate {fp:.4f} too high for sizing"
+    assert 0.0 < ix.fill_ratio() < 0.5
+
+
+def _stream(backend, docs):
+    out = []
+    for i, text in enumerate(docs):
+        out += backend.submit({"url": f"https://x/{i}", "article": text})
+    out += backend.flush()
+    return out
+
+
+def test_backend_bloom_mode_matches_exact_decisions():
+    rng = np.random.RandomState(5)
+    base = ["".join(chr(c) for c in rng.randint(97, 123, size=300)) for _ in range(30)]
+    docs = list(base)
+    docs[7] = docs[2]          # near-dup stage catches identical text
+    docs[19] = docs[11] + "x"  # near dup
+    cfg_kw = dict(batch_size=8, block_len=512)
+    exact = _stream(TpuBatchBackend(DedupConfig(**cfg_kw)), docs)
+    bloom = _stream(
+        TpuBatchBackend(DedupConfig(stream_index="bloom", bloom_bits=1 << 16, **cfg_kw)),
+        docs,
+    )
+    for e, b in zip(exact, bloom):
+        assert (e["near_dup_of"] is None) == (b["near_dup_of"] is None), e["url"]
+        if b["near_dup_of"] is not None:
+            assert b["near_dup_of"] == BLOOM_SENTINEL
+
+
+def test_backend_bloom_mode_exact_url_dups():
+    docs = ["doc one body text here", "doc two body text here"]
+    backend = TpuBatchBackend(
+        DedupConfig(stream_index="bloom", bloom_bits=1 << 16, batch_size=2, block_len=512)
+    )
+    recs = []
+    recs += backend.submit({"url": "https://x/same", "article": docs[0]})
+    recs += backend.submit({"url": "https://x/same", "article": docs[1]})
+    recs += backend.flush()
+    assert recs[0]["dup_of"] is None
+    assert recs[1]["dup_of"] == BLOOM_SENTINEL
+    assert backend.stats.exact_dups == 1
+
+
+def test_backend_unknown_stream_index_rejected():
+    with pytest.raises(ValueError, match="stream_index"):
+        TpuBatchBackend(DedupConfig(stream_index="blom"))

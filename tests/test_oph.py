@@ -85,14 +85,38 @@ def test_raw_combine_equals_whole_doc():
     )
 
 
-def test_densify_fills_from_right_circularly():
+def test_densify_fills_from_right_with_distance_offset():
     sig = np.full((1, 8), U32_MAX, dtype=np.uint32)
     sig[0, 5] = 42
     out = np.asarray(densify(sig))
-    assert (out == 42).all()
+    C = 0x9E3779B1
+    # filled bin keeps its value; empty bins borrow 42 offset by their
+    # circular distance to bin 5 (the offset breaks spurious agreement of
+    # jointly-sparse documents — Shrivastava & Li ICML 2014)
+    assert out[0, 5] == 42
+    for i in range(8):
+        if i != 5:
+            d = (5 - i) % 8
+            assert out[0, i] == np.uint32((42 + d * C) & 0xFFFFFFFF), i
     # all-empty row stays the sentinel
     empty = np.full((1, 8), U32_MAX, dtype=np.uint32)
     assert (np.asarray(densify(empty)) == U32_MAX).all()
+
+
+def test_sparse_docs_agreement_not_inflated():
+    """Two short docs with one shared shingle region must NOT show inflated
+    signature agreement from densification replication."""
+    rng = np.random.RandomState(21)
+    shared = bytes(rng.randint(32, 127, size=20, dtype=np.uint8))
+    a = shared + bytes(rng.randint(32, 127, size=40, dtype=np.uint8))
+    b = shared + bytes(rng.randint(32, 127, size=40, dtype=np.uint8))
+    from advanced_scrapper_tpu.cpu.oracle import jaccard, shingle_set
+
+    true_j = jaccard(shingle_set(a, 5), shingle_set(b, 5))
+    tok, ln = encode_batch([a, b], block_len=64)
+    sig = np.asarray(oph_signatures(tok, ln, PARAMS))
+    est = float(np.mean(sig[0] == sig[1]))
+    assert est <= true_j + 0.15, f"agreement {est:.2f} inflated vs J={true_j:.2f}"
 
 
 def test_engine_backend_oph():
