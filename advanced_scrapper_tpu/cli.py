@@ -184,10 +184,16 @@ def _cmd_smoke(args: argparse.Namespace) -> int:
         ok = False
     from advanced_scrapper_tpu.cpu.hostbatch import hostbatch_backend
     from advanced_scrapper_tpu.cpu.native import _load as _fm_load
+    from advanced_scrapper_tpu.cpu import csvnative as _csv
     from advanced_scrapper_tpu.cpu import native as _fm
 
     _fm_load()
-    report["native"] = {"fastmatch": _fm.BACKEND, "hostbatch": hostbatch_backend()}
+    _csv._load()
+    report["native"] = {
+        "fastmatch": _fm.BACKEND,
+        "hostbatch": hostbatch_backend(),
+        "csvscan": _csv.BACKEND,
+    }
     try:
         from advanced_scrapper_tpu.net.transport import make_transport
 

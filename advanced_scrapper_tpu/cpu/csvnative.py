@@ -1,0 +1,97 @@
+"""ctypes loader for the C++ CSV column scanner (``native/csvscan.cpp``).
+
+Same compile-on-demand contract as ``cpu/native.py``: built with g++ on
+first use (mtime-cached .so), silent fallback to the Python ``csv`` module
+when no compiler is available — ``storage/csvio.py`` stays correct either
+way, the native path is just fast on the multi-GB resume files.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native", "csvscan.cpp")
+_LIB = os.path.join(os.path.dirname(_SRC), "libcsvscan.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+BACKEND = "unloaded"
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, BACKEND
+    with _lock:
+        if BACKEND != "unloaded":
+            return _lib
+        needs_build = (not os.path.exists(_LIB)) or (
+            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        )
+        if needs_build and not _build():
+            BACKEND = "python"
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            BACKEND = "python"
+            return None
+        lib.csv_scan_column.restype = ctypes.POINTER(ctypes.c_char)
+        lib.csv_scan_column.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_longlong),
+            ctypes.POINTER(ctypes.c_longlong),
+        ]
+        lib.csv_free.restype = None
+        lib.csv_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+        BACKEND = "native"
+        _lib = lib
+        return lib
+
+
+def scan_column(path: str, column: str) -> list[str] | None:
+    """All values of ``column`` from a well-formed CSV, or ``None`` when
+    the native library is unavailable, the file/column is missing, or the
+    bytes are not valid UTF-8 (callers fall back to the csv module)."""
+    lib = _load()
+    if lib is None:
+        return None
+    count = ctypes.c_longlong()
+    nbytes = ctypes.c_longlong()
+    ptr = lib.csv_scan_column(
+        path.encode("utf-8"), column.encode("utf-8"),
+        ctypes.byref(count), ctypes.byref(nbytes),
+    )
+    if not ptr:
+        return None
+    try:
+        raw = ctypes.string_at(ptr, nbytes.value)
+    finally:
+        lib.csv_free(ptr)
+    if count.value == 0:
+        return []
+    try:
+        text = raw.decode("utf-8")
+    except UnicodeDecodeError:
+        return None  # Python open() would also have raised; let csv path decide
+    vals = text.split("\0")
+    assert vals and vals[-1] == ""  # arena is value+NUL repeated
+    vals.pop()
+    if len(vals) != count.value:
+        return None  # a value contained NUL — ambiguous split; fall back
+    return vals

@@ -95,20 +95,34 @@ class ChaosTransport:
         latency_spike: tuple[float, float] = (0.0, 0.0),
         rate_limit_page: str | None = None,
     ):
+        import threading
+
         self._inner = inner
         self._seed = seed
         self._error_rate = error_rate
         self._neterror_rate = neterror_rate
         self._rl_page_rate = rate_limit_page_rate
         self._spike_rate, self._spike_secs = latency_spike
-        self._rl_page = rate_limit_page or (
-            "<html><body><p>Thank you for your patience.</p>"
-            "<p>Our engineers are working quickly to resolve the issue.</p>"
-            "</body></html>"
-        )
+        if rate_limit_page is None:
+            # build the default page from the extractor's own sentinel
+            # phrases so injection keeps tripping detection if they change
+            from advanced_scrapper_tpu.extractors.yfin import _RATE_LIMIT_NEEDLES
+
+            rate_limit_page = (
+                "<html><body>"
+                + "".join(f"<p>{needle}</p>" for needle in _RATE_LIMIT_NEEDLES)
+                + "</body></html>"
+            )
+        self._rl_page = rate_limit_page
+        # engine workers share one transport: counter updates must not race
+        self._count_lock = threading.Lock()
         self.injected: dict[str, int] = {
             "error": 0, "neterror": 0, "rate_limit_page": 0, "spike": 0
         }
+
+    def _count(self, kind: str) -> None:
+        with self._count_lock:
+            self.injected[kind] += 1
 
     def fetch(self, url: str) -> str:
         import random
@@ -117,16 +131,16 @@ class ChaosTransport:
         # across processes and threads, unlike the builtin str hash
         r = random.Random(f"{self._seed}|{url}").random
         if self._spike_rate and r() < self._spike_rate:
-            self.injected["spike"] += 1
+            self._count("spike")
             time.sleep(self._spike_secs)
         if self._error_rate and r() < self._error_rate:
-            self.injected["error"] += 1
+            self._count("error")
             raise FetchError(f"injected fault for {url}")
         if self._neterror_rate and r() < self._neterror_rate:
-            self.injected["neterror"] += 1
+            self._count("neterror")
             raise FetchError(f"about:neterror (injected) for {url}")
         if self._rl_page_rate and r() < self._rl_page_rate:
-            self.injected["rate_limit_page"] += 1
+            self._count("rate_limit_page")
             return self._rl_page
         return self._inner.fetch(url)
 

@@ -48,9 +48,21 @@ class AppendCsv:
 
 
 def read_url_column(path: str, column: str = "url") -> list[str]:
-    """Read one column as strings (pandas-free fast path)."""
+    """Read one column as strings.
+
+    Served by the C++ scanner (``native/csvscan.cpp``) when available —
+    the resume anti-join re-reads multi-GB article CSVs on every start,
+    the same job the reference hands to pandas' C parser
+    (``constant_rate_scrapper.py:316-356``) — with a byte-equal Python
+    ``csv`` fallback (equivalence is golden- and fuzz-tested).
+    """
     if not os.path.exists(path):
         return []
+    from advanced_scrapper_tpu.cpu.csvnative import scan_column
+
+    native = scan_column(path, column)
+    if native is not None:
+        return native
     out: list[str] = []
     with open(path, newline="", encoding="utf-8") as fh:
         for row in csv.DictReader(fh):

@@ -1,0 +1,125 @@
+"""C++ CSV column scanner vs the Python csv module: value-equal, always.
+
+The native scanner serves the resume anti-join (multi-GB article CSVs
+whose values embed commas, quotes, and newlines); any divergence from
+csv.DictReader would silently corrupt resume.  Golden cases + randomized
+round-trip fuzzing against csv.writer output.
+"""
+
+from __future__ import annotations
+
+import csv
+import random
+import string
+
+import pytest
+
+from advanced_scrapper_tpu.cpu import csvnative
+from advanced_scrapper_tpu.storage.csvio import read_url_column
+
+
+def _python_column(path: str, column: str) -> list[str]:
+    out = []
+    with open(path, newline="", encoding="utf-8") as fh:
+        for row in csv.DictReader(fh):
+            v = row.get(column)
+            if v is not None:
+                out.append(str(v))
+    return out
+
+
+def _write(path, header, rows):
+    with open(path, "w", newline="", encoding="utf-8") as fh:
+        w = csv.writer(fh)
+        w.writerow(header)
+        w.writerows(rows)
+
+
+NASTY = [
+    "plain",
+    "",
+    "comma, inside",
+    'quote " inside',
+    'doubled "" quotes',
+    "newline\ninside",
+    "crlf\r\ninside",
+    "both, \"and\"\nmore",
+    "ünïcødé — 統一碼",
+    "trailing space ",
+    '"fully quoted looking"',
+]
+
+
+@pytest.fixture(autouse=True)
+def _require_native():
+    if csvnative._load() is None:
+        pytest.skip("no C++ toolchain")
+
+
+def test_golden_nasty_values(tmp_path):
+    p = str(tmp_path / "nasty.csv")
+    rows = [[v, f"https://x/{i}", v[::-1]] for i, v in enumerate(NASTY)]
+    _write(p, ["article", "url", "tail"], rows)
+    for col in ("article", "url", "tail"):
+        native = csvnative.scan_column(p, col)
+        assert native is not None
+        assert native == _python_column(p, col), col
+
+
+def test_missing_column_and_file(tmp_path):
+    p = str(tmp_path / "a.csv")
+    _write(p, ["a", "b"], [["1", "2"]])
+    assert csvnative.scan_column(p, "nope") is None  # caller falls back
+    assert read_url_column(p, "nope") == []          # fallback parity
+    assert csvnative.scan_column(str(tmp_path / "missing.csv"), "a") is None
+
+
+def test_blank_lines_and_short_long_rows(tmp_path):
+    p = str(tmp_path / "ragged.csv")
+    with open(p, "w", newline="", encoding="utf-8") as fh:
+        fh.write("url,title\n")
+        fh.write("\n")                      # blank: skipped
+        fh.write("https://x/1,t1\n")
+        fh.write("https://x/2\n")           # short row: still has url col
+        fh.write("https://x/3,t3,extra\n")  # long row: extras ignored
+    native = csvnative.scan_column(p, "url")
+    assert native == _python_column(p, "url")
+    title = csvnative.scan_column(p, "title")
+    assert title == _python_column(p, "title")  # short row contributes none
+
+
+def test_header_only_and_empty_values(tmp_path):
+    p = str(tmp_path / "h.csv")
+    _write(p, ["url"], [])
+    assert csvnative.scan_column(p, "url") == []
+    _write(p, ["url", "x"], [["", "1"], ["", ""]])
+    assert csvnative.scan_column(p, "url") == ["", ""]
+
+
+def test_fuzz_roundtrip_vs_csv_module(tmp_path):
+    rng = random.Random(123)
+    alphabet = string.ascii_letters + ' ,"\n\r\t\'' + "é漢"
+    p = str(tmp_path / "fuzz.csv")
+    for trial in range(20):
+        ncols = rng.randint(1, 5)
+        header = [f"c{j}" for j in range(ncols)]
+        rows = [
+            [
+                "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 30)))
+                for _ in range(ncols)
+            ]
+            for _ in range(rng.randint(0, 40))
+        ]
+        _write(p, header, rows)
+        col = rng.choice(header)
+        native = csvnative.scan_column(p, col)
+        assert native == _python_column(p, col), f"trial {trial} col {col}"
+
+
+def test_read_url_column_uses_native_and_matches(tmp_path):
+    p = str(tmp_path / "resume.csv")
+    rows = [[f"https://x/{i}", f'body "{i}", with\nnewline'] for i in range(500)]
+    _write(p, ["url", "article"], rows)
+    got = read_url_column(p)
+    assert got == [r[0] for r in rows]
+    assert csvnative.BACKEND == "native"
