@@ -1,0 +1,189 @@
+"""Myers bit-parallel semi-global edit distance — the device fuzzy scorer.
+
+SURVEY.md §7 names a "fuzzy ``partial_ratio``-equivalent scoring kernel"
+as a kernels/ deliverable.  Exact rapidfuzz ``partial_ratio`` is a
+max-over-windows LCS ratio — branchy and window-quadratic, a poor fit for
+the MXU/VPU — but a *sound upper bound* on it is computable in one linear
+scan with Myers' 1999 bit-parallel approximate-matching algorithm: the
+minimum Levenshtein distance ``d`` between the pattern and ANY substring
+of the text (semi-global: free start and end in the text), carried as two
+32-bit bitvectors per pair, ~12 integer ops per text byte, ``vmap``-batched
+over pairs and ``lax.scan``-ned over text positions.
+
+Soundness (why pruning on the bound can never drop a true match): for the
+best window ``w*`` (``|w*| ≤ m`` — rapidfuzz windows never exceed the
+pattern length),
+
+    partial_ratio = 100·(1 − d_indel(p, w*)/(m + |w*|))
+                  ≤ 100·(1 − d_lev(p, w*)/(2m))      (d_indel ≥ d_lev, m+|w*| ≤ 2m)
+                  ≤ 100·(1 − d_semi/(2m))            (w* is one substring)
+
+so ``bound = 100·(1 − d_semi/(2m)) ≥ partial_ratio`` always; a pair with
+``bound ≤ threshold`` is safe to prune before the exact host scorer
+(``cpu/fuzz.py`` / ``native/fastmatch.cpp``).  Fuzz-tested against the
+oracle.  The kernel applies only when ``len(text) ≥ len(pattern)`` and
+``len(pattern) ≤ 32`` (one uint32 lane per pair); other pairs pass through
+unpruned.
+
+This complements the q-gram screen (``ops/match.py``): the screen is a
+presence bitmap (no order information), this kernel is a true alignment
+bound — together they remove almost all host-side quadratic scoring.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAX_PATTERN = 32  # one uint32 bitvector lane per pair
+
+
+def build_pattern_masks(patterns: list[bytes]) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Per-pattern Myers match masks.
+
+    Returns ``(masks uint32[N, 256], lens int32[N], ok bool[N])`` — ``ok``
+    is False for empty or >32-byte patterns (callers must pass those
+    through unpruned).
+    """
+    n = len(patterns)
+    masks = np.zeros((n, 256), dtype=np.uint32)
+    lens = np.zeros((n,), dtype=np.int32)
+    ok = np.zeros((n,), dtype=bool)
+    for i, p in enumerate(patterns):
+        m = len(p)
+        if m == 0 or m > MAX_PATTERN:
+            continue
+        lens[i] = m
+        ok[i] = True
+        for j, byte in enumerate(p):
+            masks[i, byte] |= np.uint32(1) << np.uint32(j)
+    return masks, lens, ok
+
+
+@partial(jax.jit, static_argnames=("block",))
+def semiglobal_dist(
+    masks: jnp.ndarray,   # uint32[B, 256] per-pair pattern masks
+    plens: jnp.ndarray,   # int32[B] pattern lengths (1..32)
+    text: jnp.ndarray,    # uint8[B, L] per-pair text
+    tlens: jnp.ndarray,   # int32[B] text lengths
+    *,
+    block: int = 512,
+) -> jnp.ndarray:
+    """int32[B]: min Levenshtein distance of pattern vs a text substring.
+
+    The scan is *blocked*: the text splits into ``block``-byte tiles with a
+    ``MAX_PATTERN-1``-byte overlap, all tiles advancing in lock-step as
+    extra batch lanes — the sequential scan is ``block+31`` steps instead
+    of ``L`` (Myers' carry chain is inherently sequential per tile, so the
+    parallelism must come from the tile axis).  Every substring of length
+    ≤ ``MAX_PATTERN`` lies inside one tile, so the result equals the true
+    semi-global distance whenever the optimal substring is that short —
+    and is an upper bound on it otherwise, which preserves the
+    partial_ratio bound's soundness (rapidfuzz windows never exceed the
+    pattern length).  Empty text (or ``tlens == 0``) gives ``plens``.
+    """
+    B, L = text.shape
+    one = jnp.uint32(1)
+    full = jnp.uint32(0xFFFFFFFF)
+    O = MAX_PATTERN - 1
+    nb = max(1, -(-L // block))
+    # [B, nb, block+O]: overlapping tiles, sliced from the flat padded text
+    # (the O-byte tail may span several following tiles when block < O)
+    padded = jnp.pad(text, ((0, 0), (0, nb * block + O - L)))
+    ext = jnp.stack(
+        [padded[:, s : s + block + O] for s in range(0, nb * block, block)],
+        axis=1,
+    )
+    starts = (jnp.arange(nb) * block).astype(jnp.int32)
+    eff = jnp.clip(tlens[:, None] - starts[None, :], 0, block + O)  # [B, nb]
+
+    # clamp: rows with plen 0 (inapplicable, caller discards) must not
+    # shift by -1
+    plens = jnp.maximum(plens.astype(jnp.int32), 1)
+    high = (one << (plens.astype(jnp.uint32) - one))[:, None]  # [B, 1]
+    p0 = jnp.broadcast_to(plens[:, None], (B, nb)).astype(jnp.int32)
+
+    def step(carry, j):
+        pv, mv, score, best = carry                      # each [B, nb]
+        c = ext[:, :, j].astype(jnp.int32)               # [B, nb]
+        eq = jnp.take_along_axis(masks, c, axis=1)       # [B, nb]
+        xv = eq | mv
+        xh = (((eq & pv) + pv) ^ pv) | eq
+        ph = mv | ~(xh | pv)
+        mh = pv & xh
+        score2 = score + ((ph & high) != 0) - ((mh & high) != 0)
+        # search variant: D[0][j] = 0 for every j (a match may start
+        # anywhere), so the row-0 horizontal delta is 0 — shift WITHOUT
+        # setting bit 0 (the global-distance variant would or-in 1 here)
+        ph = ph << one
+        mh = mh << one
+        pv2 = mh | ~(xv | ph)
+        mv2 = ph & xv
+        live = j < eff
+        pv = jnp.where(live, pv2, pv)
+        mv = jnp.where(live, mv2, mv)
+        score = jnp.where(live, score2, score)
+        best = jnp.where(live, jnp.minimum(best, score), best)
+        return (pv, mv, score, best), None
+
+    init = (jnp.full((B, nb), full), jnp.zeros((B, nb), dtype=jnp.uint32), p0, p0)
+    (_, _, _, best), _ = jax.lax.scan(step, init, jnp.arange(block + O))
+    return best.min(axis=1)
+
+
+def partial_ratio_bound(dist: np.ndarray, plens: np.ndarray) -> np.ndarray:
+    """``100·(1 − d/(2m))`` — the sound upper bound on partial_ratio."""
+    m = np.maximum(np.asarray(plens, dtype=np.float64), 1.0)
+    return 100.0 * (1.0 - np.asarray(dist, dtype=np.float64) / (2.0 * m))
+
+
+def prune_mask_tables(
+    tables: tuple[np.ndarray, np.ndarray, np.ndarray],  # (masks, lens, ok)
+    texts_tok: np.ndarray,   # uint8[P, L] gathered text per pair
+    text_lens: np.ndarray,   # int32[P]
+    pattern_ix: np.ndarray,  # int32[P] index into patterns per pair
+    threshold: float,
+) -> np.ndarray:
+    """bool[P]: True where the pair can be PRUNED (bound ≤ threshold).
+
+    ``tables`` is a prebuilt :func:`build_pattern_masks` result — build it
+    once per entity index, not per slice.  Pairs whose pattern is
+    empty/overlong, or whose text is shorter than the pattern, are never
+    pruned (the bound's soundness argument needs ``|w| ≤ m`` windows over
+    a text at least as long as the pattern).
+    """
+    masks, lens, ok = tables
+    pattern_ix = np.asarray(pattern_ix, dtype=np.int32)
+    applicable = ok[pattern_ix] & (
+        np.asarray(text_lens, dtype=np.int32) >= lens[pattern_ix]
+    )
+    if not applicable.any():
+        return np.zeros(len(pattern_ix), dtype=bool)
+    d = np.asarray(
+        semiglobal_dist(
+            jnp.asarray(masks[pattern_ix]),
+            jnp.asarray(lens[pattern_ix]),
+            jnp.asarray(texts_tok),
+            jnp.asarray(text_lens, dtype=np.int32),
+        )
+    )
+    bound = partial_ratio_bound(d, lens[pattern_ix])
+    return applicable & (bound <= threshold)
+
+
+def prune_mask(
+    patterns: list[bytes],
+    texts_tok: np.ndarray,
+    text_lens: np.ndarray,
+    pattern_ix: np.ndarray,
+    threshold: float,
+) -> np.ndarray:
+    """One-shot convenience over :func:`prune_mask_tables` (builds the
+    mask tables on every call — fine for tests/small inputs, use the
+    tables form in loops)."""
+    return prune_mask_tables(
+        build_pattern_masks(patterns), texts_tok, text_lens, pattern_ix, threshold
+    )

@@ -24,7 +24,14 @@ The TPU reroute: instead of scanning every (article × name) pair on the
 host (the reference's quadratic hot loop), a device q-gram screen
 (``ops/match.py``) prunes pairs first; only survivors are verified with the
 exact host rules above, so outputs cannot differ — golden-tested against a
-pure reference implementation.
+pure reference implementation.  A second device stage (``use_refine``: the
+Myers alignment bound, ``ops/editdist.py``) can prune screen survivors
+whose text-side fuzzy score is provably ≤ threshold before the host scorer
+runs — output-identical (golden-tested) but **off by default**: measured
+2026-07 through the tunnel-attached chip, per-slice dispatch latency
+dominates (63 s vs 2.6 s screen-only on a 256-row adversarial-decoy
+corpus), so it only pays on deployments with device-local dispatch and
+large entity sets.
 
 Documented divergences from the reference (both are reference *crashes*):
 - a fuzzy-matched name that is itself an invalid regex falls back to
@@ -228,11 +235,16 @@ def match_article(
     index: EntityIndex,
     candidate_mask: np.ndarray | None = None,
     threshold: float = 95.0,
+    text_pruned: set | None = None,
 ) -> dict:
     """Exact match rules for one article → {ticker: {'text': …, 'title': …}}.
 
     ``candidate_mask[j]`` (from the TPU screen) prunes name j; None means
     scan everything (the pure reference path used for goldens).
+    ``text_pruned`` holds name indices whose *text-side* fuzzy score is
+    device-proven ≤ threshold (``ops/editdist.py`` Myers bound) — the
+    expensive long-text ``partial_ratio`` call is skipped for those; the
+    title side still runs (the bound applies per part).
     """
     per_ticker: dict[str, dict] = {}
 
@@ -256,7 +268,8 @@ def match_article(
         else:
             # the score is the decision; positions recorded even if empty
             # (ref :174-180)
-            if native.partial_ratio(text, e.name) > threshold:
+            text_possible = text_pruned is None or j not in text_pruned
+            if text_possible and native.partial_ratio(text, e.name) > threshold:
                 slot(e.ticker)["text"][e.name] = _find_positions_literal_fallback(
                     e.name, text
                 )
@@ -274,11 +287,79 @@ def _get_col(row, *candidates, default=""):
     return default
 
 
+def _refine_candidates(index: EntityIndex):
+    """Fuzzy names the Myers bound kernel can handle: non-exact-upper,
+    1..MAX_PATTERN bytes, pure ASCII (the bound is byte-level; multi-byte
+    chars would break its soundness vs the char-level oracle).  Returns
+    ``(name_indices, names, mask_tables)`` — the tables are built once
+    here, not per slice."""
+    from advanced_scrapper_tpu.ops.editdist import MAX_PATTERN, build_pattern_masks
+
+    ix, names = [], []
+    for j, e in enumerate(index.entries):
+        nb = e.name.encode("utf-8", "replace")
+        if not e.is_exact_upper and 0 < len(nb) <= MAX_PATTERN and nb.isascii():
+            ix.append(j)
+            names.append(nb)
+    return np.asarray(ix, dtype=np.int64), names, build_pattern_masks(names)
+
+
+def _refine_batch(
+    batch,
+    got: np.ndarray,
+    overlong,
+    fuzzy_ix: np.ndarray,
+    fuzzy_names: list,
+    mask_tables,
+    threshold: float,
+    *,
+    max_pairs: int = 1024,
+) -> list[set | None]:
+    """Per-row sets of name indices whose text-side score is device-proven
+    ≤ threshold.  Non-ASCII texts pass through (byte/char mismatch)."""
+    from advanced_scrapper_tpu.core.tokenizer import encode_batch
+    from advanced_scrapper_tpu.ops.editdist import prune_mask_tables
+
+    name_lens = np.array([len(n) for n in fuzzy_names], dtype=np.int64)
+    pair_row: list[int] = []
+    pair_k: list[int] = []
+    for i, (text, _title, _d, _r) in enumerate(batch):
+        if overlong[i] or not text or not text.isascii():
+            continue
+        sel = np.nonzero(got[i][fuzzy_ix] & (len(text) >= name_lens))[0]
+        pair_row.extend([i] * len(sel))
+        pair_k.extend(sel.tolist())
+    out: list[set | None] = [None] * len(batch)
+    if not pair_row:
+        return out
+    row_ids = sorted(set(pair_row))
+    pos = {r: k for k, r in enumerate(row_ids)}
+    tok, ln = encode_batch([batch[r][0] for r in row_ids])
+    for start in range(0, len(pair_row), max_pairs):
+        rows_s = pair_row[start : start + max_pairs]
+        ks = pair_k[start : start + max_pairs]
+        # pad the slice to a fixed pair count: the jitted kernel would
+        # otherwise recompile for every distinct remainder size
+        pad = max_pairs - len(rows_s)
+        t_ix = np.array([pos[r] for r in rows_s] + [pos[rows_s[0]]] * pad)
+        ks_p = np.array(ks + [ks[0]] * pad)
+        pruned = prune_mask_tables(
+            mask_tables, tok[t_ix], ln[t_ix], ks_p, threshold
+        )
+        for r, k, p in zip(rows_s, ks, pruned):
+            if p:
+                if out[r] is None:
+                    out[r] = set()
+                out[r].add(int(fuzzy_ix[k]))
+    return out
+
+
 def match_chunk(
     chunk: pd.DataFrame,
     index: EntityIndex,
     *,
     use_screen: bool = True,
+    use_refine: bool = False,
     screen_batch: int = 128,
     screen_block: int = 1 << 16,
     threshold: float = 95.0,
@@ -302,8 +383,12 @@ def match_chunk(
         rows.append((text, title, adate, row))
 
     masks: list[np.ndarray | None] = [None] * len(rows)
+    text_prunes: list[set | None] = [None] * len(rows)
     if use_screen and index.entries:
         tables = index.screen_tables()
+        fuzzy_ix, fuzzy_names, mask_tables = (
+            _refine_candidates(index) if use_refine else (np.array([]), [], None)
+        )
         for start in range(0, len(rows), screen_batch):
             batch = rows[start : start + screen_batch]
             # bitmap over title+text; part lengths drive the soundness bounds
@@ -325,10 +410,17 @@ def match_chunk(
             for i in range(len(batch)):
                 # articles longer than the screen block fall back to full scan
                 masks[start + i] = None if overlong[i] else got[i]
+            if len(fuzzy_ix):
+                prunes = _refine_batch(
+                    batch, got, overlong, fuzzy_ix, fuzzy_names, mask_tables,
+                    threshold,
+                )
+                for i, pr in enumerate(prunes):
+                    text_prunes[start + i] = pr
 
     out = []
-    for (text, title, adate, row), mask in zip(rows, masks):
-        matches = match_article(text, title, adate, index, mask, threshold)
+    for (text, title, adate, row), mask, pruned in zip(rows, masks, text_prunes):
+        matches = match_article(text, title, adate, index, mask, threshold, pruned)
         for ticker, m in matches.items():
             out.append((ticker, m, row))
     return out

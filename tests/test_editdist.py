@@ -1,0 +1,236 @@
+"""Myers semi-global kernel: exact vs brute-force DP, and bound soundness.
+
+Two properties carry the whole design:
+1. the kernel computes EXACTLY the semi-global Levenshtein distance
+   (min over text substrings), verified against an independent DP;
+2. ``100·(1 − d/(2m))`` is ≥ the oracle ``partial_ratio`` on every input
+   where the kernel applies — pruning at any threshold is lossless.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from advanced_scrapper_tpu.cpu.fuzz import partial_ratio
+from advanced_scrapper_tpu.ops.editdist import (
+    MAX_PATTERN,
+    build_pattern_masks,
+    partial_ratio_bound,
+    prune_mask,
+    semiglobal_dist,
+)
+
+
+def _dp_semiglobal(pattern: bytes, text: bytes) -> int:
+    """Reference DP: min Levenshtein distance of pattern vs any substring
+    (free start/end in text): D[0][j] = 0, answer = min_j D[m][j]."""
+    m, n = len(pattern), len(text)
+    prev = list(range(m + 1))  # D[i][0] = i
+    best = prev[m] if n == 0 else m
+    col = [0] * (m + 1)
+    for j in range(1, n + 1):
+        col[0] = 0
+        for i in range(1, m + 1):
+            cost = 0 if pattern[i - 1] == text[j - 1] else 1
+            col[i] = min(prev[i - 1] + cost, prev[i] + 1, col[i - 1] + 1)
+        best = min(best, col[m])
+        prev, col = col, prev
+    return best
+
+
+def _run_kernel(pairs):
+    patterns = [p for p, _ in pairs]
+    texts = [t for _, t in pairs]
+    L = max(1, max(len(t) for t in texts))
+    tok = np.zeros((len(pairs), L), dtype=np.uint8)
+    tlen = np.zeros((len(pairs),), dtype=np.int32)
+    for i, t in enumerate(texts):
+        tok[i, : len(t)] = np.frombuffer(t, dtype=np.uint8)
+        tlen[i] = len(t)
+    masks, lens, ok = build_pattern_masks(patterns)
+    assert ok.all()
+    return np.asarray(
+        semiglobal_dist(jnp.asarray(masks), jnp.asarray(lens), jnp.asarray(tok), jnp.asarray(tlen))
+    ), lens
+
+
+def test_kernel_matches_dp_exactly():
+    rng = np.random.RandomState(0)
+    pairs = []
+    for _ in range(60):
+        m = rng.randint(1, MAX_PATTERN + 1)
+        n = rng.randint(0, 80)
+        # small alphabet → frequent near-matches, exercises the carry chain
+        p = bytes(rng.randint(97, 101, size=m, dtype=np.uint8))
+        t = bytes(rng.randint(97, 101, size=n, dtype=np.uint8))
+        pairs.append((p, t))
+    # planted exact and near matches
+    base = b"financialnews"
+    pairs.append((base, b"xxxx" + base + b"yyyy"))            # d = 0
+    pairs.append((base, b"xxxx" + base[:6] + b"Q" + base[7:]))  # d = 1
+    pairs.append((b"abc", b""))                                # d = m
+    dist, _ = _run_kernel(pairs)
+    for k, (p, t) in enumerate(pairs):
+        assert dist[k] == _dp_semiglobal(p, t), (p, t, int(dist[k]))
+
+
+def test_blocked_scan_finds_matches_spanning_tile_boundaries():
+    """A fuzzy occurrence straddling a tile boundary must still be found
+    (tiles overlap by MAX_PATTERN-1 bytes)."""
+    import jax.numpy as jnp
+    from advanced_scrapper_tpu.ops.editdist import semiglobal_dist
+
+    rng = np.random.RandomState(4)
+    pattern = b"entitymatching"  # 14 bytes
+    for block in (16, 64, 128):
+        for pos in (block - 7, block - 1, block, 2 * block - 3):
+            t = bytearray(rng.randint(97, 105, size=3 * block, dtype=np.uint8))
+            t[pos : pos + len(pattern)] = pattern
+            t = bytes(t[: 3 * block])
+            masks, lens, ok = build_pattern_masks([pattern])
+            tok = np.frombuffer(t, dtype=np.uint8)[None, :]
+            d = np.asarray(
+                semiglobal_dist(
+                    jnp.asarray(masks), jnp.asarray(lens),
+                    jnp.asarray(tok), jnp.asarray([len(t)], dtype=np.int32),
+                    block=block,
+                )
+            )[0]
+            assert d == 0, (block, pos, int(d))
+
+
+def test_bound_is_sound_vs_partial_ratio_oracle():
+    rng = np.random.RandomState(1)
+    pairs = []
+    for _ in range(80):
+        m = rng.randint(1, 20)
+        n = rng.randint(m, 120)  # kernel applies only when text >= pattern
+        p = bytes(rng.randint(97, 105, size=m, dtype=np.uint8))
+        t = bytearray(rng.randint(97, 105, size=n, dtype=np.uint8))
+        if rng.rand() < 0.5:  # plant a fuzzy occurrence
+            pos = rng.randint(0, n - m + 1)
+            t[pos : pos + m] = p
+            if rng.rand() < 0.5 and m > 2:
+                t[pos + m // 2] = 81  # one edit
+        pairs.append((p, bytes(t)))
+    dist, lens = _run_kernel(pairs)
+    bound = partial_ratio_bound(dist, lens)
+    for k, (p, t) in enumerate(pairs):
+        true = partial_ratio(p.decode(), t.decode())
+        assert bound[k] >= true - 1e-9, (p, t, bound[k], true)
+
+
+def test_prune_mask_keeps_all_true_matches():
+    names = [b"Apple", b"Microsoft Corp", b"x" * 40]  # last: overlong, never pruned
+    texts = [
+        b"shares of Apple rose today",          # true match for names[0]
+        b"totally unrelated text 0123456789",   # prunable vs names[0]
+        b"microsoft corp lowercased",           # case-sensitive: weak match
+        b"tiny",                                # shorter than names[1]
+    ]
+    L = 64
+    tok = np.zeros((4, L), dtype=np.uint8)
+    tlen = np.zeros((4,), dtype=np.int32)
+    for i, t in enumerate(texts):
+        tok[i, : len(t)] = np.frombuffer(t, dtype=np.uint8)
+        tlen[i] = len(t)
+    pattern_ix = np.array([0, 0, 1, 1], dtype=np.int32)
+    pruned = prune_mask(names, tok, tlen, pattern_ix, threshold=95.0)
+    # the true match survives
+    assert not pruned[0]
+    # random text vs "Apple" is provably below 95
+    assert pruned[1]
+    # text shorter than pattern: never pruned (bound not applicable)
+    assert not pruned[3]
+    # pruning is sound everywhere the oracle can check
+    for k in range(4):
+        if pruned[k]:
+            true = partial_ratio(
+                names[pattern_ix[k]].decode(), texts[k].decode()
+            )
+            assert true <= 95.0
+
+
+def test_matcher_refine_skips_host_scoring_without_changing_output(monkeypatch):
+    """The device bound must eliminate text-side partial_ratio calls on
+    unrelated articles while leaving the match output bit-identical."""
+    import json
+
+    import pandas as pd
+
+    from advanced_scrapper_tpu.cpu import native
+    from advanced_scrapper_tpu.pipeline import matcher as M
+
+    entities = [
+        {
+            "id_label": "Apple Inc.",
+            "ticker": "AAPL",
+            "country": ["United States"],
+            "industry": [],
+            "aliases": ["Tim Cook", "Apple Inc."],
+            "products": ["iPhone"],
+            "subsidiaries": [],
+            "owned_entities": [],
+            "ceos": [],
+            "board_members": [],
+        }
+    ]
+    idx = M.EntityIndex(M.process_json_data(entities))
+    rng = np.random.RandomState(2)
+    rows = []
+    for i in range(24):
+        body = "".join(
+            chr(c) for c in rng.randint(97, 123, size=400)
+        )
+        # q-gram decoy: every 3-gram of "Tim Cook" is present ("Tim Coop…",
+        # "…booked") but no window scores > 95 — the presence screen passes
+        # it, only the alignment bound can prune it before the host scorer
+        body += " Tim Cooperation booked gains."
+        if i % 6 == 0:
+            body += " Tim Cook spoke about the new iPhone lineup at Apple Inc."
+        rows.append(
+            {
+                "article_text": body,
+                "title": "daily wrap",
+                "date_time": "2020-06-01T00:00:00Z",
+                "url": f"https://x/{i}.html",
+                "source": "s",
+                "source_url": "su",
+            }
+        )
+    df = pd.DataFrame(rows)
+
+    calls = {"n": 0}
+    real = native.partial_ratio
+
+    def counting(text, name):
+        calls["n"] += 1
+        return real(text, name)
+
+    monkeypatch.setattr(M.native, "partial_ratio", counting)
+
+    calls["n"] = 0
+    refined = M.match_chunk(df, idx, use_screen=True, use_refine=True)
+    refined_calls = calls["n"]
+
+    calls["n"] = 0
+    unrefined = M.match_chunk(df, idx, use_screen=True, use_refine=False)
+    unrefined_calls = calls["n"]
+
+    def norm(res):
+        return sorted(
+            (t, json.dumps(m, sort_keys=True), r["url"]) for t, m, r in res
+        )
+
+    assert norm(refined) == norm(unrefined)
+    assert norm(refined) == norm(M.match_chunk(df, idx, use_screen=False))
+    assert refined_calls < unrefined_calls, (refined_calls, unrefined_calls)
+
+
+def test_overlong_and_empty_patterns_pass_through():
+    names = [b"", b"y" * (MAX_PATTERN + 1)]
+    tok = np.zeros((2, 8), dtype=np.uint8) + 97
+    tlen = np.array([8, 8], dtype=np.int32)
+    pruned = prune_mask(names, tok, tlen, np.array([0, 1]), threshold=95.0)
+    assert not pruned.any()
