@@ -68,7 +68,17 @@ def _load() -> ctypes.CDLL | None:
         lib.hb_create.restype = ctypes.c_void_p
         lib.hb_create.argtypes = [ctypes.c_long, ctypes.c_long]
         lib.hb_push.restype = ctypes.c_int
-        lib.hb_push.argtypes = [ctypes.c_void_p, u8p, ctypes.c_long, ctypes.c_uint64]
+        # c_char_p: bytes pass zero-copy (the C side copies into its arena;
+        # explicit length keeps embedded NULs intact)
+        lib.hb_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_uint64
+        ]
+        lib.hb_push_many.restype = ctypes.c_long
+        lib.hb_push_many.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
         lib.hb_pop_batch.restype = ctypes.c_long
         lib.hb_pop_batch.argtypes = [
             ctypes.c_void_p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
@@ -107,8 +117,27 @@ class _NativeBatcher:
         self._h = ctypes.c_void_p(lib.hb_create(max_docs, arena_bytes))
 
     def push(self, doc: bytes, tag: int) -> bool:
-        buf = (ctypes.c_uint8 * len(doc)).from_buffer_copy(doc) if doc else None
-        return bool(self._lib.hb_push(self._h, buf, len(doc), tag))
+        return bool(self._lib.hb_push(self._h, doc, len(doc), tag))
+
+    def push_many(self, docs: list[bytes], tags) -> int:
+        """One C call for a whole list; returns docs accepted (prefix)."""
+        n = min(len(docs), len(tags))  # zip-truncate like the Python twin;
+        docs = docs[:n]                # C reads exactly n tags — no OOB
+        if n == 0:
+            return 0
+        offsets = np.zeros((n + 1,), dtype=np.int64)
+        np.cumsum([len(d) for d in docs], out=offsets[1:])
+        blob = b"".join(docs)
+        tag_arr = np.ascontiguousarray(tags, dtype=np.uint64)
+        return int(
+            self._lib.hb_push_many(
+                self._h,
+                blob,
+                offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+                n,
+                tag_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            )
+        )
 
     def pop_batch(self, batch: int, block: int, timeout_ms: int):
         tokens = np.zeros((batch, block), dtype=np.uint8)
@@ -174,6 +203,14 @@ class _PyBatcher:
             self._pushed += 1
             self._cv.notify()
             return True
+
+    def push_many(self, docs: list[bytes], tags) -> int:
+        n = 0
+        for doc, tag in zip(docs, tags):
+            if not self.push(doc, int(tag)):
+                break
+            n += 1
+        return n
 
     def pop_batch(self, batch: int, block: int, timeout_ms: int):
         tokens = np.zeros((batch, block), dtype=np.uint8)
@@ -259,6 +296,11 @@ class HostBatcher:
     def push(self, doc: str | bytes, tag: int) -> bool:
         """Queue one document; False = backpressure (caller retries/drops)."""
         return self._impl.push(_enc(doc), tag)
+
+    def push_many(self, docs, tags) -> int:
+        """Queue a list in one native call (~3× the one-at-a-time rate);
+        returns the accepted prefix length — backpressure stops the rest."""
+        return self._impl.push_many([_enc(d) for d in docs], tags)
 
     def push_blocking(
         self, doc: str | bytes, tag: int, *, poll_s: float = 0.005, timeout_s: float = 60.0

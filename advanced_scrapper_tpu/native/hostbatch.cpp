@@ -69,6 +69,34 @@ int hb_push(void* hp, const uint8_t* data, long len, uint64_t tag) {
   return 1;
 }
 
+// Push n documents in one call: data is the concatenation, offsets has n+1
+// entries (doc i = data[offsets[i], offsets[i+1])).  Amortises the
+// per-call overhead that caps the one-at-a-time binding (~0.5M docs/s from
+// Python); stops at the first rejection and returns the number accepted,
+// so callers retry the remainder under backpressure.
+long hb_push_many(void* hp, const uint8_t* data, const long long* offsets,
+                  long n, const uint64_t* tags) {
+  auto* h = static_cast<HostBatch*>(hp);
+  std::lock_guard<std::mutex> lk(h->mu);
+  long accepted = 0;
+  for (long i = 0; i < n; ++i) {
+    long long len = offsets[i + 1] - offsets[i];
+    if (len < 0) break;
+    if (h->closed || h->q.size() >= h->max_docs ||
+        h->arena_used + static_cast<size_t>(len) > h->arena_cap) {
+      h->rejected++;
+      break;
+    }
+    const uint8_t* p = data + offsets[i];
+    h->q.push_back(Doc{std::vector<uint8_t>(p, p + len), tags[i]});
+    h->arena_used += static_cast<size_t>(len);
+    h->pushed++;
+    accepted++;
+  }
+  if (accepted) h->not_empty.notify_all();
+  return accepted;
+}
+
 // Fill up to `batch` rows of out_tokens (uint8[batch, block_len], zero-padded),
 // out_lengths (int32[batch], truncated at block_len), out_tags
 // (uint64[batch]).  Blocks up to timeout_ms for the FIRST document (0 = no

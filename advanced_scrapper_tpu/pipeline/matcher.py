@@ -201,6 +201,7 @@ class EntityIndex:
                         )
                     # pure-lowercase-alpha names are skipped (ref :174)
         self._tables: dict | None = None
+        self._refine_tables: tuple | None = None
 
     @classmethod
     def from_info_dir(cls, folder: str) -> "EntityIndex":
@@ -291,8 +292,12 @@ def _refine_candidates(index: EntityIndex):
     """Fuzzy names the Myers bound kernel can handle: non-exact-upper,
     1..MAX_PATTERN bytes, pure ASCII (the bound is byte-level; multi-byte
     chars would break its soundness vs the char-level oracle).  Returns
-    ``(name_indices, names, mask_tables)`` — the tables are built once
-    here, not per slice."""
+    ``(name_indices, names, mask_tables)``, cached on the index (same
+    lifetime as ``screen_tables`` — the tables depend only on the index,
+    never on the chunk)."""
+    cached = getattr(index, "_refine_tables", None)
+    if cached is not None:
+        return cached
     from advanced_scrapper_tpu.ops.editdist import MAX_PATTERN, build_pattern_masks
 
     ix, names = [], []
@@ -301,7 +306,9 @@ def _refine_candidates(index: EntityIndex):
         if not e.is_exact_upper and 0 < len(nb) <= MAX_PATTERN and nb.isascii():
             ix.append(j)
             names.append(nb)
-    return np.asarray(ix, dtype=np.int64), names, build_pattern_masks(names)
+    out = (np.asarray(ix, dtype=np.int64), names, build_pattern_masks(names))
+    index._refine_tables = out
+    return out
 
 
 def _refine_batch(

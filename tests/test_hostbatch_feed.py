@@ -118,6 +118,46 @@ def test_concurrent_producers_no_loss(batcher_factory):
     assert sorted(seen) == list(range(N * P))
 
 
+def test_push_many_equals_singles(batcher_factory):
+    import numpy as np
+
+    b = batcher_factory()
+    docs = [f"doc number {i}".encode() for i in range(100)]
+    # embedded NULs must survive the zero-copy char* binding
+    docs[7] = b"nul\x00inside\x00doc"
+    docs[8] = b""
+    tags = np.arange(100, dtype=np.uint64)
+    assert b.push_many(docs, tags) == 100
+    n, tok, ln, tg = b.pop_batch(128, timeout_ms=0)
+    assert n == 100
+    for i in range(100):
+        assert bytes(tok[i, : ln[i]]) == docs[i][: b.block]
+        assert tg[i] == i
+
+
+def test_push_many_short_tags_zip_truncates(batcher_factory):
+    """Both backends must truncate to min(len(docs), len(tags)) — the
+    native path reads exactly that many tags (no out-of-bounds)."""
+    import numpy as np
+
+    b = batcher_factory()
+    acc = b.push_many([b"a", b"b", b"c"], np.arange(2, dtype=np.uint64))
+    assert acc == 2
+    n, _, _, tg = b.pop_batch(8, timeout_ms=0)
+    assert n == 2 and list(tg[:2]) == [0, 1]
+
+
+def test_push_many_backpressure_accepts_prefix(batcher_factory):
+    import numpy as np
+
+    b = batcher_factory(max_docs=5)
+    docs = [b"x" * 10] * 9
+    acc = b.push_many(docs, np.arange(9, dtype=np.uint64))
+    assert acc == 5  # queue cap: the accepted prefix, rest rejected
+    n, _, _, tg = b.pop_batch(16, timeout_ms=0)
+    assert n == 5 and list(tg[:5]) == [0, 1, 2, 3, 4]
+
+
 def test_stream_signatures_matches_direct_path():
     """The firehose path must produce the same signatures as the direct
     kernel on the same (truncated) bytes, with tags mapping rows back."""
