@@ -32,7 +32,7 @@ def _cmd_dedup(args: argparse.Namespace) -> int:
     """Near-dup dedup of a newline-delimited text file (one doc per line)."""
     from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
 
-    cfg = default_config().dedup
+    cfg = _with_overrides(default_config().dedup, backend=args.backend)
     engine = NearDupEngine(cfg)
     with open(args.input, "r", encoding="utf-8", errors="replace") as f:
         docs = [line.rstrip("\n") for line in f]
@@ -88,7 +88,67 @@ def _cmd_enrich(args: argparse.Namespace) -> int:
 
 def _cmd_match(args: argparse.Namespace) -> int:
     run_matcher = _import_pipeline("matcher", "run_matcher")
-    return run_matcher(default_config().match)
+    if args.refine and args.no_screen:
+        print("astpu match: --refine requires the screen; drop --no-screen")
+        return 2
+    kw = {}
+    if args.no_screen:
+        kw["use_screen"] = False
+    if args.refine:
+        kw["use_refine"] = True
+    return run_matcher(default_config().match, **kw)
+
+
+def _cmd_poll(args: argparse.Namespace) -> int:
+    """Live topic poller + optional article drain (successor of the
+    reference's experiental/04..10 infinite loops; bounded by --rounds)."""
+    from advanced_scrapper_tpu.extractors import load_extractor
+    from advanced_scrapper_tpu.net.transport import make_transport
+    from advanced_scrapper_tpu.pipeline.poller import (
+        DEFAULT_TOPIC_URL,
+        drain_unscraped,
+        poll_links,
+    )
+    from advanced_scrapper_tpu.storage.stores import ArticleStore, LinkStore
+
+    import time as _time
+
+    links = LinkStore(args.db)
+    transport = make_transport(args.transport or default_config().scraper.transport)
+    extractor = load_extractor(args.website) if args.drain else None
+    articles = ArticleStore(args.db) if args.drain else None
+    new = stored = rounds_done = 0
+    try:
+        # drain interleaves with polling (the reference's 09/10 pair runs
+        # discovery and scraping concurrently forever) — a trailing-only
+        # drain would never run under the default infinite rounds
+        while args.rounds is None or rounds_done < args.rounds:
+            new += poll_links(
+                links,
+                transport,
+                topic_url=args.topic or DEFAULT_TOPIC_URL,
+                interval=args.interval,
+                max_iterations=1,
+            )
+            if args.drain:
+                stored += drain_unscraped(
+                    links,
+                    articles,
+                    transport,
+                    extractor,
+                    max_rounds=args.drain_rounds,
+                )
+            rounds_done += 1
+            if args.rounds is None or rounds_done < args.rounds:
+                _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        transport.close()
+    print(f"{new} new links → {args.db}")
+    if args.drain:
+        print(f"{stored} articles stored")
+    return 0
 
 
 def _cmd_serve(args: argparse.Namespace) -> int:
@@ -239,6 +299,10 @@ def build_parser() -> argparse.ArgumentParser:
     d = sub.add_parser("dedup", help="near-dup dedup of a line-delimited corpus")
     d.add_argument("input")
     d.add_argument("-o", "--output", default=None)
+    d.add_argument(
+        "--backend", default=None, choices=["scan", "oph", "pallas"],
+        help="signature backend (default: config; scan is measured-fastest)",
+    )
     d.set_defaults(fn=_cmd_dedup)
 
     h = sub.add_parser("harvest", help="CDX URL harvest -> deduped yfin_urls.csv")
@@ -253,7 +317,26 @@ def build_parser() -> argparse.ArgumentParser:
     e.set_defaults(fn=_cmd_enrich)
 
     m = sub.add_parser("match", help="ticker→article entity matching")
+    m.add_argument(
+        "--no-screen", action="store_true",
+        help="disable the TPU q-gram screen (pure reference scan)",
+    )
+    m.add_argument(
+        "--refine", action="store_true",
+        help="enable the device alignment-bound prune (see DESIGN.md §4)",
+    )
     m.set_defaults(fn=_cmd_match)
+
+    pl = sub.add_parser("poll", help="live topic poller → sqlite link store")
+    pl.add_argument("--db", default="crypto_news.db")
+    pl.add_argument("--topic", default=None)
+    pl.add_argument("--interval", type=float, default=3.0)
+    pl.add_argument("--rounds", type=int, default=None, help="default: forever")
+    pl.add_argument("--drain", action="store_true", help="also scrape unscraped links")
+    pl.add_argument("--drain-rounds", type=int, default=1)
+    pl.add_argument("--website", default="yfin")
+    pl.add_argument("--transport", default=None)
+    pl.set_defaults(fn=_cmd_poll)
 
     sv = sub.add_parser("serve", help="lease server: distribute URLs to workers")
     sv.add_argument("--input", default=None, help="URL csv (default scraper input)")

@@ -299,8 +299,14 @@ class HostBatcher:
 
     def push_many(self, docs, tags) -> int:
         """Queue a list in one native call (~3× the one-at-a-time rate);
-        returns the accepted prefix length — backpressure stops the rest."""
-        return self._impl.push_many([_enc(d) for d in docs], tags)
+        returns the accepted prefix length — backpressure stops the rest.
+        ``tags`` may be any iterable; it is materialised (and truncated to
+        the doc count) here so both backends behave identically."""
+        import itertools
+
+        docs = [_enc(d) for d in docs]
+        tags = list(itertools.islice(iter(tags), len(docs)))
+        return self._impl.push_many(docs, tags)
 
     def push_blocking(
         self, doc: str | bytes, tag: int, *, poll_s: float = 0.005, timeout_s: float = 60.0
@@ -326,15 +332,42 @@ class HostBatcher:
         return self._impl.pop_batch(batch, self.block, timeout_ms)
 
     def feed(
-        self, docs: Iterable[str | bytes], *, start_tag: int = 0, timeout_s: float = 60.0
+        self,
+        docs: Iterable[str | bytes],
+        *,
+        start_tag: int = 0,
+        timeout_s: float = 60.0,
+        chunk: int = 1024,
     ) -> int:
-        """Convenience: push an iterable with sequential tags; returns count."""
+        """Push an iterable with sequential tags; returns count.
+
+        Chunks through :meth:`push_many` — the batched native call is what
+        actually out-runs the device (1.03M vs 0.49M docs/s one-at-a-time;
+        DESIGN.md §5).  Each chunk's rejected suffix retries under bounded
+        backpressure; on timeout the remaining docs are dropped and the
+        count returned reflects what was queued.
+        """
+        import itertools
+
         n = 0
-        for i, doc in enumerate(docs, start=start_tag):
-            if not self.push_blocking(doc, i, timeout_s=timeout_s):
-                break
-            n += 1
-        return n
+        tag = start_tag
+        it = iter(docs)
+        while True:
+            batch = [_enc(d) for d in itertools.islice(it, chunk)]
+            if not batch:
+                return n
+            deadline = time.monotonic() + timeout_s
+            while batch:
+                acc = self._impl.push_many(
+                    batch, list(range(tag, tag + len(batch)))
+                )
+                n += acc
+                tag += acc
+                batch = batch[acc:]
+                if batch:
+                    if time.monotonic() >= deadline:
+                        return n
+                    time.sleep(0.005)
 
     def size(self) -> int:
         return self._impl.size()

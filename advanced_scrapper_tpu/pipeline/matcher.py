@@ -478,6 +478,7 @@ def run_matcher(
     cfg: MatchConfig,
     *,
     use_screen: bool | None = None,
+    use_refine: bool = False,
     articles_csv: str | None = None,
 ) -> int:
     """CLI entry: full matching run (ref ``__main__`` :220-246)."""
@@ -492,7 +493,11 @@ def run_matcher(
     n_matches = 0
     for chunk in pd.read_csv(articles_csv, chunksize=cfg.chunk_size):
         for ticker, matches, row in match_chunk(
-            chunk, index, use_screen=use_screen, threshold=cfg.fuzzy_threshold
+            chunk,
+            index,
+            use_screen=use_screen,
+            use_refine=use_refine,
+            threshold=cfg.fuzzy_threshold,
         ):
             if append_match(out_dir, ticker, matches, row):
                 n_matches += 1

@@ -54,3 +54,70 @@ def test_split_and_new_links(tmp_path, capsys):
     out = tmp_path / "new.csv"
     assert main(["new-links", str(src), str(out), str(done)]) == 0
     assert len(pd.read_csv(out)) == 5
+
+
+def test_poll_command_with_drain(tmp_path, monkeypatch, capsys):
+    """astpu poll: topic discovery → link store → drain → article store."""
+    import os
+
+    from advanced_scrapper_tpu.net import transport as T
+    from advanced_scrapper_tpu.storage.stores import ArticleStore, LinkStore
+
+    fixtures = os.path.join(os.path.dirname(__file__), "fixtures")
+    article_html = open(os.path.join(fixtures, "yfin_article.html")).read()
+    topic = (
+        '<html><body>'
+        '<a href="https://finance.yahoo.com/news/one.html">a</a>'
+        '<a href="https://finance.yahoo.com/news/two.html">b</a>'
+        '<a href="https://finance.yahoo.com/quote/AAPL">not news</a>'
+        "</body></html>"
+    )
+    pages = {
+        "https://finance.yahoo.com/topic/crypto/": topic,
+        "https://finance.yahoo.com/news/one.html": article_html,
+        "https://finance.yahoo.com/news/two.html": article_html,
+    }
+    real = T.make_transport
+    monkeypatch.setattr(
+        T, "make_transport", lambda name="auto", **kw: T.MockTransport(pages)
+    )
+    db = str(tmp_path / "poll.db")
+    assert (
+        main(
+            [
+                "poll", "--db", db, "--rounds", "2", "--interval", "0",
+                "--drain", "--transport", "mock",
+            ]
+        )
+        == 0
+    )
+    out = capsys.readouterr().out
+    assert "2 new links" in out and "2 articles stored" in out
+    assert LinkStore(db).unscraped() == []
+    texts = dict(ArticleStore(db).all_texts())
+    assert len(texts) == 2
+    monkeypatch.setattr(T, "make_transport", real)
+
+
+def test_match_cli_flags(tmp_path, monkeypatch):
+    """--no-screen and --refine plumb through to run_matcher."""
+    seen = {}
+
+    def fake_run(cfg, **kw):
+        seen.update(kw)
+        return 0
+
+    import advanced_scrapper_tpu.pipeline.matcher as M
+
+    monkeypatch.setattr(M, "run_matcher", fake_run)
+    # --refine without the screen is rejected (it would silently no-op)
+    assert main(["match", "--no-screen", "--refine"]) == 2
+    assert seen == {}
+    assert main(["match", "--refine"]) == 0
+    assert seen == {"use_refine": True}
+    seen.clear()
+    assert main(["match", "--no-screen"]) == 0
+    assert seen == {"use_screen": False}
+    seen.clear()
+    assert main(["match"]) == 0
+    assert seen == {}
