@@ -96,7 +96,12 @@ def _cmd_match(args: argparse.Namespace) -> int:
         kw["use_screen"] = False
     if args.refine:
         kw["use_refine"] = True
-    return run_matcher(default_config().match, **kw)
+    try:
+        return run_matcher(default_config().match, **kw)
+    except ValueError as e:
+        # e.g. --refine with the screen disabled via config/env
+        print(f"astpu match: {e}")
+        return 2
 
 
 def _cmd_poll(args: argparse.Namespace) -> int:

@@ -300,12 +300,17 @@ class HostBatcher:
     def push_many(self, docs, tags) -> int:
         """Queue a list in one native call (~3× the one-at-a-time rate);
         returns the accepted prefix length — backpressure stops the rest.
-        ``tags`` may be any iterable; it is materialised (and truncated to
-        the doc count) here so both backends behave identically."""
-        import itertools
-
+        ``tags`` may be any iterable; generators are materialised (and
+        truncated to the doc count) so both backends behave identically;
+        sized inputs (lists, ndarrays) slice without a per-element
+        round-trip."""
         docs = [_enc(d) for d in docs]
-        tags = list(itertools.islice(iter(tags), len(docs)))
+        if hasattr(tags, "__len__"):
+            tags = tags[: len(docs)]
+        else:
+            import itertools
+
+            tags = list(itertools.islice(iter(tags), len(docs)))
         return self._impl.push_many(docs, tags)
 
     def push_blocking(
@@ -364,6 +369,11 @@ class HostBatcher:
                 n += acc
                 tag += acc
                 batch = batch[acc:]
+                if acc:
+                    # progress resets the clock — only a consumer making NO
+                    # progress for timeout_s drops docs (parity with the old
+                    # per-document push_blocking semantics)
+                    deadline = time.monotonic() + timeout_s
                 if batch:
                     if time.monotonic() >= deadline:
                         return n

@@ -490,6 +490,11 @@ def run_matcher(
     out_dir = f"{cfg.source_name}{cfg.out_dir_suffix}"
     os.makedirs(out_dir, exist_ok=True)
     use_screen = cfg.use_tpu if use_screen is None else use_screen
+    if use_refine and not use_screen:
+        # refine lives inside the screen path; silently no-opping would
+        # betray the caller's explicit request (screen may have been
+        # disabled via config/env, not just a CLI flag)
+        raise ValueError("use_refine requires use_screen (see DESIGN.md §4)")
     n_matches = 0
     for chunk in pd.read_csv(articles_csv, chunksize=cfg.chunk_size):
         for ticker, matches, row in match_chunk(
