@@ -10,18 +10,17 @@ This file must set the env vars *before* jax is imported anywhere.
 """
 
 import os
+import sys
 
 # The axon TPU plugin's sitecustomize force-registers itself at interpreter
 # startup (before this file runs) and sets jax_platforms="axon,cpu".  Undo it
 # through jax.config — XLA_FLAGS is still honoured because no backend has
-# been *initialised* yet at conftest-import time.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+# been *initialised* yet at conftest-import time.  The env dance is shared
+# with the driver entry (single source of truth).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from __graft_entry__ import virtual_mesh_env  # noqa: E402
+
+virtual_mesh_env(os.environ, 8)
 
 import jax  # noqa: E402
 

@@ -1,0 +1,87 @@
+"""Driver-entry contract tests.
+
+Round 1's one red driver deliverable was ``dryrun_multichip`` asserting
+``need 8 devices, have 1`` on the 1-chip bench host (MULTICHIP_r01.json).
+These tests pin the fix: the entry must self-provision a virtual CPU mesh
+(the conftest platform-override dance, re-exec'd in a subprocess) whenever
+the current process sees fewer devices than requested.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_entry_jits():
+    import jax
+
+    fn, args = graft.entry()
+    out = np.asarray(jax.jit(fn)(*args))
+    assert out.shape == (256,)
+    # the planted duplicate must resolve to the first occurrence
+    assert out[128] == 0
+
+
+def test_dryrun_direct_path(devices8):
+    # conftest provisions 8 virtual devices -> no re-exec needed
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_reexecs_when_devices_short():
+    """From a deliberately 1-device parent, dryrun_multichip(4) must still
+    pass by re-exec'ing onto a virtual 4-device mesh (the driver scenario)."""
+    env = graft.virtual_mesh_env(dict(os.environ), 1)
+    env.pop("ASTPU_DRYRUN_SUBPROC", None)
+    code = (
+        f"import sys; sys.path.insert(0, {REPO!r}); "
+        "import jax; assert len(jax.devices()) == 1, jax.devices(); "
+        "import __graft_entry__ as g; g.dryrun_multichip(4)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "dryrun_multichip OK" in proc.stdout
+
+
+def test_parent_never_touches_jax_backend():
+    """The decision to re-exec must be made from env inspection alone —
+    initialising the backend in the parent can hang on a flaky axon tunnel.
+    Poison jax so any backend touch raises, and confirm the re-exec path
+    still completes."""
+    env = graft.virtual_mesh_env(dict(os.environ), 1)
+    env.pop("ASTPU_DRYRUN_SUBPROC", None)
+    env["JAX_PLATFORMS"] = "poison"  # unknown platform: jax.devices() raises
+    code = (
+        f"import sys; sys.path.insert(0, {REPO!r}); "
+        "import __graft_entry__ as g; g.dryrun_multichip(4)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "dryrun_multichip OK" in proc.stdout
+
+
+def test_child_fails_loud_instead_of_recursing():
+    env = graft.virtual_mesh_env(dict(os.environ), 1)
+    env["ASTPU_DRYRUN_SUBPROC"] = "1"  # pretend we are already the child
+    code = (
+        f"import sys; sys.path.insert(0, {REPO!r}); "
+        "import __graft_entry__ as g; g.dryrun_multichip(4)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode != 0
+    assert "need 4 devices" in proc.stderr
