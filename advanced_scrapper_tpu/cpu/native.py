@@ -51,14 +51,13 @@ def _load() -> ctypes.CDLL | None:
         except OSError:
             BACKEND = "python"
             return None
-        lib.fm_ratio.restype = ctypes.c_double
-        lib.fm_ratio.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
-        ]
-        lib.fm_partial_ratio.restype = ctypes.c_double
-        lib.fm_partial_ratio.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
-        ]
+        for name in ("fm_ratio", "fm_partial_ratio", "fm_ratio_u32",
+                     "fm_partial_ratio_u32"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_double
+            fn.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ]
         _lib = lib
         BACKEND = "native"
         return lib
@@ -68,21 +67,35 @@ def _enc(s: str | bytes) -> bytes:
     return s if isinstance(s, bytes) else s.encode("utf-8", "replace")
 
 
-def ratio(s1: str | bytes, s2: str | bytes) -> float:
+def _call(byte_fn, u32_fn, py_fn, s1: str | bytes, s2: str | bytes) -> float:
+    """Dispatch: bytes/ASCII → byte kernel; non-ASCII str → UTF-32 kernel
+    (rapidfuzz scores code points, not bytes — byte-level scoring diverges
+    on curly quotes/accents/CJK); no compiler → pure-Python oracle."""
     lib = _load()
-    a, b = _enc(s1), _enc(s2)
-    if lib is not None:
-        return lib.fm_ratio(a, len(a), b, len(b))
-    from advanced_scrapper_tpu.cpu import fuzz
+    if lib is None:
+        from advanced_scrapper_tpu.cpu import fuzz
 
-    return fuzz.ratio(a.decode("utf-8", "replace"), b.decode("utf-8", "replace"))
+        a = s1.decode("utf-8", "replace") if isinstance(s1, bytes) else s1
+        b = s2.decode("utf-8", "replace") if isinstance(s2, bytes) else s2
+        return py_fn(fuzz, a, b)
+    if isinstance(s1, str) and isinstance(s2, str) and not (
+        s1.isascii() and s2.isascii()
+    ):
+        # surrogatepass: scraped text may carry lone surrogates; rapidfuzz
+        # scores raw ord() values, and strict utf-32 would raise on them
+        a32 = s1.encode("utf-32-le", "surrogatepass")
+        b32 = s2.encode("utf-32-le", "surrogatepass")
+        return getattr(lib, u32_fn)(a32, len(s1), b32, len(s2))
+    a, b = _enc(s1), _enc(s2)
+    return getattr(lib, byte_fn)(a, len(a), b, len(b))
+
+
+def ratio(s1: str | bytes, s2: str | bytes) -> float:
+    return _call("fm_ratio", "fm_ratio_u32", lambda f, a, b: f.ratio(a, b), s1, s2)
 
 
 def partial_ratio(s1: str | bytes, s2: str | bytes) -> float:
-    lib = _load()
-    a, b = _enc(s1), _enc(s2)
-    if lib is not None:
-        return lib.fm_partial_ratio(a, len(a), b, len(b))
-    from advanced_scrapper_tpu.cpu import fuzz
-
-    return fuzz.partial_ratio(a.decode("utf-8", "replace"), b.decode("utf-8", "replace"))
+    return _call(
+        "fm_partial_ratio", "fm_partial_ratio_u32",
+        lambda f, a, b: f.partial_ratio(a, b), s1, s2,
+    )

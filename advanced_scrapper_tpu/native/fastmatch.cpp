@@ -1,20 +1,30 @@
 // fastmatch: host-side exact verification kernels behind the TPU match screen.
 //
 // The reference leans on rapidfuzz (a C++ pip extension) for
-// fuzz.partial_ratio (match_keywords.py:4,175-176).  rapidfuzz is not
-// available in this environment, so this library provides the same
-// semantics natively (and `cpu/fuzz.py` is the pure-Python oracle it is
-// tested against):
+// fuzz.partial_ratio (match_keywords.py:4,175-176).  This library provides
+// the same semantics natively (dependency-free for deployment), with exact
+// score parity CI-fuzzed against the installed rapidfuzz 3.x
+// (tests/test_rapidfuzz_parity.py; `cpu/fuzz.py` is the pure-Python twin):
 //
 //   ratio(s1, s2)        = 100 * (1 - indel_dist / (|s1|+|s2|))
 //                          with indel_dist = |s1|+|s2| - 2*LCS
 //   partial_ratio(s1,s2) = max over sliding windows of the shorter string's
 //                          length across the longer (including overhanging
-//                          partial windows at both ends)
+//                          partial windows at both ends), with two
+//                          rapidfuzz-3.x rules: an empty needle scores 0
+//                          against non-empty text (100 only empty-vs-empty),
+//                          and equal-length inputs are scanned in BOTH
+//                          orientations (max taken) — see
+//                          fuzz_py.partial_ratio_alignment in rapidfuzz.
+//
+// rapidfuzz scores UNICODE CODE POINTS, not bytes; the `_u32` entry points
+// take UTF-32 sequences and match it exactly on non-ASCII text (curly
+// quotes, accents, CJK).  The byte entry points remain for pure-ASCII
+// fast paths and raw-bytes callers (identical results on ASCII).
 //
 // LCS length uses the Crochemore/Hyyrö bit-parallel recurrence
 //   V = (V + (V & M)) | (V & ~M)
-// over 64-bit words (multi-word with carry for patterns > 64 bytes);
+// over 64-bit words (multi-word with carry for patterns > 64 units);
 // LCS = zero bits of V within the pattern length.  Complexity per call:
 // O(windows * |window| * ceil(m/64)) — microseconds for typical entity
 // names against full articles.
@@ -22,28 +32,63 @@
 // Build: g++ -O3 -shared -fPIC fastmatch.cpp -o libfastmatch.so
 // (driven automatically by cpu/native.py)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <vector>
 
 namespace {
 
-struct PatternMasks {
+// Pattern match-mask table over a 256-entry direct-indexed byte alphabet.
+struct ByteMasks {
   int m;
   int words;
-  // 256 characters x words bitmask table
-  std::vector<uint64_t> table;
+  std::vector<uint64_t> table;  // 256 x words
 
-  explicit PatternMasks(const uint8_t* p, int len) : m(len), words((len + 63) / 64) {
+  explicit ByteMasks(const uint8_t* p, int len) : m(len), words((len + 63) / 64) {
     table.assign(256 * (size_t)words, 0);
     for (int i = 0; i < len; ++i) {
       table[(size_t)p[i] * words + (i >> 6)] |= 1ULL << (i & 63);
     }
   }
+
+  const uint64_t* masks_for(uint8_t c) const { return &table[(size_t)c * words]; }
+};
+
+// Pattern match-mask table over the pattern's own (sorted, deduped)
+// codepoint alphabet; haystack chars resolve by binary search, misses map
+// to an all-zero mask.
+struct CodepointMasks {
+  int m;
+  int words;
+  std::vector<uint32_t> alpha;
+  std::vector<uint64_t> table;  // alpha.size() x words
+  std::vector<uint64_t> zero;   // words zeros
+
+  explicit CodepointMasks(const uint32_t* p, int len)
+      : m(len), words((len + 63) / 64) {
+    alpha.assign(p, p + len);
+    std::sort(alpha.begin(), alpha.end());
+    alpha.erase(std::unique(alpha.begin(), alpha.end()), alpha.end());
+    table.assign(alpha.size() * (size_t)words, 0);
+    zero.assign(words, 0);
+    for (int i = 0; i < len; ++i) {
+      const size_t idx =
+          std::lower_bound(alpha.begin(), alpha.end(), p[i]) - alpha.begin();
+      table[idx * words + (i >> 6)] |= 1ULL << (i & 63);
+    }
+  }
+
+  const uint64_t* masks_for(uint32_t c) const {
+    auto it = std::lower_bound(alpha.begin(), alpha.end(), c);
+    if (it == alpha.end() || *it != c) return zero.data();
+    return &table[(size_t)(it - alpha.begin()) * words];
+  }
 };
 
 // LCS length of the pattern (via masks) against text[0..tlen)
-int lcs_len(const PatternMasks& pm, const uint8_t* text, int tlen) {
+template <typename Masks, typename CharT>
+int lcs_len(const Masks& pm, const CharT* text, int tlen) {
   const int words = pm.words;
   uint64_t vbuf[8];
   std::vector<uint64_t> vheap;
@@ -55,7 +100,7 @@ int lcs_len(const PatternMasks& pm, const uint8_t* text, int tlen) {
     for (int w = 0; w < words; ++w) vbuf[w] = ~0ULL;
   }
   for (int j = 0; j < tlen; ++j) {
-    const uint64_t* M = &pm.table[(size_t)text[j] * words];
+    const uint64_t* M = pm.masks_for(text[j]);
     uint64_t carry = 0;
     for (int w = 0; w < words; ++w) {
       const uint64_t u = V[w] & M[w];
@@ -81,35 +126,17 @@ inline double indel_ratio(int m, int w, int lcs) {
   return 200.0 * (double)lcs / (double)total;
 }
 
-}  // namespace
-
-extern "C" {
-
-// Normalised indel similarity in [0, 100].
-double fm_ratio(const uint8_t* s1, int len1, const uint8_t* s2, int len2) {
-  if (len1 + len2 == 0) return 100.0;
-  if (len1 == 0 || len2 == 0) return 0.0;
-  PatternMasks pm(s1, len1);
-  const int lcs = lcs_len(pm, s2, len2);
-  return indel_ratio(len1, len2, lcs);
-}
-
-// Sliding-window partial ratio (rapidfuzz semantics; see header comment).
-double fm_partial_ratio(const uint8_t* s1, int len1, const uint8_t* s2, int len2) {
-  const uint8_t* shorter = s1;
-  const uint8_t* longer = s2;
-  int m = len1, n = len2;
-  if (len1 > len2) {
-    shorter = s2; longer = s1; m = len2; n = len1;
-  }
-  if (m == 0) return 100.0;
-  PatternMasks pm(shorter, m);
+// Max ratio of `needle` vs the length-m sliding windows of `haystack`
+// (clipped at both edges).
+template <typename Masks, typename CharT>
+double scan_windows(const CharT* needle, int m, const CharT* haystack, int n) {
+  Masks pm(needle, m);
   double best = 0.0;
   for (int start = -(m - 1); start < n; ++start) {
     const int lo = start > 0 ? start : 0;
     const int hi = (start + m) < n ? (start + m) : n;
     if (hi <= lo) continue;
-    const int lcs = lcs_len(pm, longer + lo, hi - lo);
+    const int lcs = lcs_len(pm, haystack + lo, hi - lo);
     const double sc = indel_ratio(m, hi - lo, lcs);
     if (sc > best) {
       best = sc;
@@ -117,6 +144,59 @@ double fm_partial_ratio(const uint8_t* s1, int len1, const uint8_t* s2, int len2
     }
   }
   return best;
+}
+
+template <typename Masks, typename CharT>
+double ratio_impl(const CharT* s1, int len1, const CharT* s2, int len2) {
+  if (len1 + len2 == 0) return 100.0;
+  if (len1 == 0 || len2 == 0) return 0.0;
+  Masks pm(s1, len1);
+  const int lcs = lcs_len(pm, s2, len2);
+  return indel_ratio(len1, len2, lcs);
+}
+
+// rapidfuzz 3.x partial_ratio semantics (see header comment).
+template <typename Masks, typename CharT>
+double partial_ratio_impl(const CharT* s1, int len1, const CharT* s2, int len2) {
+  const CharT* shorter = s1;
+  const CharT* longer = s2;
+  int m = len1, n = len2;
+  if (len1 > len2) {
+    shorter = s2; longer = s1; m = len2; n = len1;
+  }
+  if (m == 0) return n == 0 ? 100.0 : 0.0;
+  double best = scan_windows<Masks>(shorter, m, longer, n);
+  if (best < 100.0 && m == n) {
+    // equal lengths: rapidfuzz scans both orientations and takes the max
+    const double rev = scan_windows<Masks>(longer, n, shorter, m);
+    if (rev > best) best = rev;
+  }
+  return best;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Normalised indel similarity in [0, 100] over bytes.
+double fm_ratio(const uint8_t* s1, int len1, const uint8_t* s2, int len2) {
+  return ratio_impl<ByteMasks>(s1, len1, s2, len2);
+}
+
+// Normalised indel similarity over UTF-32 code points (lengths in units).
+double fm_ratio_u32(const uint32_t* s1, int len1, const uint32_t* s2, int len2) {
+  return ratio_impl<CodepointMasks>(s1, len1, s2, len2);
+}
+
+// partial_ratio over bytes (exact rapidfuzz parity for pure-ASCII input).
+double fm_partial_ratio(const uint8_t* s1, int len1, const uint8_t* s2, int len2) {
+  return partial_ratio_impl<ByteMasks>(s1, len1, s2, len2);
+}
+
+// partial_ratio over UTF-32 code points — exact rapidfuzz parity on any text.
+double fm_partial_ratio_u32(
+    const uint32_t* s1, int len1, const uint32_t* s2, int len2) {
+  return partial_ratio_impl<CodepointMasks>(s1, len1, s2, len2);
 }
 
 // Batch: one needle against many haystacks (offsets into a byte arena).

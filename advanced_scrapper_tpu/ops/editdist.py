@@ -151,14 +151,16 @@ def prune_mask_tables(
 
     ``tables`` is a prebuilt :func:`build_pattern_masks` result — build it
     once per entity index, not per slice.  Pairs whose pattern is
-    empty/overlong, or whose text is shorter than the pattern, are never
-    pruned (the bound's soundness argument needs ``|w| ≤ m`` windows over
-    a text at least as long as the pattern).
+    empty/overlong, or whose text is NOT STRICTLY LONGER than the pattern,
+    are never pruned: the bound's soundness argument needs ``|w| ≤ m``
+    windows over a longer text, and rapidfuzz 3.x scores equal-length
+    inputs in BOTH orientations (substrings of either side), which the
+    one-direction semi-global bound does not cover.
     """
     masks, lens, ok = tables
     pattern_ix = np.asarray(pattern_ix, dtype=np.int32)
     applicable = ok[pattern_ix] & (
-        np.asarray(text_lens, dtype=np.int32) >= lens[pattern_ix]
+        np.asarray(text_lens, dtype=np.int32) > lens[pattern_ix]
     )
     if not applicable.any():
         return np.zeros(len(pattern_ix), dtype=bool)

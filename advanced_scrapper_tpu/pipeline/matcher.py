@@ -10,8 +10,10 @@ Re-implements ``match_keywords.py`` end to end:
   - ALL-CAPS names of length > 1 → ``\\b re.escape(name) \\b`` positions in
     article text and title;
   - names that are not pure-lowercase-alphabetic → fuzzy
-    ``partial_ratio(text, name) > 95`` (native C++ kernel, rapidfuzz
-    semantics), positions via un-escaped ``re.finditer`` like the ref;
+    ``partial_ratio(text, name) > 95`` (native C++ kernel; exact score
+    parity with installed rapidfuzz 3.x is CI-fuzzed in
+    ``tests/test_rapidfuzz_parity.py``), positions via un-escaped
+    ``re.finditer`` like the ref;
   - everything else is skipped entirely;
   - a name only counts when the article date is inside its window
     (``is_within_period``, naive datetimes promoted to UTC, ref ``:17-37``);
@@ -189,6 +191,12 @@ class EntityIndex:
         for ticker, attrs in processed.items():
             for attribute, names in attrs.items():
                 for name, (start, end) in names.items():
+                    if not name:
+                        # empty names (reachable via extract_time_periods on
+                        # strings starting " (") score partial_ratio 0.0 in
+                        # rapidfuzz 3.x — they can never match; storing them
+                        # would only waste screen lanes
+                        continue
                     if name.isupper():
                         if len(name) > 1:
                             self.entries.append(
@@ -333,7 +341,9 @@ def _refine_batch(
     for i, (text, _title, _d, _r) in enumerate(batch):
         if overlong[i] or not text or not text.isascii():
             continue
-        sel = np.nonzero(got[i][fuzzy_ix] & (len(text) >= name_lens))[0]
+        # strictly longer only: equal-length pairs are never prunable under
+        # rapidfuzz's bidirectional rule (see editdist.prune_mask_tables)
+        sel = np.nonzero(got[i][fuzzy_ix] & (len(text) > name_lens))[0]
         pair_row.extend([i] * len(sel))
         pair_k.extend(sel.tolist())
     out: list[set | None] = [None] * len(batch)

@@ -1,0 +1,162 @@
+"""Exact-score parity with the INSTALLED rapidfuzz 3.x.
+
+Round 1 claimed "rapidfuzz parity" while only testing against the in-repo
+oracle; fuzzing against the real library found 151/3000 score mismatches
+(empty-needle semantics + the equal-length bidirectional scan).  These
+tests pin both implementations — pure-Python ``cpu/fuzz.py`` and C++
+``native/fastmatch.cpp`` — to the library the reference actually calls
+(``/root/reference/match_keywords.py:174-180``), on the decision that
+matters (the ``> 95`` gate) AND on raw scores.
+"""
+
+import random
+
+import pytest
+
+rapidfuzz = pytest.importorskip("rapidfuzz")
+from rapidfuzz import fuzz as rf  # noqa: E402
+
+from advanced_scrapper_tpu.cpu import fuzz as pyfuzz  # noqa: E402
+from advanced_scrapper_tpu.cpu import native  # noqa: E402
+
+BACKENDS = [("python", pyfuzz.partial_ratio), ("native", native.partial_ratio)]
+
+
+@pytest.mark.parametrize("name,pr", BACKENDS)
+def test_edge_cases(name, pr):
+    # rapidfuzz 3.x: empty needle scores 0 against non-empty text
+    assert pr("", "abc") == rf.partial_ratio("", "abc") == 0.0
+    assert pr("abc", "") == rf.partial_ratio("abc", "") == 0.0
+    assert pr("", "") == rf.partial_ratio("", "") == 100.0
+    # equal lengths: both orientations scanned ('dd' of 'add' vs 'dbd' = 80)
+    assert pr("add", "dbd") == rf.partial_ratio("add", "dbd") == 80.0
+    # lone surrogates (dirty scraped text) must score, not raise
+    assert pr("caf\ud800e", "cafe") == pytest.approx(
+        rf.partial_ratio("caf\ud800e", "cafe"), abs=1e-7
+    )
+
+
+@pytest.mark.parametrize("name,pr", BACKENDS)
+def test_score_parity_random_ascii(name, pr):
+    rng = random.Random(1)
+    for _ in range(2000):
+        a = "".join(rng.choices("abcdef ", k=rng.randint(0, 16)))
+        b = "".join(rng.choices("abcdef ", k=rng.randint(0, 40)))
+        assert pr(a, b) == pytest.approx(rf.partial_ratio(a, b), abs=1e-7), (a, b)
+
+
+@pytest.mark.parametrize("name,pr", BACKENDS)
+def test_score_parity_unicode(name, pr):
+    """rapidfuzz scores code points; curly quotes/accents/CJK must not
+    shift scores (the native kernel routes non-ASCII through UTF-32)."""
+    rng = random.Random(7)
+    alpha = "abé日ç x’“"
+    for _ in range(1500):
+        a = "".join(rng.choices(alpha, k=rng.randint(0, 10)))
+        b = "".join(rng.choices(alpha, k=rng.randint(0, 25)))
+        assert pr(a, b) == pytest.approx(rf.partial_ratio(a, b), abs=1e-7), (a, b)
+
+
+@pytest.mark.parametrize("name,pr", BACKENDS)
+def test_ratio_parity(name, pr):
+    rng = random.Random(2)
+    r = pyfuzz.ratio if name == "python" else native.ratio
+    for _ in range(1500):
+        a = "".join(rng.choices("abé日 ", k=rng.randint(0, 12)))
+        b = "".join(rng.choices("abé日 ", k=rng.randint(0, 12)))
+        assert r(a, b) == pytest.approx(rf.ratio(a, b), abs=1e-7), (a, b)
+
+
+NAMES = [
+    "Tim Cook", "Timothy Donald Cook", "Satya Nadella", "Berkshire Hathaway",
+    "Société Générale", "Alphabet Inc.", "Warren Buffett",
+    "José María Álvarez-Pallete",
+]
+
+FILLER = (
+    "shares rallied on Tuesday after the company reported quarterly "
+    "earnings that beat expectations’ consensus, with revenue up and "
+    "guidance “strong” according to analysts. "
+)
+
+
+def _mutate(rng, s):
+    """Small realistic typos: drop/dup/swap/replace one char."""
+    if len(s) < 3:
+        return s
+    i = rng.randrange(1, len(s) - 1)
+    op = rng.randrange(4)
+    if op == 0:
+        return s[:i] + s[i + 1:]
+    if op == 1:
+        return s[:i] + s[i] + s[i:]
+    if op == 2:
+        return s[:i] + s[i + 1] + s[i] + s[i + 2:]
+    return s[:i] + chr(rng.randrange(97, 123)) + s[i + 1:]
+
+
+@pytest.mark.parametrize("name,pr", BACKENDS)
+def test_gate_decisions_embedded_names(name, pr):
+    """The reference's actual decision — partial_ratio(text, name) > 95 —
+    must flip identically to real rapidfuzz on embedded-name corpora
+    (exact embeds, typo embeds, absent names).  0 flips allowed."""
+    rng = random.Random(42)
+    flips = 0
+    trials = 0
+    for _ in range(300):
+        target = rng.choice(NAMES)
+        kind = rng.randrange(3)
+        if kind == 0:
+            embedded = target                      # exact
+        elif kind == 1:
+            embedded = _mutate(rng, target)        # near miss
+        else:
+            embedded = ""                          # absent
+        text = FILLER + embedded + " " + FILLER
+        for probe in (target, rng.choice(NAMES)):
+            trials += 1
+            want = rf.partial_ratio(text, probe) > 95
+            got = pr(text, probe) > 95
+            if want != got:
+                flips += 1
+    assert flips == 0, f"{flips}/{trials} gate decisions flipped vs rapidfuzz"
+
+
+def test_myers_bound_sound_vs_real_rapidfuzz():
+    """The device prune bound must upper-bound REAL rapidfuzz scores on
+    every prunable pair (text strictly longer than pattern)."""
+    import numpy as np
+
+    from advanced_scrapper_tpu.ops.editdist import (
+        build_pattern_masks, partial_ratio_bound, semiglobal_dist,
+    )
+
+    rng = random.Random(3)
+    pats, texts = [], []
+    for _ in range(200):
+        p = "".join(rng.choices("abcde ", k=rng.randint(1, 12)))
+        t = "".join(rng.choices("abcde ", k=rng.randint(len(p) + 1, 60)))
+        pats.append(p.encode())
+        texts.append(t)
+    masks, lens, ok = build_pattern_masks(pats)
+    L = max(len(t) for t in texts)
+    tok = np.zeros((len(texts), L), dtype=np.uint8)
+    tlens = np.zeros(len(texts), dtype=np.int32)
+    for i, t in enumerate(texts):
+        b = t.encode()
+        tok[i, : len(b)] = np.frombuffer(b, dtype=np.uint8)
+        tlens[i] = len(b)
+    d = np.asarray(semiglobal_dist(masks, lens, tok, tlens))
+    bound = partial_ratio_bound(d, lens)
+    for i, t in enumerate(texts):
+        real = rf.partial_ratio(t, pats[i].decode())
+        assert bound[i] >= real - 1e-7, (pats[i], t, bound[i], real)
+
+
+def test_entity_index_skips_empty_names():
+    from advanced_scrapper_tpu.pipeline.matcher import EntityIndex
+
+    idx = EntityIndex(
+        {"TST": {"label": {"": (None, None), "Acme Corp": (None, None)}}}
+    )
+    assert [e.name for e in idx.entries] == ["Acme Corp"]
