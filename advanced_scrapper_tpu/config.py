@@ -109,6 +109,8 @@ class MatchConfig:
     fuzzy_threshold: float = 95.0       # ref :175 (partial_ratio > 95)
     use_tpu: bool = True
     out_dir_suffix: str = "_ticker_matched_articles"  # ref :129
+    verify_workers: int = 0  # exact-verify process fan-out; 0 = cpu_count
+    #                          (the ref's mp.Pool width, :231-238); 1 = inline
 
 
 @dataclass(frozen=True)

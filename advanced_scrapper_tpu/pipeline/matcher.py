@@ -58,7 +58,10 @@ from dateutil.tz import tzutc
 
 from advanced_scrapper_tpu.config import MatchConfig
 from advanced_scrapper_tpu.cpu import native
-from advanced_scrapper_tpu.ops.match import match_screen, prepare_names
+
+# ops.match (and through it jax) is imported lazily inside the screen path:
+# verify-pool workers must stay jax-free (they only run the host rules), and
+# CLI paths that never screen shouldn't pay device-runtime import time.
 
 ATTRIBUTES = (
     "id_label",
@@ -217,6 +220,8 @@ class EntityIndex:
 
     def screen_tables(self) -> dict:
         if self._tables is None:
+            from advanced_scrapper_tpu.ops.match import prepare_names
+
             names = [e.name.encode("utf-8", "replace") for e in self.entries]
             fuzzy = np.array([not e.is_exact_upper for e in self.entries], bool)
             self._tables = prepare_names(names, fuzzy=fuzzy)
@@ -380,13 +385,25 @@ def match_chunk(
     screen_batch: int = 128,
     screen_block: int = 1 << 16,
     threshold: float = 95.0,
+    pool=None,
 ) -> list[tuple[str, dict, dict]]:
     """Match a frame of articles → [(ticker, matches, row_record), …].
 
     Accepts both the reference dataset schema (``article_text``/``date_time``)
     and this framework's scraper schema (``article``/``datetime``).
+
+    ``pool`` (an executor from :func:`make_verify_pool`) fans the host-side
+    exact-verify stage out across processes — the successor of the
+    reference's ``np.array_split`` × ``mp.Pool.starmap(cpu_count)``
+    (``match_keywords.py:231-238``).  The device screen always runs in THIS
+    process (one device context); only the CPU verify work ships out.
+    Output order is identical with and without a pool.
     """
-    from advanced_scrapper_tpu.core.tokenizer import encode_batch
+    if use_refine and not use_screen:
+        # refine lives inside the screen path; silently no-opping here would
+        # betray a direct caller's explicit request (previously this guard
+        # lived only in run_matcher)
+        raise ValueError("use_refine requires use_screen (see DESIGN.md §4)")
 
     rows = []
     for _, row in chunk.iterrows():
@@ -402,6 +419,9 @@ def match_chunk(
     masks: list[np.ndarray | None] = [None] * len(rows)
     text_prunes: list[set | None] = [None] * len(rows)
     if use_screen and index.entries:
+        from advanced_scrapper_tpu.core.tokenizer import encode_batch
+        from advanced_scrapper_tpu.ops.match import match_screen
+
         tables = index.screen_tables()
         fuzzy_ix, fuzzy_names, mask_tables = (
             _refine_candidates(index) if use_refine else (np.array([]), [], None)
@@ -435,12 +455,96 @@ def match_chunk(
                 for i, pr in enumerate(prunes):
                     text_prunes[start + i] = pr
 
+    if pool is not None and len(rows) > 1:
+        # ship (text, title, date, row-INDEX) out; the full pandas row stays
+        # here and is re-attached on return (half the IPC volume)
+        light = [(t, ti, d, i) for i, (t, ti, d, _r) in enumerate(rows)]
+        n_slices = min(getattr(pool, "_max_workers", 4), len(rows))
+        bounds = np.linspace(0, len(rows), n_slices + 1).astype(int)
+        futures = [
+            pool.submit(
+                _verify_slice,
+                light[lo:hi], masks[lo:hi], text_prunes[lo:hi], threshold,
+            )
+            for lo, hi in zip(bounds[:-1], bounds[1:])
+            if hi > lo
+        ]
+        out = []
+        for f in futures:  # slice order == row order
+            out.extend((ticker, m, rows[i][3]) for ticker, m, i in f.result())
+        return out
+
     out = []
     for (text, title, adate, row), mask, pruned in zip(rows, masks, text_prunes):
         matches = match_article(text, title, adate, index, mask, threshold, pruned)
         for ticker, m in matches.items():
             out.append((ticker, m, row))
     return out
+
+
+# -- verify-stage process pool (ref match_keywords.py:231-238) ---------------
+
+_WORKER_INDEX: EntityIndex | None = None
+
+
+def _verify_worker_init(processed: dict) -> None:
+    """Build the worker's EntityIndex ONCE (not per slice)."""
+    global _WORKER_INDEX
+    _WORKER_INDEX = EntityIndex(processed)
+
+
+def _warm_noop() -> bool:
+    return True
+
+
+def _verify_slice(rows, masks, prunes, threshold: float):
+    """Run the host exact-verify rules over one row slice (no jax, no
+    device: masks/prunes were computed by the screen in the parent).
+    ``rows`` carry row INDICES, echoed back for parent-side re-attach."""
+    index = _WORKER_INDEX
+    out = []
+    for (text, title, adate, row_ix), mask, pruned in zip(rows, masks, prunes):
+        matches = match_article(text, title, adate, index, mask, threshold, pruned)
+        for ticker, m in matches.items():
+            out.append((ticker, m, row_ix))
+    return out
+
+
+def make_verify_pool(index: EntityIndex, workers: int | None = None):
+    """ProcessPoolExecutor for the exact-verify stage, or None for ≤ 1
+    worker.  Fork start method: workers inherit the loaded native scorer
+    and never import jax (the screen stays in the parent).  The entity
+    data ships once via the initializer, not per chunk.
+
+    On jax's fork warning: it flags children that go on to USE jax (whose
+    internal locks may be mid-acquire at fork time).  These workers are
+    jax-free by construction — host rules only (re/native/dateutil) — and
+    in the CLI flow the pool is created before the first screen batch ever
+    initialises the device, so the fork happens pre-jax-threads anyway.
+    Spawn would be "cleaner" but re-runs the axon sitecustomize in every
+    child, which can hang on a flaky TPU tunnel (see tests/conftest.py)."""
+    import multiprocessing as mp
+    from concurrent.futures import ProcessPoolExecutor, wait
+
+    if workers is None or workers == 0:  # 0 = auto, matching cfg.verify_workers
+        workers = os.cpu_count() or 1
+    if workers <= 1:
+        return None
+    try:
+        ctx = mp.get_context("fork")
+    except ValueError:  # non-POSIX: spawn re-imports (workers stay jax-free
+        ctx = mp.get_context("spawn")  # because ops.match imports are lazy)
+    pool = ProcessPoolExecutor(
+        max_workers=workers,
+        mp_context=ctx,
+        initializer=_verify_worker_init,
+        initargs=(index.processed,),
+    )
+    # Executors fork lazily on first submit — which would otherwise happen
+    # AFTER the screen initialised the device in this process.  Warm every
+    # worker NOW so the forks really do predate any jax device state.
+    wait([pool.submit(_warm_noop) for _ in range(workers)])
+    return pool
 
 
 # -- output writing (ref :128-146, :195-217) --------------------------------
@@ -490,8 +594,18 @@ def run_matcher(
     use_screen: bool | None = None,
     use_refine: bool = False,
     articles_csv: str | None = None,
+    workers: int | None = None,
 ) -> int:
-    """CLI entry: full matching run (ref ``__main__`` :220-246)."""
+    """CLI entry: full matching run (ref ``__main__`` :220-246).
+
+    The verify stage fans out over ``workers`` processes (default
+    ``cfg.verify_workers``; 0 = ``os.cpu_count()``, the reference's pool
+    width) — one pool for the whole run, created BEFORE the screen touches
+    the device so fork never duplicates an active device context.  CSV
+    writing stays in this process: single-writer by construction, unlike
+    the reference's lock-free multi-process appends
+    (``match_keywords.py:128-146``, a known race designed out here).
+    """
     articles_csv = articles_csv or cfg.articles_csv
     if not os.path.exists(articles_csv):
         print(f"Articles CSV '{articles_csv}' not found.")
@@ -501,21 +615,26 @@ def run_matcher(
     os.makedirs(out_dir, exist_ok=True)
     use_screen = cfg.use_tpu if use_screen is None else use_screen
     if use_refine and not use_screen:
-        # refine lives inside the screen path; silently no-opping would
-        # betray the caller's explicit request (screen may have been
-        # disabled via config/env, not just a CLI flag)
         raise ValueError("use_refine requires use_screen (see DESIGN.md §4)")
+    if workers is None:
+        workers = cfg.verify_workers
+    pool = make_verify_pool(index, workers)  # 0/None normalise to cpu_count
     n_matches = 0
-    for chunk in pd.read_csv(articles_csv, chunksize=cfg.chunk_size):
-        for ticker, matches, row in match_chunk(
-            chunk,
-            index,
-            use_screen=use_screen,
-            use_refine=use_refine,
-            threshold=cfg.fuzzy_threshold,
-        ):
-            if append_match(out_dir, ticker, matches, row):
-                n_matches += 1
+    try:
+        for chunk in pd.read_csv(articles_csv, chunksize=cfg.chunk_size):
+            for ticker, matches, row in match_chunk(
+                chunk,
+                index,
+                use_screen=use_screen,
+                use_refine=use_refine,
+                threshold=cfg.fuzzy_threshold,
+                pool=pool,
+            ):
+                if append_match(out_dir, ticker, matches, row):
+                    n_matches += 1
+    finally:
+        if pool is not None:
+            pool.shutdown()
     for f in os.listdir(out_dir):
         sort_matched_csv(os.path.join(out_dir, f))
     print(f"Matching complete: {n_matches} ticker-article matches → {out_dir}/")

@@ -298,3 +298,50 @@ def test_screen_exact_path_prunes_impossible_substrings():
     keep = match_screen(tok, np.array([4], np.int32), np.array([5], np.int32),
                         ln, tables)
     assert not keep[0, 0]
+
+
+def test_verify_pool_output_identical_to_serial():
+    """The process fan-out (ref match_keywords.py:231-238) must not change
+    output content or order."""
+    from advanced_scrapper_tpu.pipeline.matcher import make_verify_pool
+
+    rows = []
+    for i in range(25):
+        body = "filler text about markets. "
+        if i % 3 == 0:
+            body += ARTICLE
+        rows.append({
+            "article_text": body, "title": TITLE if i % 4 == 0 else "wrap",
+            "date_time": "2020-06-01T00:00:00Z", "url": f"https://x/{i}.html",
+            "source": "s", "source_url": "su",
+        })
+    df = pd.DataFrame(rows)
+    idx = _index()
+    serial = match_chunk(df, idx, use_screen=True)
+    pool = make_verify_pool(idx, workers=3)
+    assert pool is not None
+    try:
+        pooled = match_chunk(df, idx, use_screen=True, pool=pool)
+    finally:
+        pool.shutdown()
+    as_cmp = lambda res: [
+        (t, json.dumps(m, sort_keys=True), r["url"]) for t, m, r in res
+    ]
+    assert as_cmp(pooled) == as_cmp(serial)
+    assert len(serial) >= 8
+
+
+def test_verify_pool_single_worker_is_none():
+    from advanced_scrapper_tpu.pipeline.matcher import make_verify_pool
+
+    assert make_verify_pool(_index(), workers=1) is None
+
+
+def test_match_chunk_rejects_refine_without_screen():
+    df = pd.DataFrame([{
+        "article_text": "x", "title": "t",
+        "date_time": "2020-06-01T00:00:00Z", "url": "u",
+        "source": "s", "source_url": "su",
+    }])
+    with pytest.raises(ValueError, match="use_refine requires use_screen"):
+        match_chunk(df, _index(), use_screen=False, use_refine=True)
