@@ -305,9 +305,9 @@ class HostBatcher:
         sized inputs (lists, ndarrays) slice without a per-element
         round-trip."""
         docs = [_enc(d) for d in docs]
-        if hasattr(tags, "__len__"):
+        try:
             tags = tags[: len(docs)]
-        else:
+        except TypeError:  # sized-but-unsliceable (set, dict keys) or generator
             import itertools
 
             tags = list(itertools.islice(iter(tags), len(docs)))

@@ -23,7 +23,6 @@ XLA-idiomatic split.  For *static* corpora the all-device path
 
 from __future__ import annotations
 
-import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Sequence
 
@@ -31,7 +30,7 @@ import numpy as np
 
 from advanced_scrapper_tpu.config import DedupConfig
 from advanced_scrapper_tpu.core.hashing import make_params
-from advanced_scrapper_tpu.ops.lsh import band_keys
+from advanced_scrapper_tpu.ops.lsh import band_keys, band_keys_wide
 from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
 
 
@@ -89,8 +88,12 @@ class TpuBatchBackend:
         #   marks carry the sentinel BLOOM_SENTINEL instead of a target key.
         self._bloom_mode = self.cfg.stream_index == "bloom"
         if self._bloom_mode:
-            from advanced_scrapper_tpu.utils.bloom import BloomBandIndex
+            from advanced_scrapper_tpu.utils.bloom import (
+                BloomBandIndex, hash_key64, pack_keys64,
+            )
 
+            self._hash_key64 = hash_key64
+            self._pack_keys64 = pack_keys64
             self._bloom = BloomBandIndex(
                 self.cfg.num_bands,
                 bits=self.cfg.bloom_bits,
@@ -135,12 +138,12 @@ class TpuBatchBackend:
         # exact stage: host dict over record keys (urls); bloom mode uses a
         # fixed-size 1-band filter over a url hash instead of the growing set
         if self._bloom_mode:
+            # 64-bit url hash: a collision here is an unverifiable false
+            # "exact dup" drop, so 32-bit (crc32) key width was the dominant
+            # error term at stream scale (~n/2³²)
             url_hash = np.array(
-                [
-                    [zlib.crc32(_key_of(rec, self.key_field).encode("utf-8", "replace"))]
-                    for rec in records
-                ],
-                dtype=np.uint32,
+                [[self._hash_key64(_key_of(rec, self.key_field))] for rec in records],
+                dtype=np.uint64,
             )
             keyed = np.array(
                 [bool(_key_of(rec, self.key_field)) for rec in records]
@@ -171,10 +174,15 @@ class TpuBatchBackend:
         # near-dup stage: device signatures + band keys, host bucket join
         texts = [str(r.get(self.text_field, "") or "") for r in records]
         sigs = self.engine.signatures(texts)
-        keys = np.asarray(band_keys(sigs, self.params.band_salt))
         thresh = self.cfg.sim_threshold
         if self._bloom_mode:
-            return self._near_dup_bloom(records, texts, keys)
+            # wide (2×uint32 → uint64) keys: the bloom index cannot verify
+            # membership, so key width IS the false-drop floor
+            keys64 = self._pack_keys64(
+                np.asarray(band_keys_wide(sigs, self.params.band_salt))
+            )
+            return self._near_dup_bloom(records, texts, keys64)
+        keys = np.asarray(band_keys(sigs, self.params.band_salt))
         for i, rec in enumerate(records):
             rec["near_dup_of"] = None
             if rec["dup_of"] is not None:

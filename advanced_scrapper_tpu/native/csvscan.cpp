@@ -64,8 +64,11 @@ struct Scanner {
         end_field();
         if (!header_done) {
             header_done = true;
+            // keep the LAST matching column: csv.DictReader's dict build
+            // overwrites duplicates, so the last duplicate's values win —
+            // the Python fallback and this scanner must agree
             for (size_t i = 0; i < header.size(); ++i) {
-                if (want && header[i] == want) { target = (int)i; break; }
+                if (want && header[i] == want) target = (int)i;
             }
             if (target < 0) return false;
         }

@@ -25,6 +25,33 @@ import jax.numpy as jnp
 from advanced_scrapper_tpu.ops.shingle import FNV_OFFSET, FNV_PRIME, U32_MAX, fmix32
 
 
+# second-lane constants for the wide (64-bit-entropy) band keys: a distinct
+# FNV-style offset/prime pair so the two lanes are independent hashes of the
+# same band content (TPUs have no native uint64 — the packing happens on
+# host).  numpy (not jnp) scalars: a module-level jnp constant would
+# initialise the backend at import time, breaking jax.distributed ordering.
+import numpy as _np
+
+_WIDE_OFFSET = _np.uint32(0xCBF29CE4)
+_WIDE_PRIME = _np.uint32(0x01000197)
+
+
+def _fold_bands(sig: jnp.ndarray, nb: int, offset, prime) -> jnp.ndarray:
+    """FNV-1a fold of each band's signature rows → uint32[B, nb] (unsalted).
+
+    Single source of the fold used by BOTH :func:`band_keys` and lane 0/1
+    of :func:`band_keys_wide`, so their documented equivalence is
+    structural, not maintained by parallel editing.
+    """
+    B, P = sig.shape
+    r = P // nb
+    rows = sig.reshape(B, nb, r)
+    k = jnp.full((B, nb), offset, dtype=jnp.uint32)
+    for j in range(r):
+        k = (k ^ rows[:, :, j]) * prime
+    return k
+
+
 @jax.jit
 def band_keys(sig: jnp.ndarray, band_salt: jnp.ndarray) -> jnp.ndarray:
     """Fold each band's rows into one salted uint32 bucket key.
@@ -32,14 +59,29 @@ def band_keys(sig: jnp.ndarray, band_salt: jnp.ndarray) -> jnp.ndarray:
     ``sig`` is ``uint32[B, num_perm]``; returns ``uint32[B, num_bands]``.
     The north-star config is 16 bands × 8 rows (BASELINE.json).
     """
-    B, P = sig.shape
     nb = band_salt.shape[0]
-    r = P // nb
-    rows = sig.reshape(B, nb, r)
-    k = jnp.full((B, nb), FNV_OFFSET, dtype=jnp.uint32)
-    for j in range(r):
-        k = (k ^ rows[:, :, j]) * FNV_PRIME
+    k = _fold_bands(sig, nb, FNV_OFFSET, FNV_PRIME)
     return fmix32(k ^ band_salt[None, :])
+
+
+@jax.jit
+def band_keys_wide(sig: jnp.ndarray, band_salt: jnp.ndarray) -> jnp.ndarray:
+    """uint32[B, num_bands, 2]: two independent 32-bit keys per band.
+
+    Lane 0 is exactly :func:`band_keys` (same fold, same salt).  Lane 1
+    folds the same band rows with different constants and a rotated salt.
+    Packed to uint64 on host (``utils.bloom.pack_keys64``) this gives band
+    keys whose accidental collision rate is ~n·num_bands/2⁶⁴ — required by
+    the unattributed Bloom stream index, where a key collision is an
+    unverifiable false drop (32-bit keys lose ~n/2³² of unique docs, ~4%
+    at 10M scale).
+    """
+    nb = band_salt.shape[0]
+    lo = _fold_bands(sig, nb, FNV_OFFSET, FNV_PRIME)
+    hi = _fold_bands(sig, nb, _WIDE_OFFSET, _WIDE_PRIME)
+    salt = band_salt[None, :]
+    rot = (salt << jnp.uint32(13)) | (salt >> jnp.uint32(19))
+    return jnp.stack([fmix32(lo ^ salt), fmix32(hi ^ rot)], axis=-1)
 
 
 def _run_head_per_band(kt: jnp.ndarray, idxb: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:

@@ -92,10 +92,13 @@ def distribute_global_batch(tokens, lengths, mesh):
     return t, l
 
 
-# step-function cache: keyed on mesh (hashable) + params identity (held
-# strongly via the cached tuple, so the id cannot be recycled while cached)
-# + kwargs — same pattern as parallel.sharded._SEQ_KERNEL_CACHE.
+# step-function cache: keyed on mesh (hashable) + the params VALUE (its
+# arrays are pure functions of these four ints, see core.hashing.make_params)
+# + kwargs.  Bounded: long-lived processes creating many param variants must
+# not pin compiled executables forever; eviction is insertion-order (dicts
+# preserve it) — effectively FIFO, fine for a compile cache this small.
 _DEDUP_STEP_CACHE: dict = {}
+_DEDUP_STEP_CACHE_MAX = 16
 
 
 def multihost_dedup(local_tokens, local_lengths, params, mesh=None, **kw):
@@ -114,12 +117,18 @@ def multihost_dedup(local_tokens, local_lengths, params, mesh=None, **kw):
     if mesh is None:
         mesh = global_mesh()
     t, l = distribute_global_batch(local_tokens, local_lengths, mesh)
-    key = (mesh, id(params), tuple(sorted(kw.items())))
-    entry = _DEDUP_STEP_CACHE.get(key)
-    if entry is None:
-        entry = (make_sharded_dedup(mesh, params, **kw), params)
-        _DEDUP_STEP_CACHE[key] = entry
-    rep, hist = entry[0](t, l)
+    key = (
+        mesh,
+        params.num_perm, params.num_bands, params.shingle_k, params.seed,
+        tuple(sorted(kw.items())),
+    )
+    step = _DEDUP_STEP_CACHE.pop(key, None)
+    if step is None:
+        while len(_DEDUP_STEP_CACHE) >= _DEDUP_STEP_CACHE_MAX:
+            _DEDUP_STEP_CACHE.pop(next(iter(_DEDUP_STEP_CACHE)))
+        step = make_sharded_dedup(mesh, params, **kw)
+    _DEDUP_STEP_CACHE[key] = step  # (re-)insert at the back: LRU eviction
+    rep, hist = step(t, l)
     return (
         np.asarray(jax.device_get(rep.addressable_data(0))),
         np.asarray(jax.device_get(hist.addressable_data(0))),

@@ -13,9 +13,15 @@ Trade-offs vs the exact index (both are first-class; pick per workload):
 
 - **no attribution** — a Bloom hit says "a previously seen document shared
   this band", not *which* one, and no stored signature exists to verify
-  agreement against; precision is the LSH banding precision minus the
-  Bloom false-positive rate ``ε ≈ (1 - e^(-k·n/m))^k``.  At the default
-  2²⁴ bits/band with k=4 hashes, ε < 1e-4 past ten million insertions.
+  agreement against.  The false-drop rate has TWO terms: the filter's
+  ``ε_filter ≈ (1 - e^(-k·n/m))^k`` (< 1e-4 past ten million insertions at
+  the default 2²⁴ bits/band, k=4) **and the band-key collision rate**
+  ``ε_key ≈ n·num_bands/2^bits(key)`` — unverifiable here precisely
+  because nothing is stored.  With 32-bit keys ε_key dominates (~4% of
+  unique docs silently dropped at 10M); this index therefore expects
+  **uint64 keys** (``ops.lsh.band_keys_wide`` + :func:`pack_keys64`),
+  where ε_key ≈ 1e-11 at 10M and ε_filter dominates again.  uint32 keys
+  are still accepted for small/bounded streams.
 - **bounded memory** — 32 MiB total at defaults, forever.
 - **mergeable** — Bloom filters combine with bitwise OR, so per-shard /
   per-host indexes union exactly (the collective analogue of the band-key
@@ -27,6 +33,8 @@ filters only across batches — stream semantics match the exact index.
 """
 
 from __future__ import annotations
+
+import hashlib
 
 import numpy as np
 
@@ -41,8 +49,28 @@ def _splitmix64(x: np.ndarray) -> np.ndarray:
     return x ^ (x >> np.uint64(31))
 
 
+def pack_keys64(wide: np.ndarray) -> np.ndarray:
+    """``uint32[..., 2]`` (``ops.lsh.band_keys_wide`` layout) → ``uint64[...]``.
+
+    TPUs have no native uint64, so the two 32-bit lanes are computed on
+    device and packed here on host."""
+    wide = np.asarray(wide)
+    if wide.shape[-1] != 2:
+        raise ValueError(f"expected trailing lane dim of 2, got {wide.shape}")
+    lo = wide[..., 0].astype(np.uint64)
+    hi = wide[..., 1].astype(np.uint64)
+    return (hi << np.uint64(32)) | lo
+
+
+def hash_key64(key: str | bytes) -> int:
+    """Stable 64-bit hash of a record key (url) — the exact-dup filter's
+    key path.  blake2b-8: keyed-collision rate ~n/2⁶⁴ vs crc32's n/2³²."""
+    data = key if isinstance(key, bytes) else key.encode("utf-8", "replace")
+    return int.from_bytes(hashlib.blake2b(data, digest_size=8).digest(), "little")
+
+
 class BloomBandIndex:
-    """One Bloom filter per LSH band over uint32 band keys.
+    """One Bloom filter per LSH band over uint64 (preferred) or uint32 keys.
 
     ``bits`` must be a power of two.  All batch operations are vectorised
     numpy; nothing grows with the stream.
@@ -64,15 +92,33 @@ class BloomBandIndex:
         self.seed = seed
         self._words = np.zeros((num_bands, bits // 64), dtype=np.uint64)
         self.inserted = 0
+        # key width is pinned by the FIRST batch: a uint32 key and the same
+        # band content's uint64 key hash to different positions, so mixing
+        # widths silently corrupts membership — fail loudly instead
+        self.key_bits: int | None = None
 
     # -- core --------------------------------------------------------------
 
+    def _check_width(self, keys: np.ndarray) -> None:
+        w = 64 if keys.dtype == np.uint64 else 32
+        if self.key_bits is None:
+            self.key_bits = w
+        elif self.key_bits != w:
+            raise ValueError(
+                f"index was keyed with {self.key_bits}-bit keys; got "
+                f"{keys.dtype} — mixed widths never match each other"
+            )
+
     def _positions(self, keys: np.ndarray) -> np.ndarray:
-        """uint64[B, nb, k] bit positions for ``uint32[B, nb]`` band keys."""
+        """uint64[B, nb, k] bit positions for ``uint{32,64}[B, nb]`` keys."""
         B, nb = keys.shape
-        base = keys.astype(np.uint64) ^ (
-            (np.arange(nb, dtype=np.uint64) + np.uint64(self.seed + 1)) << np.uint64(32)
-        )[None, :]
+        # full-width per-band tweak (splitmix of band index) so 64-bit key
+        # entropy survives the band separation; a shifted-constant XOR would
+        # collide with the key's high lane
+        band_tweak = _splitmix64(
+            np.arange(nb, dtype=np.uint64) + np.uint64(self.seed + 1)
+        )
+        base = keys.astype(np.uint64) ^ band_tweak[None, :]
         hs = np.stack(
             [
                 _splitmix64(base + (np.uint64(h) << np.uint64(56)))
@@ -84,7 +130,9 @@ class BloomBandIndex:
 
     def contains_batch(self, keys: np.ndarray) -> np.ndarray:
         """bool[B]: any band of the row fully present in that band's filter."""
-        pos = self._positions(np.asarray(keys, dtype=np.uint32))
+        keys = np.asarray(keys)
+        self._check_width(keys)
+        pos = self._positions(keys)
         word = (pos >> np.uint64(6)).astype(np.int64)
         bit = np.uint64(1) << (pos & np.uint64(63))
         nb = self.num_bands
@@ -94,7 +142,8 @@ class BloomBandIndex:
 
     def add_batch(self, keys: np.ndarray, mask: np.ndarray | None = None) -> None:
         """Insert rows (optionally only where ``mask``) into every band filter."""
-        keys = np.asarray(keys, dtype=np.uint32)
+        keys = np.asarray(keys)
+        self._check_width(keys)
         if mask is not None:
             keys = keys[np.asarray(mask, dtype=bool)]
         if keys.size == 0:
@@ -119,7 +168,7 @@ class BloomBandIndex:
         conservative than the exact index (which only matches kept rows);
         a Bloom index cannot attribute representatives anyway.
         """
-        keys = np.asarray(keys, dtype=np.uint32)
+        keys = np.asarray(keys)
         dup = self.contains_batch(keys)
         B, nb = keys.shape
         rows = np.arange(B)
@@ -142,6 +191,17 @@ class BloomBandIndex:
             other.seed,
         ):
             raise ValueError("cannot merge differently-configured indexes")
+        if (
+            self.key_bits is not None
+            and other.key_bits is not None
+            and self.key_bits != other.key_bits
+        ):
+            raise ValueError(
+                f"cannot merge a {self.key_bits}-bit-keyed index with a "
+                f"{other.key_bits}-bit one — their keys never match"
+            )
+        if self.key_bits is None:
+            self.key_bits = other.key_bits
         np.bitwise_or(self._words, other._words, out=self._words)
         self.inserted += other.inserted
 

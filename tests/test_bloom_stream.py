@@ -113,3 +113,71 @@ def test_backend_bloom_mode_exact_url_dups():
 def test_backend_unknown_stream_index_rejected():
     with pytest.raises(ValueError, match="stream_index"):
         TpuBatchBackend(DedupConfig(stream_index="blom"))
+
+
+def test_pack_keys64_and_wide_keys():
+    """Wide band keys: lane 0 == band_keys, lane 1 independent; packed
+    uint64 separates band contents that collide at 32 bits only by luck."""
+    import numpy as np
+
+    from advanced_scrapper_tpu.core.hashing import make_params
+    from advanced_scrapper_tpu.core.tokenizer import encode_batch
+    from advanced_scrapper_tpu.ops.lsh import band_keys, band_keys_wide
+    from advanced_scrapper_tpu.ops.minhash import minhash_signatures
+    from advanced_scrapper_tpu.utils.bloom import pack_keys64
+
+    params = make_params()
+    tok, lens = encode_batch(
+        [f"document number {i} with some body text" for i in range(32)], 256
+    )
+    sig = minhash_signatures(tok, lens, params)
+    narrow = np.asarray(band_keys(sig, params.band_salt))
+    wide = np.asarray(band_keys_wide(sig, params.band_salt))
+    assert wide.shape == narrow.shape + (2,)
+    assert (wide[..., 0] == narrow).all()  # lane 0 is the classic key
+    assert (wide[..., 1] != narrow).any()  # lane 1 is a different hash
+    packed = pack_keys64(wide)
+    assert packed.dtype == np.uint64
+    assert (packed.astype(np.uint32) == narrow).all()  # low half round-trips
+
+
+def test_bloom_index_uint64_keys():
+    import numpy as np
+
+    from advanced_scrapper_tpu.utils.bloom import BloomBandIndex
+
+    idx = BloomBandIndex(4, bits=1 << 16)
+    rng = np.random.RandomState(0)
+    keys = rng.randint(0, 2**63, size=(8, 4)).astype(np.uint64)
+    assert not idx.contains_batch(keys).any()
+    idx.add_batch(keys)
+    assert idx.contains_batch(keys).all()
+    # keys sharing only the LOW 32 bits must NOT be reported present
+    low_only = keys ^ (np.uint64(0xDEADBEEF) << np.uint64(32))
+    assert not idx.contains_batch(low_only).any()
+
+
+def test_hash_key64_stable_and_wide():
+    from advanced_scrapper_tpu.utils.bloom import hash_key64
+
+    h = hash_key64("https://finance.yahoo.com/news/a.html")
+    assert h == hash_key64("https://finance.yahoo.com/news/a.html")
+    assert 0 <= h < 2**64
+    assert h != hash_key64("https://finance.yahoo.com/news/b.html")
+    assert hash_key64(b"bytes") == hash_key64("bytes")
+
+
+def test_mixed_key_widths_rejected():
+    import numpy as np
+    import pytest
+
+    from advanced_scrapper_tpu.utils.bloom import BloomBandIndex
+
+    idx = BloomBandIndex(2, bits=1 << 12)
+    idx.add_batch(np.array([[1, 2]], dtype=np.uint64))
+    with pytest.raises(ValueError, match="mixed widths"):
+        idx.contains_batch(np.array([[1, 2]], dtype=np.uint32))
+    other = BloomBandIndex(2, bits=1 << 12)
+    other.add_batch(np.array([[3, 4]], dtype=np.uint32))
+    with pytest.raises(ValueError, match="bit"):
+        idx.merge(other)

@@ -123,3 +123,19 @@ def test_read_url_column_uses_native_and_matches(tmp_path):
     got = read_url_column(p)
     assert got == [r[0] for r in rows]
     assert csvnative.BACKEND == "native"
+
+
+def test_duplicate_header_keeps_last_column(tmp_path):
+    """csv.DictReader's dict overwrite keeps the LAST duplicate column; the
+    native scanner must agree or resume anti-joins diverge by backend."""
+    import csv
+
+    p = str(tmp_path / "dup.csv")
+    with open(p, "w") as f:
+        f.write("url,title,url\nfirst1,t1,last1\nfirst2,t2,last2\n")
+    native_vals = csvnative.scan_column(p, "url")
+    assert native_vals is not None
+    with open(p, newline="") as f:
+        py_vals = [row["url"] for row in csv.DictReader(f)]
+    assert py_vals == ["last1", "last2"]
+    assert native_vals == py_vals

@@ -188,3 +188,16 @@ def test_stream_signatures_matches_direct_path():
     ref = np.asarray(minhash_signatures(tok, lens, params))
     for i in range(len(docs)):
         assert np.array_equal(out[i], ref[i]), f"doc {i} signature mismatch"
+
+
+def test_push_many_accepts_sized_unsliceable_tags(batcher_factory):
+    """Sets / dict keys have __len__ but no slicing; push_many must not
+    TypeError on them (docstring: 'tags may be any iterable')."""
+    b = batcher_factory(block=16)
+    n = b.push_many([b"a", b"b", b"c"], {10, 11, 12})
+    assert n == 3
+    n = b.push_many([b"d", b"e"], {20: "x", 21: "y"}.keys())
+    assert n == 2
+    got, _, _, tags = b.pop_batch(5, timeout_ms=100)
+    assert got == 5
+    assert set(tags.tolist()) == {10, 11, 12, 20, 21}
