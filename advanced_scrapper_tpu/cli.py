@@ -134,6 +134,8 @@ def _cmd_poll(args: argparse.Namespace) -> int:
                 topic_url=args.topic or DEFAULT_TOPIC_URL,
                 interval=args.interval,
                 max_iterations=1,
+                mirror_csv=args.mirror_csv,
+                scroll=args.scroll,
             )
             if args.drain:
                 stored += drain_unscraped(
@@ -332,8 +334,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     m.set_defaults(fn=_cmd_match)
 
-    pl = sub.add_parser("poll", help="live topic poller → sqlite link store")
-    pl.add_argument("--db", default="crypto_news.db")
+    pl = sub.add_parser("poll", help="live topic poller → link store")
+    pl.add_argument(
+        "--db", default="crypto_news.db",
+        help="sqlite path or postgres:// DSN (ref runs both stacks)",
+    )
     pl.add_argument("--topic", default=None)
     pl.add_argument("--interval", type=float, default=3.0)
     pl.add_argument("--rounds", type=int, default=None, help="default: forever")
@@ -341,6 +346,14 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--drain-rounds", type=int, default=1)
     pl.add_argument("--website", default="yfin")
     pl.add_argument("--transport", default=None)
+    pl.add_argument(
+        "--mirror-csv", default=None,
+        help="also append new links to this CSV (ref 04_crypto_1.py:76-80)",
+    )
+    pl.add_argument(
+        "--scroll", action="store_true",
+        help="scroll-to-load discovery on scroll-capable transports (04:57-63)",
+    )
     pl.set_defaults(fn=_cmd_poll)
 
     sv = sub.add_parser("serve", help="lease server: distribute URLs to workers")

@@ -208,6 +208,40 @@ class SeleniumTransport:
         except Exception as e:  # WebDriver raises many exception types
             raise FetchError(str(e)) from e
 
+    def fetch_scrolled(
+        self,
+        url: str,
+        *,
+        max_scrolls: int = 10,
+        settle_s: float = 2.0,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> str:
+        """Fetch, then scroll to the bottom until the page height stabilises
+        (lazy-loaded feeds; ref ``experiental/04_crypto_1.py:57-63``).
+
+        ``max_scrolls`` bounds infinite feeds; ``settle_s`` is the ref's
+        post-scroll wait for the lazy loader to append content.
+        """
+        self.fetch(url)  # navigation + readyState wait
+        try:
+            last_height = self._driver.execute_script(
+                "return document.body.scrollHeight"
+            )
+            for _ in range(max_scrolls):
+                self._driver.execute_script(
+                    "window.scrollTo(0, document.body.scrollHeight);"
+                )
+                sleep(settle_s)
+                height = self._driver.execute_script(
+                    "return document.body.scrollHeight"
+                )
+                if height == last_height:
+                    break  # stable: nothing more is lazy-loading
+                last_height = height
+            return self._driver.page_source
+        except Exception as e:
+            raise FetchError(str(e)) from e
+
     def close(self) -> None:
         self._driver.quit()
 

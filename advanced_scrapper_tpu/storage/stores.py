@@ -1,42 +1,67 @@
-"""SQLite link/article stores with DB-flag resume.
+"""Link/article stores with DB-flag resume, over a pluggable DB backend.
 
-Re-implements the reference's live-poller persistence
-(``experiental/09_btc_links.py:15-27``, ``10_btc_articles.py:48-112``):
+Re-implements the reference's live-poller persistence across BOTH of its
+database stacks (``storage/backends.py``):
+
+- SQLite (``experiental/09_btc_links.py:15-27``, ``10_btc_articles.py:48-112``)
+  — the default;
+- Postgres (``04_crypto_1.py:14-34``: ``CREATE DATABASE`` bootstrap,
+  ``INSERT … ON CONFLICT DO NOTHING``) — same store code over a DBAPI
+  driver.
+
+Schema:
 
 - ``links(url PRIMARY KEY, first_seen_utc, first_seen_unix,
   is_scraped DEFAULT 0)`` — insert-or-ignore discovery; the ``is_scraped``
   flag is the resume checkpoint (SURVEY.md §5.4 flavor 4);
 - ``articles(url PRIMARY KEY, title, author, datetime_utc, datetime_unix,
   content, ticker_symbols)`` — upsert + flag flip in one transaction.
-
-A Postgres twin of the link store exists in the reference
-(``04_crypto_1.py:14-34``, ``INSERT … ON CONFLICT DO NOTHING``); psycopg2
-is not available in this environment, so :class:`LinkStore` exposes the same
-interface over SQLite and a Postgres URL raises a clear error.
 """
 
 from __future__ import annotations
 
 import json
-import sqlite3
 import time
+from contextlib import contextmanager
 from datetime import datetime, timezone
 
 from dateutil import parser as dateparser
 
+from advanced_scrapper_tpu.storage.backends import make_backend
 
-class LinkStore:
+_LINK_COLS = ["url", "first_seen_utc", "first_seen_unix"]
+_ARTICLE_COLS = [
+    "url", "title", "author", "content",
+    "datetime_utc", "datetime_unix", "ticker_symbols",
+]
+
+
+class _StoreBase:
+    def __init__(self, target, *, driver=None):
+        # target: sqlite path, postgres DSN, or a prebuilt backend object
+        if isinstance(target, str):
+            self.backend = make_backend(target, driver=driver)
+        else:
+            self.backend = target
+        self.db_path = getattr(self.backend, "path", getattr(self.backend, "dsn", ""))
+
+    @contextmanager
+    def _conn(self):
+        conn = self.backend.connect()
+        try:
+            with conn:  # one transaction per store operation (both DBAPIs)
+                yield conn
+        finally:
+            conn.close()
+
+
+class LinkStore(_StoreBase):
     """links table: discovery + is_scraped checkpoint."""
 
-    def __init__(self, db_path: str):
-        if db_path.startswith(("postgres://", "postgresql://")):
-            raise RuntimeError(
-                "Postgres link store requires psycopg2, which is not "
-                "installed; use a sqlite path"
-            )
-        self.db_path = db_path
+    def __init__(self, target, *, driver=None):
+        super().__init__(target, driver=driver)
         with self._conn() as conn:
-            conn.execute(
+            conn.cursor().execute(
                 """
                 CREATE TABLE IF NOT EXISTS links (
                     url TEXT PRIMARY KEY,
@@ -47,49 +72,53 @@ class LinkStore:
                 """
             )
 
-    def _conn(self) -> sqlite3.Connection:
-        return sqlite3.connect(self.db_path)
+    def add_links(self, urls: list[str], now: float | None = None) -> list[str]:
+        """Insert-or-ignore; returns the urls that were NEW (in input order).
 
-    def add_links(self, urls: list[str], now: float | None = None) -> int:
-        """Insert-or-ignore; returns the number of NEW links."""
+        The reference's Postgres poller relies on exactly this
+        insert-or-ignore semantics (``04_crypto_1.py:76-80``)."""
         ts = now if now is not None else time.time()
         utc = datetime.fromtimestamp(ts, timezone.utc).strftime("%Y-%m-%d %H:%M:%S")
-        new = 0
+        sql = self.backend.insert_ignore_sql("links", _LINK_COLS, "url")
+        new: list[str] = []
         with self._conn() as conn:
+            cur = conn.cursor()
             for u in urls:
-                cur = conn.execute(
-                    "INSERT OR IGNORE INTO links (url, first_seen_utc, first_seen_unix)"
-                    " VALUES (?, ?, ?)",
-                    (u, utc, int(ts)),
-                )
-                new += cur.rowcount
+                cur.execute(sql, (u, utc, int(ts)))
+                if cur.rowcount:
+                    new.append(u)
         return new
 
     def unscraped(self) -> list[str]:
         with self._conn() as conn:
-            rows = conn.execute("SELECT url FROM links WHERE is_scraped = 0").fetchall()
-        return [r[0] for r in rows]
+            cur = conn.cursor()
+            cur.execute("SELECT url FROM links WHERE is_scraped = 0")
+            return [r[0] for r in cur.fetchall()]
 
     def mark_scraped(self, url: str) -> None:
+        p = self.backend.paramstyle
         with self._conn() as conn:
-            conn.execute("UPDATE links SET is_scraped = 1 WHERE url = ?", (url,))
+            conn.cursor().execute(
+                f"UPDATE links SET is_scraped = 1 WHERE url = {p}", (url,)
+            )
 
     def counts(self) -> tuple[int, int]:
         with self._conn() as conn:
-            total = conn.execute("SELECT COUNT(*) FROM links").fetchone()[0]
-            done = conn.execute(
-                "SELECT COUNT(*) FROM links WHERE is_scraped = 1"
-            ).fetchone()[0]
+            cur = conn.cursor()
+            cur.execute("SELECT COUNT(*) FROM links")
+            total = cur.fetchone()[0]
+            cur.execute("SELECT COUNT(*) FROM links WHERE is_scraped = 1")
+            done = cur.fetchone()[0]
         return total, done
 
 
-class ArticleStore:
+class ArticleStore(_StoreBase):
     """articles table: extractor-record upsert + link flag flip."""
 
-    def __init__(self, db_path: str):
-        self.db_path = db_path
+    def __init__(self, target, *, driver=None):
+        super().__init__(target, driver=driver)
         with self._conn() as conn:
-            conn.execute(
+            conn.cursor().execute(
                 """
                 CREATE TABLE IF NOT EXISTS articles (
                     url TEXT PRIMARY KEY,
@@ -103,9 +132,6 @@ class ArticleStore:
                 """
             )
 
-    def _conn(self) -> sqlite3.Connection:
-        return sqlite3.connect(self.db_path)
-
     def store(self, url: str, data: dict) -> None:
         """Upsert one extracted record and flip the link flag (ref 10:81-112)."""
         raw_dt = data.get("datetime") or None
@@ -117,11 +143,11 @@ class ArticleStore:
                 dt_unix = int(parsed.timestamp())
             except (ValueError, OverflowError):
                 pass
+        sql = self.backend.upsert_sql("articles", _ARTICLE_COLS, "url")
         with self._conn() as conn:
-            conn.execute(
-                "INSERT OR REPLACE INTO articles "
-                "(url, title, author, content, datetime_utc, datetime_unix, ticker_symbols)"
-                " VALUES (?, ?, ?, ?, ?, ?, ?)",
+            cur = conn.cursor()
+            cur.execute(
+                sql,
                 (
                     url,
                     str(data.get("title")) if data.get("title") is not None else None,
@@ -137,22 +163,26 @@ class ArticleStore:
             # flip the link flag only when this DB also hosts a links table
             # (the reference shares one file; independent files are legal here
             # and must not roll back the article insert)
-            has_links = conn.execute(
-                "SELECT 1 FROM sqlite_master WHERE type='table' AND name='links'"
-            ).fetchone()
-            if has_links:
-                conn.execute("UPDATE links SET is_scraped = 1 WHERE url = ?", (url,))
+            if self.backend.has_table(conn, "links"):
+                p = self.backend.paramstyle
+                cur.execute(
+                    f"UPDATE links SET is_scraped = 1 WHERE url = {p}", (url,)
+                )
 
     def all_texts(self):
         """Yield (url, content) pairs — the cross-source dedup feed.
 
-        Lazy: rows stream off the sqlite cursor so a multi-GB store never
+        Lazy: rows stream off the cursor so a multi-GB store never
         materialises on the host at once.
         """
         with self._conn() as conn:
-            for r in conn.execute("SELECT url, COALESCE(content, '') FROM articles"):
+            cur = conn.cursor()
+            cur.execute("SELECT url, COALESCE(content, '') FROM articles")
+            for r in cur:
                 yield (r[0], r[1])
 
     def count(self) -> int:
         with self._conn() as conn:
-            return conn.execute("SELECT COUNT(*) FROM articles").fetchone()[0]
+            cur = conn.cursor()
+            cur.execute("SELECT COUNT(*) FROM articles")
+            return cur.fetchone()[0]

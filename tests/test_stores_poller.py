@@ -40,8 +40,8 @@ def test_extract_topic_links_reference_filter():
 def test_link_store_insert_ignore_and_flag(tmp_path):
     db = str(tmp_path / "news.db")
     store = LinkStore(db)
-    assert store.add_links(["u1", "u2"], now=1000.0) == 2
-    assert store.add_links(["u2", "u3"], now=1001.0) == 1  # u2 ignored
+    assert store.add_links(["u1", "u2"], now=1000.0) == ["u1", "u2"]
+    assert store.add_links(["u2", "u3"], now=1001.0) == ["u3"]  # u2 ignored
     assert sorted(store.unscraped()) == ["u1", "u2", "u3"]
     store.mark_scraped("u2")
     assert sorted(store.unscraped()) == ["u1", "u3"]
@@ -51,8 +51,10 @@ def test_link_store_insert_ignore_and_flag(tmp_path):
     assert cols == ["url", "first_seen_utc", "first_seen_unix", "is_scraped"]
 
 
-def test_link_store_rejects_postgres_url():
-    with pytest.raises(RuntimeError):
+def test_link_store_postgres_url_needs_driver():
+    # psycopg2 is not installed here: the DSN routes to PostgresBackend,
+    # which must fail loudly (not silently fall back to sqlite)
+    with pytest.raises(RuntimeError, match="psycopg2"):
         LinkStore("postgresql://localhost/crypto")
 
 
@@ -118,3 +120,172 @@ def test_article_store_independent_db_files(tmp_path):
     assert stored == 1 and arts.count() == 1
     # link flag lives in the other DB: stays unscraped there (documented)
     assert links.unscraped() == ["https://x/a.html"]
+
+
+# -- backend seam (ref 04_crypto_1.py:14-34 Postgres path) -------------------
+
+
+class FakePgDriver:
+    """Minimal psycopg2-compatible driver backed by sqlite.
+
+    Translates %s placeholders and intercepts the Postgres-only statements
+    (CREATE DATABASE bootstrap, catalog queries) so the stores' pg-dialect
+    SQL runs unmodified — a true seam test without a Postgres server.
+    """
+
+    def __init__(self, tmpdir):
+        self.tmpdir = tmpdir
+        self.statements: list[str] = []
+        self.databases: set[str] = set()
+
+    def connect(self, dsn):
+        driver = self
+
+        class Cursor:
+            def __init__(self, conn):
+                self._conn = conn
+                self._cur = None
+
+            def execute(self, sql, params=()):
+                driver.statements.append(sql)
+                if sql.startswith("CREATE DATABASE"):
+                    driver.databases.add(sql.split('"')[1])
+                    self._cur = None
+                    return
+                if "FROM pg_database" in sql:
+                    self._rows = (
+                        [(1,)] if params and params[0] in driver.databases else []
+                    )
+                    self._cur = None
+                    return
+                if "information_schema.tables" in sql:
+                    sql = (
+                        "SELECT 1 FROM sqlite_master WHERE type='table' "
+                        "AND name = ?"
+                    )
+                self._cur = self._conn.execute(sql.replace("%s", "?"), params)
+                self.rowcount = self._cur.rowcount
+
+            def fetchone(self):
+                if self._cur is None:
+                    return self._rows[0] if self._rows else None
+                return self._cur.fetchone()
+
+            def fetchall(self):
+                return self._cur.fetchall()
+
+            def __iter__(self):
+                return iter(self._cur)
+
+        class Conn:
+            def __init__(self, path):
+                self._conn = sqlite3.connect(path)
+                self.autocommit = False
+
+            def cursor(self):
+                return Cursor(self._conn)
+
+            def execute(self, sql, params=()):
+                c = Cursor(self._conn)
+                c.execute(sql, params)
+                return c
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                if exc[0] is None:
+                    self._conn.commit()
+                else:
+                    self._conn.rollback()
+                return False
+
+            def close(self):
+                self._conn.close()
+
+        name = dsn.rsplit("/", 1)[-1] or "default"
+        return Conn(os.path.join(self.tmpdir, f"pg_{name}.db"))
+
+
+def test_stores_over_postgres_backend_seam(tmp_path):
+    """The full link+article flow through the pg dialect (injected driver)."""
+    driver = FakePgDriver(str(tmp_path))
+    dsn = "postgresql://localhost/crypto_links"
+    links = LinkStore(dsn, driver=driver)
+    arts = ArticleStore(dsn, driver=driver)
+    assert links.add_links(["u1", "u2"], now=5.0) == ["u1", "u2"]
+    assert links.add_links(["u1", "u3"], now=6.0) == ["u3"]
+    assert sorted(links.unscraped()) == ["u1", "u2", "u3"]
+    arts.store("u2", {"title": "T", "article": "body", "datetime": "2024-01-01"})
+    assert sorted(links.unscraped()) == ["u1", "u3"]  # flag flipped
+    assert arts.count() == 1
+    assert list(arts.all_texts()) == [("u2", "body")]
+    # the dialect actually used pg syntax (not sqlite INSERT OR IGNORE)
+    assert any("ON CONFLICT (url) DO NOTHING" in s for s in driver.statements)
+    assert any("ON CONFLICT (url) DO UPDATE" in s for s in driver.statements)
+    assert not any("INSERT OR IGNORE" in s for s in driver.statements)
+
+
+def test_postgres_create_database_bootstrap(tmp_path):
+    from advanced_scrapper_tpu.storage.backends import PostgresBackend
+
+    driver = FakePgDriver(str(tmp_path))
+    be = PostgresBackend("postgresql://localhost/crypto", driver=driver)
+    be.ensure_database("crypto", "postgresql://localhost/postgres")
+    assert "crypto" in driver.databases
+    be.ensure_database("crypto", "postgresql://localhost/postgres")  # idempotent
+    assert sum(1 for s in driver.statements if s.startswith("CREATE DATABASE")) == 1
+
+
+# -- mirror CSV + scroll-to-load (ref 04:57-63, 76-80) -----------------------
+
+
+def test_poll_links_mirror_csv(tmp_path):
+    import csv as csvmod
+
+    store = LinkStore(str(tmp_path / "n.db"))
+    mirror = str(tmp_path / "mirror.csv")
+    poll_links(
+        store, MockTransport(lambda u: TOPIC_HTML), max_iterations=2,
+        sleep=lambda s: None, mirror_csv=mirror,
+    )
+    with open(mirror) as f:
+        rows = list(csvmod.DictReader(f))
+    # each NEW link mirrored exactly once (second poll found nothing new)
+    assert [r["url"] for r in rows] == [
+        "https://finance.yahoo.com/news/btc-surges-123.html",
+        "https://finance.yahoo.com/news/eth-dips-456.html?src=rss",
+    ]
+    assert all(r["first_seen_utc"] for r in rows)
+
+
+def test_poll_links_uses_transport_scroll(tmp_path):
+    class ScrollingMock(MockTransport):
+        def __init__(self, plain, scrolled):
+            super().__init__(lambda u: plain)
+            self._scrolled = scrolled
+            self.scroll_calls = 0
+
+        def fetch_scrolled(self, url):
+            self.scroll_calls += 1
+            return self._scrolled
+
+    extra = TOPIC_HTML.replace(
+        "</div>",
+        '<a href="https://finance.yahoo.com/news/lazy-789.html">lazy</a></div>',
+    )
+    t = ScrollingMock(TOPIC_HTML, extra)
+    store = LinkStore(str(tmp_path / "n.db"))
+    new = poll_links(store, t, max_iterations=1, sleep=lambda s: None, scroll=True)
+    assert t.scroll_calls == 1
+    assert new == 3  # the lazy-loaded link was discovered
+
+
+def test_poll_links_scroll_fallback_warns_once(tmp_path, capsys):
+    store = LinkStore(str(tmp_path / "n.db"))
+    poll_links(
+        store, MockTransport(lambda u: TOPIC_HTML), max_iterations=3,
+        sleep=lambda s: None, scroll=True,
+    )
+    out = capsys.readouterr().out
+    assert out.count("cannot scroll") == 1  # logged once, not per poll

@@ -1,0 +1,187 @@
+"""SeleniumTransport contract test over a fake selenium module.
+
+selenium is not installed in this environment, so the production fetch
+substrate (``net/transport.py::SeleniumTransport``, mirroring
+``/root/reference/constant_rate_scrapper.py:136-153``) would otherwise be
+dead code here.  A ``sys.modules``-injected stub drives the full contract:
+init with the reference's Firefox preferences, fetch with readyState wait,
+scroll-until-height-stable (ref ``04_crypto_1.py:57-63``), error wrapping,
+and quit.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import pytest
+
+
+class FakeDriver:
+    def __init__(self, options, heights=None):
+        self.options = options
+        self.visited: list[str] = []
+        self.scripts: list[str] = []
+        self.page_source = ""
+        self.page_load_timeout = None
+        self.quit_called = False
+        self.ready_after = 0  # readyState polls before "complete"
+        self._ready_polls = 0
+        # successive scrollHeight values; page_source grows alongside
+        self.heights = heights or [100]
+        self._h_ix = 0
+        self.raise_on_get: Exception | None = None
+
+    # -- WebDriver surface used by SeleniumTransport --
+    def set_page_load_timeout(self, t):
+        self.page_load_timeout = t
+
+    def get(self, url):
+        if self.raise_on_get is not None:
+            raise self.raise_on_get
+        self.visited.append(url)
+        self._ready_polls = 0
+        self._h_ix = 0
+        self.page_source = f"<html>page0 of {url}</html>"
+
+    def execute_script(self, script):
+        self.scripts.append(script)
+        if "readyState" in script:
+            self._ready_polls += 1
+            return "complete" if self._ready_polls > self.ready_after else "loading"
+        if "return document.body.scrollHeight" in script:
+            return self.heights[min(self._h_ix, len(self.heights) - 1)]
+        if "scrollTo" in script:
+            self._h_ix = min(self._h_ix + 1, len(self.heights) - 1)
+            self.page_source = f"<html>page{self._h_ix}</html>"
+            return None
+        raise AssertionError(f"unexpected script: {script}")
+
+    def quit(self):
+        self.quit_called = True
+
+
+@pytest.fixture()
+def fake_selenium(monkeypatch):
+    """Install a minimal selenium package into sys.modules."""
+    created: dict = {}
+
+    class Options:
+        def __init__(self):
+            self.prefs: dict = {}
+            self.args: list[str] = []
+
+        def set_preference(self, k, v):
+            self.prefs[k] = v
+
+        def add_argument(self, a):
+            self.args.append(a)
+
+    class Service:
+        def __init__(self, executable_path):
+            self.executable_path = executable_path
+
+    def Firefox(service, options):
+        d = FakeDriver(options)
+        created["driver"] = d
+        created["service"] = service
+        return d
+
+    class WebDriverWait:
+        def __init__(self, driver, timeout):
+            self.driver = driver
+            self.timeout = timeout
+
+        def until(self, pred):
+            for _ in range(50):
+                if pred(self.driver):
+                    return True
+            raise TimeoutError("condition never true")
+
+    selenium = types.ModuleType("selenium")
+    webdriver = types.ModuleType("selenium.webdriver")
+    webdriver.Firefox = Firefox
+    firefox = types.ModuleType("selenium.webdriver.firefox")
+    options_m = types.ModuleType("selenium.webdriver.firefox.options")
+    options_m.Options = Options
+    service_m = types.ModuleType("selenium.webdriver.firefox.service")
+    service_m.Service = Service
+    support = types.ModuleType("selenium.webdriver.support")
+    ui = types.ModuleType("selenium.webdriver.support.ui")
+    ui.WebDriverWait = WebDriverWait
+    selenium.webdriver = webdriver
+    mods = {
+        "selenium": selenium,
+        "selenium.webdriver": webdriver,
+        "selenium.webdriver.firefox": firefox,
+        "selenium.webdriver.firefox.options": options_m,
+        "selenium.webdriver.firefox.service": service_m,
+        "selenium.webdriver.support": support,
+        "selenium.webdriver.support.ui": ui,
+    }
+    for name, mod in mods.items():
+        monkeypatch.setitem(sys.modules, name, mod)
+    return created
+
+
+def test_init_applies_reference_preferences(fake_selenium):
+    from advanced_scrapper_tpu.net.transport import SeleniumTransport
+
+    t = SeleniumTransport(page_load_timeout=30.0, executable_path="gd-path")
+    d = fake_selenium["driver"]
+    # the reference's Firefox prefs (constant_rate_scrapper.py:33-41)
+    assert d.options.prefs["permissions.default.image"] == 2
+    assert d.options.prefs["javascript.enabled"] is False
+    assert "-headless" in d.options.args
+    assert fake_selenium["service"].executable_path == "gd-path"
+    assert d.page_load_timeout == 30.0
+    t.close()
+    assert d.quit_called
+
+
+def test_fetch_waits_for_ready_state(fake_selenium):
+    from advanced_scrapper_tpu.net.transport import SeleniumTransport
+
+    t = SeleniumTransport()
+    d = fake_selenium["driver"]
+    d.ready_after = 3  # "loading" three times before "complete"
+    html = t.fetch("https://x/a.html")
+    assert d.visited == ["https://x/a.html"]
+    assert "page0" in html
+    assert d._ready_polls == 4
+
+
+def test_fetch_wraps_webdriver_errors(fake_selenium):
+    from advanced_scrapper_tpu.net.transport import FetchError, SeleniumTransport
+
+    t = SeleniumTransport()
+    fake_selenium["driver"].raise_on_get = RuntimeError(
+        "about:neterror (unknown host)"
+    )
+    with pytest.raises(FetchError, match="about:neterror"):
+        t.fetch("https://x/down.html")
+
+
+def test_fetch_scrolled_until_height_stable(fake_selenium):
+    from advanced_scrapper_tpu.net.transport import SeleniumTransport
+
+    t = SeleniumTransport()
+    d = fake_selenium["driver"]
+    d.heights = [100, 250, 400, 400]  # grows twice, then stable
+    slept: list[float] = []
+    html = t.fetch_scrolled("https://x/topic", settle_s=2.0, sleep=slept.append)
+    scrolls = [s for s in d.scripts if "scrollTo" in s]
+    # scrolls: 100->250, 250->400, 400->400 (stable -> stop)
+    assert len(scrolls) == 3
+    assert slept == [2.0, 2.0, 2.0]
+    assert "page3" in html or "page2" in html  # final, post-scroll source
+
+
+def test_fetch_scrolled_respects_max_scrolls(fake_selenium):
+    from advanced_scrapper_tpu.net.transport import SeleniumTransport
+
+    t = SeleniumTransport()
+    d = fake_selenium["driver"]
+    d.heights = list(range(100, 10000, 100))  # never stabilises
+    t.fetch_scrolled("https://x/topic", max_scrolls=4, sleep=lambda s: None)
+    assert len([s for s in d.scripts if "scrollTo" in s]) == 4
