@@ -84,12 +84,17 @@ def encode_blocks(
     """
     if block_len <= overlap:
         raise ValueError(f"block_len {block_len} must exceed overlap {overlap}")
+    raw_docs = [to_bytes(t) for t in texts]
+    from advanced_scrapper_tpu.cpu.hostbatch import encode_blocks_native
+
+    native = encode_blocks_native(raw_docs, block_len, overlap)
+    if native is not None:
+        return native
     stride = block_len - overlap
     tok_rows: list[np.ndarray] = []
     lens: list[int] = []
     owners: list[int] = []
-    for i, t in enumerate(texts):
-        r = to_bytes(t)
+    for i, r in enumerate(raw_docs):
         if not r:
             r = b"\x00"
         pos = 0

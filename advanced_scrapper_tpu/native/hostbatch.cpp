@@ -137,6 +137,46 @@ long hb_pop_batch(void* hp, long batch, long block_len, long timeout_ms,
   return n;
 }
 
+// Blockwise split+pad encoder: the native twin of
+// core/tokenizer.encode_blocks (the host side of the ragged→fixed-shape
+// bridge).  Doc i = data[offsets[i], offsets[i+1]) is cut into blocks of
+// block_len bytes with `overlap` bytes carried across cuts (k-1 for
+// k-shingles, so no shingle is lost at a boundary); an empty doc yields one
+// zero block of recorded length 1 (parity with the Python twin's b"\x00").
+// out_tokens must arrive zero-filled (np.zeros): only real bytes are
+// memcpy'd, padding is never touched.  Returns blocks written, or -1 when
+// the caller's count (max_blocks, computed vectorised in numpy) disagrees —
+// callers treat that as a hard bug, not a retry.
+long hb_encode_blocks(const uint8_t* data, const long long* offsets,
+                      long n_docs, long block_len, long overlap,
+                      long max_blocks, uint8_t* out_tokens,
+                      int32_t* out_lengths, int32_t* out_owners) {
+  if (block_len <= overlap || n_docs < 0) return -1;
+  const long long stride = block_len - overlap;
+  long j = 0;
+  for (long i = 0; i < n_docs; ++i) {
+    const long long len = offsets[i + 1] - offsets[i];
+    if (len < 0) return -1;
+    const uint8_t* doc = data + offsets[i];
+    long long pos = 0;
+    while (true) {
+      if (j >= max_blocks) return -1;
+      const long long rem = len - pos;
+      const long long copy =
+          rem < block_len ? (rem > 0 ? rem : 0) : block_len;
+      if (copy)
+        std::memcpy(out_tokens + static_cast<size_t>(j) * block_len,
+                    doc + pos, static_cast<size_t>(copy));
+      out_lengths[j] = len == 0 ? 1 : static_cast<int32_t>(copy);
+      out_owners[j] = static_cast<int32_t>(i);
+      ++j;
+      if (pos + block_len >= len) break;
+      pos += stride;
+    }
+  }
+  return j;
+}
+
 long hb_size(void* hp) {
   auto* h = static_cast<HostBatch*>(hp);
   std::lock_guard<std::mutex> lk(h->mu);

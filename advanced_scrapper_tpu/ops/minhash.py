@@ -154,3 +154,27 @@ def combine_block_signatures(
     return jax.ops.segment_min(
         block_sigs, owners, num_segments=num_articles, indices_are_sorted=False
     )
+
+
+@partial(jax.jit, static_argnames=("num_articles",), donate_argnums=(0,))
+def accumulate_block_signatures(
+    running: jnp.ndarray,
+    block_sigs: jnp.ndarray,
+    owners: jnp.ndarray,
+    *,
+    num_articles: int,
+) -> jnp.ndarray:
+    """One streamed step of the block→article combine: fold a fixed-shape
+    batch of block signatures into the running ``uint32[num_articles, P]``
+    minimum.  Min is associative/commutative, so folding batch-by-batch is
+    bit-identical to one whole-corpus :func:`combine_block_signatures` —
+    but each step dispatches asynchronously (and donates ``running``'s
+    buffer), so host encode, H2D, and device compute overlap instead of
+    serialising on a per-batch device sync (the round-2 ragged-regime
+    bottleneck).  Padding rows carry all-``U32_MAX`` signatures (the min
+    identity): their owner index is irrelevant.
+    """
+    part = jax.ops.segment_min(
+        block_sigs, owners, num_segments=num_articles, indices_are_sorted=False
+    )
+    return jnp.minimum(running, part)

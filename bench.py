@@ -84,21 +84,29 @@ def _ragged_corpus(rng: np.random.RandomState, n: int) -> list[bytes]:
     return docs
 
 
-def _bench_ragged(n_articles: int) -> float:
+def _bench_ragged(n_articles: int, n_corpora: int = 4) -> float:
+    """Steady-state streamed rate over several distinct warm corpora.
+
+    Every corpus's full dedup is dispatched async (``dedup_reps_async``)
+    before any result is synced, so corpus i+1's encode/H2D/compute overlap
+    corpus i's readback — the production firehose regime (the reference
+    analogue never stalls between 20k-row chunks, match_keywords.py:227-230).
+    Distinct corpora defeat transport-level (program, input) caching."""
     from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
 
     rng = np.random.RandomState(7)
     engine = NearDupEngine()
-    # corpus 0 warms every compiled shape (block batches are padded, article
-    # axis is bucketed); corpus 1 of the same config must hit only caches
-    warm = _ragged_corpus(rng, n_articles)
-    engine.dedup_reps(warm)
-    corpus = _ragged_corpus(rng, n_articles)
+    # corpus 0 warms every compiled shape (width buckets, block batches,
+    # bucketed article axis); later corpora of the same config hit caches
+    engine.dedup_reps(_ragged_corpus(rng, n_articles))
+    corpora = [_ragged_corpus(rng, n_articles) for _ in range(n_corpora)]
     t0 = time.perf_counter()
-    reps = engine.dedup_reps(corpus)
+    reps_dev = [engine.dedup_reps_async(c) for c in corpora]
+    reps = [np.asarray(r)[:n_articles] for r in reps_dev]
     dt = time.perf_counter() - t0
-    assert reps.shape == (n_articles,)
-    return n_articles / dt
+    for r in reps:
+        assert r.shape == (n_articles,)
+    return n_articles * n_corpora / dt
 
 
 def _bench_stream(

@@ -58,3 +58,32 @@ def test_pad_and_iter_batches():
     batches = list(iter_batches(["a", "b", "c"], batch_size=2, block_len=64))
     assert len(batches) == 2
     assert batches[0][2] == 2 and batches[1][2] == 1
+
+
+def test_encode_blocks_native_matches_python_oracle(monkeypatch):
+    """The C++ hb_encode_blocks must be bit-identical to the Python loop
+    (the behavioural oracle) across ragged lengths, empties, exact block
+    multiples, and off-by-one boundaries."""
+    import numpy as np
+
+    import advanced_scrapper_tpu.cpu.hostbatch as hb
+    from advanced_scrapper_tpu.cpu.hostbatch import encode_blocks_native
+
+    rng = np.random.RandomState(3)
+    lens = np.concatenate(
+        [rng.randint(0, 40, 8), rng.randint(40, 3000, 16),
+         rng.randint(3000, 40000, 4), [0, 1, 511, 512, 513, 1020, 1021]]
+    )
+    docs = [rng.randint(0, 256, int(n), dtype=np.uint8).tobytes() for n in lens]
+    for block, ov in [(512, 4), (64, 7), (128, 0)]:
+        nat = encode_blocks_native(docs, block, ov)
+        if nat is None:  # no compiler on this host: nothing to compare
+            import pytest
+
+            pytest.skip("no native hostbatch backend")
+        monkeypatch.setattr(hb, "encode_blocks_native", lambda *a: None)
+        py = encode_blocks(docs, block, overlap=ov)
+        monkeypatch.undo()
+        for a, b in zip(nat, py):
+            assert a.shape == b.shape
+            assert (a == b).all()
