@@ -123,6 +123,9 @@ class DedupConfig:
     block_len: int = 4096    # bytes per device block (bucketed padding)
     batch_size: int = 1024
     sim_threshold: float = 0.70  # signature-agreement verification threshold
+    cand_subbands: int = 32  # extra fine candidate bands (128/32 = 4 rows:
+    #   near-certain candidacy at the threshold knee; 0 disables.  Merges
+    #   still require sim_threshold agreement, so precision is unchanged.
     seed: int = 1            # datasketch's default seed for oracle parity
     backend: str = "scan"    # scan (dense, datasketch-parity) | oph | pallas
     stream_index: str = "exact"  # exact (attributed, grows with stream) |

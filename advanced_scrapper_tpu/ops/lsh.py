@@ -84,8 +84,11 @@ def band_keys_wide(sig: jnp.ndarray, band_salt: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([fmix32(lo ^ salt), fmix32(hi ^ rot)], axis=-1)
 
 
-def _run_head_per_band(kt: jnp.ndarray, idxb: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """For each band row (axis 1 = batch): sorted keys → run-head indices."""
+def _run_head_per_band(
+    kt: jnp.ndarray, idxb: jnp.ndarray
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """For each band row (axis 1 = batch): sorted keys → run-head and
+    run-predecessor indices, ``(si, head_sorted, pred_sorted)``."""
     nb, B = kt.shape
     sk, si = jax.lax.sort((kt, idxb), dimension=1, num_keys=2)
     seg_start = jnp.concatenate(
@@ -93,12 +96,16 @@ def _run_head_per_band(kt: jnp.ndarray, idxb: jnp.ndarray) -> tuple[jnp.ndarray,
     )
     seg_id = jnp.cumsum(seg_start, axis=1) - 1  # int32 [nb, B], < B
     # si is ascending within each equal-key run, so the run head (first-seen
-    # row) is the segment minimum of si.
+    # row) is the segment minimum of si, and the run predecessor is si
+    # shifted one sorted position (self at run starts).
     run_min = jax.vmap(
         lambda s, g: jax.ops.segment_min(s, g, num_segments=B)
     )(si, seg_id)
-    rep_sorted = jnp.take_along_axis(run_min, seg_id, axis=1)
-    return si, rep_sorted
+    head_sorted = jnp.take_along_axis(run_min, seg_id, axis=1)
+    pred_sorted = jnp.where(
+        seg_start, si, jnp.concatenate([si[:, :1], si[:, :-1]], axis=1)
+    )
+    return si, head_sorted, pred_sorted
 
 
 @jax.jit
@@ -119,7 +126,7 @@ def duplicate_reps(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     keys = jnp.where(valid[:, None], keys, U32_MAX)
     kt = keys.T
     idxb = jnp.broadcast_to(idx, (nb, B))
-    si, rep_sorted = _run_head_per_band(kt, idxb)
+    si, rep_sorted, _pred = _run_head_per_band(kt, idxb)
     rep_band = jax.vmap(
         lambda s, r: jnp.zeros((B,), dtype=jnp.int32).at[s].set(r)
     )(si, rep_sorted)
@@ -128,6 +135,121 @@ def duplicate_reps(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     # each other; sever them (and protect the pathological valid row that
     # really hashes to U32_MAX) by self-assignment.
     return jnp.where(valid, rep, idx)
+
+
+@jax.jit
+def duplicate_rep_bands(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Per-band candidate representatives: ``int32[B, 2*nb]`` (run head +
+    run predecessor per band).
+
+    Unlike :func:`duplicate_reps` (which min-reduces across bands BEFORE
+    verification), this keeps every band's candidates independent so the
+    verifier can test all of them.  The min-first scheme loses verified
+    pairs to shadowing: if row i shares band 3 with its true near-dup j
+    but band 7 accidentally collides with an unrelated earlier row h < j,
+    min picks h, verification fails, and i reverts to self even though j
+    would have verified (measured: 54 of 133 recall-certification misses
+    were this exact shape).
+    """
+    B, nb = keys.shape
+    idx = jnp.arange(B, dtype=jnp.int32)
+    keys = jnp.where(valid[:, None], keys, U32_MAX)
+    kt = keys.T
+    idxb = jnp.broadcast_to(idx, (nb, B))
+    # Head links alone under-connect a run — i and j may verify against
+    # each other but not against the head (datasketch's union-find merges
+    # any pairwise path); predecessor links chain consecutive run members
+    # so those pairs survive.
+    si, head_sorted, pred_sorted = _run_head_per_band(kt, idxb)
+    cands = []
+    for cand_sorted in (head_sorted, pred_sorted):
+        cand = jax.vmap(
+            lambda s, r: jnp.zeros((B,), dtype=jnp.int32).at[s].set(r)
+        )(si, cand_sorted)
+        cands.append(jnp.where(valid[None, :], cand, idxb).T)
+    return jnp.concatenate(cands, axis=1)  # int32[B, 2*nb]
+
+
+@partial(jax.jit, static_argnames=("jump_rounds",))
+def resolve_rep_bands(
+    rep_bands: jnp.ndarray,
+    sig: jnp.ndarray,
+    valid: jnp.ndarray,
+    threshold: float,
+    *,
+    jump_rounds: int,
+) -> jnp.ndarray:
+    """Verify EVERY band candidate by signature agreement, keep the smallest
+    verified one, then pointer-jump chains to the fixpoint.
+
+    The multi-candidate twin of :func:`resolve_reps`: ``rep_bands`` is
+    ``int32[B, nc]`` from :func:`duplicate_rep_bands` (callers may
+    concatenate extra candidate sets along axis 1).  Each verified
+    (row, candidate) pair is an undirected edge; the result is the
+    connected-component minimum — exactly datasketch's union-find over
+    verified pairs.  Single-parent min-hooking (keep only the smallest
+    verified candidate, then pointer-jump) is NOT equivalent: a row with
+    two verified edges keeps one, the discarded edge can bridge two
+    clusters, and backward-only edges never pull a cluster's later rows
+    down to its final label (measured: 30 of 74 certification misses had
+    pairwise agreement ≥ threshold yet landed in different clusters).
+    Label propagation: pull the min label along edges, push it back with a
+    scatter-min, then pointer-double — symmetric, monotone, and fixpoint =
+    component min within ``jump_rounds`` ≥ ceil(log2(B)) rounds.
+    Precision is unchanged — a merge still requires agreement ≥
+    ``threshold`` — candidates that fail verification contribute no edge.
+    """
+    B, nc = rep_bands.shape
+    idx = jnp.arange(B, dtype=jnp.int32)
+    # Verify in candidate-axis chunks: the full [B, nc, P] gather would be
+    # ~nc× the signature footprint (51 GB at nc=96 over a 2^20 bucket);
+    # chunked, the peak transient stays at [B, 8, P] — the same order as
+    # the signatures themselves.
+    ok_parts = []
+    for c0 in range(0, nc, 8):
+        cand_sig = jnp.take(sig, rep_bands[:, c0 : c0 + 8], axis=0)
+        agree = (sig[:, None, :] == cand_sig).mean(axis=2)
+        ok_parts.append(agree >= threshold)
+    ok = jnp.concatenate(ok_parts, axis=1) & valid[:, None]
+    cand = jnp.where(ok, rep_bands, idx[:, None])  # self-edges are no-ops
+    lab = idx
+    for _ in range(jump_rounds):
+        pulled = jnp.take(lab, cand, axis=0).min(axis=1)
+        lab = jnp.minimum(lab, pulled)
+        lab = lab.at[cand.reshape(-1)].min(
+            jnp.broadcast_to(lab[:, None], (B, nc)).reshape(-1)
+        )
+        lab = jnp.take(lab, lab)  # pointer doubling
+    return jnp.where(valid, lab, idx)
+
+
+def subband_salt(num: int, seed: int = 0x5B5C9A02) -> _np.ndarray:
+    """Deterministic uint32[num] salts for sub-band candidate keys —
+    derived, not stored in MinHashParams, so any sub-band count works
+    against the frozen north-star params."""
+    x = (_np.arange(num, dtype=_np.uint64) * _np.uint64(0x9E3779B97F4A7C15)
+         + _np.uint64(seed)) & _np.uint64(0xFFFFFFFF)
+    return x.astype(_np.uint32)
+
+
+def candidate_keys(
+    sig: jnp.ndarray, band_salt, cand_subbands: int
+) -> jnp.ndarray:
+    """Coarse + fine candidate band keys: ``uint32[B, nb + cand_subbands]``.
+
+    The single construction shared by the batch engine, the sharded step,
+    and the driver entry — their resolutions must stay identical (the
+    streamed path may not recall less than the certified one-shot path), so
+    the key scheme lives in exactly one place.  Fine sub-bands (fewer rows
+    per key) give near-certain candidacy at the threshold knee; merges
+    still require signature-agreement verification, so precision is
+    unchanged.  ``cand_subbands=0`` yields the plain 16-band keys.
+    """
+    keys = band_keys(sig, jnp.asarray(band_salt))
+    if not cand_subbands:
+        return keys
+    fine = band_keys(sig, jnp.asarray(subband_salt(cand_subbands)))
+    return jnp.concatenate([keys, fine], axis=1)
 
 
 @partial(jax.jit, static_argnames=("jump_rounds",))

@@ -31,8 +31,9 @@ from advanced_scrapper_tpu.core.hashing import MinHashParams
 from advanced_scrapper_tpu.ops.lsh import (
     band_keys,
     bucket_histogram,
-    duplicate_reps,
-    resolve_reps,
+    candidate_keys,
+    duplicate_rep_bands,
+    resolve_rep_bands,
 )
 from advanced_scrapper_tpu.ops.minhash import (
     minhash_signatures,
@@ -58,6 +59,7 @@ def make_sharded_dedup(
     jump_rounds: int = 16,
     hist_bins: int = 1 << 16,
     backend: str = "scan",
+    cand_subbands: int = 32,
 ):
     """Build the jitted batch-sharded dedup step for ``mesh``.
 
@@ -67,6 +69,11 @@ def make_sharded_dedup(
     psum-merged bucket histogram.  ``backend="oph"`` swaps the dense
     signature kernel for one-permutation hashing (``ops/oph.py``) — data
     shards own whole rows, so densification is safe shard-local.
+
+    Resolution is the same verified-candidate connected-components as the
+    batch engine (``duplicate_rep_bands`` + ``resolve_rep_bands``, with
+    ``cand_subbands`` fine candidate bands): the streamed path must not
+    recall less than the certified one-shot path.
     """
     data = _data_axis(mesh)
     salt = jnp.asarray(params.band_salt)
@@ -78,13 +85,16 @@ def make_sharded_dedup(
         sig = _sig_fn(tokens, lengths, params)
         keys = band_keys(sig, salt)
         valid = lengths >= k
+        all_keys = candidate_keys(sig, salt, cand_subbands)
         # Cross-shard candidate resolution: gather the compact per-article
-        # summaries (keys: 64 B, sig: 512 B per article) — never the text.
-        g_keys = jax.lax.all_gather(keys, data, axis=0, tiled=True)
+        # summaries (keys: 64-192 B, sig: 512 B per article) — never the text.
+        g_keys = jax.lax.all_gather(all_keys, data, axis=0, tiled=True)
         g_sig = jax.lax.all_gather(sig, data, axis=0, tiled=True)
         g_valid = jax.lax.all_gather(valid, data, axis=0, tiled=True)
-        rep = duplicate_reps(g_keys, g_valid)
-        rep = resolve_reps(rep, g_sig, g_valid, threshold, jump_rounds=jump_rounds)
+        rep_bands = duplicate_rep_bands(g_keys, g_valid)
+        rep = resolve_rep_bands(
+            rep_bands, g_sig, g_valid, threshold, jump_rounds=jump_rounds
+        )
         # North-star bucket merge: psum of per-shard histograms over ICI.
         hist = bucket_histogram(keys, valid, nbins=hist_bins)
         hist = jax.lax.psum(hist, data)

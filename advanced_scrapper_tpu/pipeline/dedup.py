@@ -25,7 +25,12 @@ from advanced_scrapper_tpu.core.tokenizer import (
     to_bytes,
 )
 from advanced_scrapper_tpu.ops.exact import ExactHasher
-from advanced_scrapper_tpu.ops.lsh import band_keys, duplicate_reps, keep_mask, resolve_reps
+from advanced_scrapper_tpu.ops.lsh import (
+    candidate_keys,
+    duplicate_rep_bands,
+    keep_mask,
+    resolve_rep_bands,
+)
 from advanced_scrapper_tpu.ops.minhash import resolve_signature_fn
 
 
@@ -158,10 +163,10 @@ class NearDupEngine:
         valid = np.zeros((n_bucket,), bool)
         valid[:n] = lens >= self.params.shingle_k
         valid = jax.device_put(valid)
-        keys = band_keys(sigs, jax.device_put(np.asarray(self.params.band_salt)))
-        rep = duplicate_reps(keys, valid)
-        return resolve_reps(
-            rep, sigs, valid, self.cfg.sim_threshold,
+        keys = candidate_keys(sigs, self.params.band_salt, self.cfg.cand_subbands)
+        rep_bands = duplicate_rep_bands(keys, valid)
+        return resolve_rep_bands(
+            rep_bands, sigs, valid, self.cfg.sim_threshold,
             jump_rounds=_jump_rounds(n_bucket),
         )
 

@@ -129,30 +129,57 @@ def _bench_stream(
     jax.block_until_ready(step(*warm))  # compile outside the timed region
 
     batcher = HostBatcher(block)
-    feed = DeviceFeed(batcher, batch)
+    feed = DeviceFeed(batcher, batch, depth=4)
 
     def produce():
+        # feed() chunks through push_many with bounded-backpressure retries —
+        # no O(n²) re-slicing of the remaining docs (the r2 producer
+        # re-sliced docs[pushed:] on every retry).
         for b in range(n_batches):
-            tags = np.arange(b * batch, (b + 1) * batch, dtype=np.uint64)
-            pushed = 0
-            while pushed < batch:
-                pushed += batcher.push_many(docs[pushed:], tags[pushed:])
+            batcher.feed(docs, start_tag=b * batch, chunk=4096)
         batcher.close()
 
     t0 = time.perf_counter()
     producer = threading.Thread(target=produce, daemon=True)
     producer.start()
     seen = 0
-    rep_tags: list[np.ndarray] = []
+    pending: list[tuple[object, np.ndarray, int]] = []
     for n, tok_dev, len_dev, tags in feed:
         rep, _hist = step(tok_dev, len_dev)
-        rep_tags.append(tags[np.asarray(rep)[:n]])  # tag-indexed reps (D2H)
+        try:
+            rep.copy_to_host_async()  # readback streams behind compute
+        except AttributeError:
+            pass
+        pending.append((rep, tags, n))  # sync nothing inside the loop
         seen += n
+    rep_tags = [tags[np.asarray(rep)[:n]] for rep, tags, n in pending]
     dt = time.perf_counter() - t0
     producer.join(timeout=30)
     feed.join()
     assert seen == total, (seen, total)
+    assert sum(r.shape[0] for r in rep_tags) == total
     return total / dt
+
+
+def _bench_recall(n_bases: int) -> tuple[float, int]:
+    """Measured near-dup recall vs datasketch-semantics oracle on the
+    hardened certification corpus (ragged 100 B–100 kB lengths, pairs
+    planted across the Jaccard knee) — the driver-visible twin of
+    ``tests/test_recall_vs_oracle.py::test_near_dup_recall_certification_hardened``
+    so recall is tracked per round, not just pass/fail (BASELINE bar ≥0.95)."""
+    from advanced_scrapper_tpu.core.hashing import make_params
+    from advanced_scrapper_tpu.cpu.oracle import (
+        build_certification_corpus,
+        measured_recall,
+    )
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    rng = np.random.RandomState(7)
+    texts = build_certification_corpus(rng, n_bases, n_long=min(12, n_bases // 8))
+    reps = NearDupEngine().dedup_reps(texts)
+    return measured_recall(
+        texts, reps, make_params(), threshold=0.7
+    )
 
 
 def main() -> None:
@@ -175,6 +202,7 @@ def main() -> None:
     uniform = _bench_uniform(jax, mesh, params, backend, batch, block)
     ragged = _bench_ragged(1024 if quick else 8192)
     stream = _bench_stream(jax, mesh, params, backend, batch, block, 2 if quick else 4)
+    recall, recall_pairs = _bench_recall(64 if quick else 512)
 
     print(
         json.dumps(
@@ -187,6 +215,8 @@ def main() -> None:
                 "ragged_vs_baseline": round(ragged / 50000.0, 4),
                 "stream_articles_per_sec": round(stream, 1),
                 "stream_vs_baseline": round(stream / 50000.0, 4),
+                "recall_vs_oracle": round(recall, 4),
+                "recall_pairs": recall_pairs,
             }
         )
     )

@@ -84,8 +84,100 @@ def test_near_dup_recall_vs_oracle():
     assert recall >= 0.95, f"near-dup recall {recall:.3f} < 0.95 ({hit}/{len(oracle_pairs)})"
 
 
+def test_near_dup_recall_certification_hardened():
+    """The round-3 hardened certification (VERDICT r2 item 4): 2048 docs
+    with ragged lengths (100 B – 100 kB, forcing the blockwise segment-min
+    combine), near-dup pairs planted ACROSS the Jaccard 0.6–0.8 knee where
+    LSH candidacy is genuinely probabilistic, measured against datasketch
+    oracle semantics.  The engine must recover ≥95% of oracle pairs while
+    never merging unrelated docs (checked separately below)."""
+    from advanced_scrapper_tpu.cpu.oracle import (
+        build_certification_corpus,
+        measured_recall,
+    )
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    rng = np.random.RandomState(7)
+    texts = build_certification_corpus(rng, 512)
+    assert len(texts) == 2048
+    assert max(len(t) for t in texts) >= 100_000  # blockwise combine forced
+    reps = NearDupEngine().dedup_reps(texts)
+    recall, n_pairs = measured_recall(texts, reps, PARAMS, 0.7)
+    assert n_pairs >= 900, "corpus must plant a statistically meaningful pair set"
+    assert recall >= 0.95, f"hardened recall {recall:.4f} < 0.95 ({n_pairs} pairs)"
+
+
+def test_resolve_rep_bands_is_union_find_over_verified_edges():
+    """Connected-component semantics: a pairwise-verified edge must merge
+    its endpoints even when neither endpoint verifies against the other's
+    smallest candidate (single-parent min-hooking drops such bridges)."""
+    import jax.numpy as jnp
+
+    from advanced_scrapper_tpu.ops.lsh import resolve_rep_bands
+
+    P = 128
+    base = np.arange(P).astype(np.uint32)
+    sig1 = base.copy()
+    sig1[:32] += 10_000          # agree(1, 0) = 96/128 = 0.75
+    sig2 = sig1.copy()
+    sig2[32:64] += 20_000        # agree(2, 1) = 0.75 but agree(2, 0) = 0.5
+    sigs = jnp.asarray(np.stack([base, sig1, sig2]))
+    valid = jnp.ones((3,), bool)
+    # row 2's candidates: head 0 (fails verify) AND predecessor 1 (verifies)
+    rep_bands = jnp.asarray(np.array([[0, 0], [0, 0], [0, 1]], np.int32))
+    out = np.asarray(
+        resolve_rep_bands(rep_bands, sigs, valid, 0.7, jump_rounds=4)
+    )
+    assert out.tolist() == [0, 0, 0]
+
+
+def test_resolve_rep_bands_symmetric_push_pulls_late_rows_down():
+    """Backward-only edges: row 2 holds BOTH verified edges (2→0 and 2→1).
+    Pulling alone gives row 2 label 0 but leaves row 1 stuck at 1 — row 1
+    has no edge of its own; only the scatter-min PUSH along edge 2→1 can
+    drag row 1 down to 0.  Deleting the push in resolve_rep_bands must turn
+    this red."""
+    import jax.numpy as jnp
+
+    from advanced_scrapper_tpu.ops.lsh import resolve_rep_bands
+
+    P = 128
+    a = np.arange(P).astype(np.uint32)
+    b = a.copy(); b[:16] += 10_000     # agree(b, a) = 0.875
+    c = a.copy(); c[16:32] += 20_000   # agree(c, a) = 0.875; agree(c, b) = 0.75
+    sigs = jnp.asarray(np.stack([a, b, c]))
+    valid = jnp.ones((3,), bool)
+    # row 0 and row 1 propose only themselves; row 2 proposes 0 and 1
+    rep_bands = jnp.asarray(np.array([[0, 0], [1, 1], [0, 1]], np.int32))
+    out = np.asarray(
+        resolve_rep_bands(rep_bands, sigs, valid, 0.7, jump_rounds=4)
+    )
+    assert out.tolist() == [0, 0, 0]
+
+
 def test_no_false_merges_of_unrelated_texts():
     rng = np.random.RandomState(11)
     texts = [bytes(rng.randint(32, 127, size=300, dtype=np.uint8)) for _ in range(64)]
     rep = _device_clusters(texts, threshold=0.7)
     assert (rep == np.arange(64)).all()
+
+
+def test_fast_oracle_bit_identical_to_slow_oracle():
+    """The vectorised oracle (ground truth for the hardened certification
+    and bench's recall field) must stay bit-identical to the per-shingle
+    datasketch-algorithm oracle — including u64 wraparound semantics."""
+    from advanced_scrapper_tpu.cpu.oracle import (
+        oracle_signatures,
+        oracle_signatures_fast,
+    )
+
+    rng = np.random.RandomState(5)
+    docs = [
+        rng.randint(0, 256, size=int(n), dtype=np.uint8).tobytes()
+        for n in (0, 1, 4, 5, 6, 37, 400, 5000, 20000)
+    ]
+    docs.append("ünïcode — mixed œntênt".encode())
+    slow = oracle_signatures(docs, PARAMS)
+    fast = oracle_signatures_fast(docs, PARAMS)
+    assert slow.shape == fast.shape
+    assert (slow == fast).all()
