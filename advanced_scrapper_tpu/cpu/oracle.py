@@ -176,7 +176,7 @@ def oracle_near_dup_pairs(
 
 
 def mutate_to_jaccard(
-    rng: np.random.RandomState, text: bytes, target_j: float, k: int = 5
+    rng: np.random.RandomState, text: bytes, target_j: float
 ) -> bytes:
     """Mutant whose k-shingle Jaccard with ``text`` lands near ``target_j``.
 
@@ -202,7 +202,6 @@ def build_certification_corpus(
     n_long: int = 12,
     long_len: int = 100_000,
     knee_frac: float = 0.4,
-    k: int = 5,
 ) -> list[bytes]:
     """Recall-certification corpus: ragged lengths (log-uniform
     ``min_len..max_len`` plus ``n_long`` docs at ``long_len`` forcing the
@@ -224,7 +223,7 @@ def build_certification_corpus(
                 tj = rng.uniform(0.62, 0.80)
             else:
                 tj = rng.uniform(0.85, 0.97)
-            texts.append(mutate_to_jaccard(rng, base, tj, k=k))
+            texts.append(mutate_to_jaccard(rng, base, tj))
         texts.append(
             rng.randint(32, 127, size=int(lens[rng.randint(n_bases)]), dtype=np.uint8).tobytes()
         )

@@ -30,7 +30,7 @@ import numpy as np
 
 from advanced_scrapper_tpu.config import DedupConfig
 from advanced_scrapper_tpu.core.hashing import make_params
-from advanced_scrapper_tpu.ops.lsh import band_keys, band_keys_wide
+from advanced_scrapper_tpu.ops.lsh import band_keys_wide, candidate_keys
 from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
 
 
@@ -182,7 +182,15 @@ class TpuBatchBackend:
                 np.asarray(band_keys_wide(sigs, self.params.band_salt))
             )
             return self._near_dup_bloom(records, texts, keys64)
-        keys = np.asarray(band_keys(sigs, self.params.band_salt))
+        # Coarse + fine candidate columns — the same key scheme as the
+        # certified batch engine (ops.lsh.candidate_keys), so the streaming
+        # exact index keeps knee-regime candidacy; every hit still verifies
+        # by signature agreement before attribution.  (The bloom mode below
+        # stays coarse-band: it cannot verify, and widening its key set
+        # would trade its bounded-memory contract for unverifiable drops.)
+        keys = np.asarray(
+            candidate_keys(sigs, self.params.band_salt, self.cfg.cand_subbands)
+        )
         for i, rec in enumerate(records):
             rec["near_dup_of"] = None
             if rec["dup_of"] is not None:
@@ -192,7 +200,7 @@ class TpuBatchBackend:
             if len(texts[i].encode("utf-8", "replace")) < self.params.shingle_k:
                 continue  # no shingles: never bucket
             candidate = None
-            for b in range(self.params.num_bands):
+            for b in range(keys.shape[1]):
                 idx = self._buckets.get((b, int(keys[i, b])))
                 if idx is not None:
                     agree = float(np.mean(self._kept_sigs[idx] == sigs[i]))
@@ -207,7 +215,7 @@ class TpuBatchBackend:
                 # copy: a row view would pin the whole batch array forever
                 self._kept_sigs.append(sigs[i].copy())
                 self._kept_keys.append(_key_of(rec, self.key_field))
-                for b in range(self.params.num_bands):
+                for b in range(keys.shape[1]):
                     self._buckets.setdefault((b, int(keys[i, b])), sig_idx)
                 self.stats.kept += 1
 

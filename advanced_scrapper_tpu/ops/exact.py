@@ -26,6 +26,12 @@ from advanced_scrapper_tpu.ops.shingle import fmix32
 
 _N_LANES = 4
 
+#: Hard ceiling for blockwise-hashed documents (4 MiB — far beyond any
+#: article body).  Not a correctness limit: the coefficient stream costs
+#: ~16 bytes per byte of the longest document, so one pathological blob
+#: must fail loudly rather than OOM the host.
+MAX_DOC_LEN = 1 << 22
+
 
 class ExactHasher:
     """Seeded 128-bit row hasher; coefficient tables are cached per row length."""
@@ -51,6 +57,90 @@ class ExactHasher:
     def __call__(self, tokens: jnp.ndarray, lengths: jnp.ndarray) -> jnp.ndarray:
         """``uint8[B, L] -> uint32[B, 4]`` (a 128-bit hash in 4 lanes)."""
         return _row_hash_impl(tokens, lengths, jnp.asarray(self._coef(tokens.shape[-1])))
+
+    def hash_docs(
+        self, raw: list[bytes], *, block_len: int = 4096
+    ) -> np.ndarray:
+        """``uint32[n, 4]`` — the same 128-bit hash at ANY document length.
+
+        The row hash is a linear form ``fmix32(Σ c_i·x_i ⊕ mix(len))``, so a
+        long document's sum splits exactly across fixed-shape blocks: block
+        p's partial dot uses the coefficient slice at offset ``p·block_len``
+        (the per-lane stream is prefix-consistent, so short docs hash
+        identically to the single-block path), partials segment-sum per doc
+        (uint32 wrap = mod-2³² addition, associative), and the length mix is
+        applied once at the end.  This removes the old ``max_len`` ceiling:
+        article bodies of any size get exact-hashed blockwise, the same
+        combine trick the MinHash path uses (VERDICT r2 item 5).
+        """
+        from advanced_scrapper_tpu.core.tokenizer import bucket_len, encode_blocks
+
+        n = len(raw)
+        if n == 0:
+            return np.zeros((0, _N_LANES), np.uint32)
+        longest = max(len(r) for r in raw)
+        if longest > MAX_DOC_LEN:
+            raise ValueError(
+                f"item of {longest} bytes exceeds MAX_DOC_LEN {MAX_DOC_LEN}; "
+                "the linear hash needs one coefficient per byte (~16 B/byte "
+                "host + device), so an unbounded item would silently become "
+                "an allocation storm — reject it loudly instead"
+            )
+        tok, _block_lens, owners = encode_blocks(raw, block_len, overlap=0)
+        true_lens = np.fromiter((len(r) for r in raw), np.int64, count=n)
+        # block position within its doc: owners is ascending, so the first
+        # block of doc d sits at searchsorted(owners, d).
+        block_pos = (
+            np.arange(tok.shape[0]) - np.searchsorted(owners, owners)
+        ).astype(np.int32)
+        # bucket the position axis so the coef tensor's shape is O(log) stable
+        n_pos = bucket_len(int(block_pos.max()) + 1, min_bucket=8)
+        coef = self._coef(n_pos * block_len)  # [4, n_pos*block_len]
+        coef_blocks = np.ascontiguousarray(
+            coef.reshape(_N_LANES, n_pos, block_len).transpose(1, 0, 2)
+        )
+        # Pad the block axis to a bucket so compiled shapes stay O(log N);
+        # padded rows point at doc slot n (a scratch row sliced off below).
+        n_blocks = tok.shape[0]
+        nb_bucket = bucket_len(n_blocks, min_bucket=64)
+        if nb_bucket != n_blocks:
+            pad = nb_bucket - n_blocks
+            tok = np.concatenate([tok, np.zeros((pad, block_len), np.uint8)])
+            owners = np.concatenate([owners, np.full((pad,), n, np.int32)])
+            block_pos = np.concatenate([block_pos, np.zeros((pad,), np.int32)])
+        n_doc_bucket = bucket_len(n + 1, min_bucket=64)
+        lens_pad = np.zeros((n_doc_bucket,), np.int32)
+        lens_pad[:n] = true_lens
+        out = _block_hash_impl(
+            tok,
+            jnp.asarray(block_pos),
+            jnp.asarray(owners),
+            jnp.asarray(lens_pad),
+            jnp.asarray(coef_blocks),
+            num_docs=n_doc_bucket,
+        )
+        return np.asarray(out)[:n]
+
+
+@partial(jax.jit, static_argnames=("num_docs",))
+def _block_hash_impl(
+    tokens: jnp.ndarray,
+    block_pos: jnp.ndarray,
+    owners: jnp.ndarray,
+    doc_lengths: jnp.ndarray,
+    coef_blocks: jnp.ndarray,
+    *,
+    num_docs: int,
+) -> jnp.ndarray:
+    """Blockwise 128-bit hash: per-block partial dots (coefficients gathered
+    by block position) segment-summed per document, then length-mixed."""
+    t = tokens.astype(jnp.uint32)
+    c = jnp.take(coef_blocks, block_pos, axis=0)  # [N, 4, BL]
+    dots = (t[:, None, :] * c).sum(axis=-1, dtype=jnp.uint32)  # [N, 4]
+    total = jax.ops.segment_sum(dots, owners, num_segments=num_docs)
+    lmix = fmix32(doc_lengths.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+    lane_salt = jnp.arange(_N_LANES, dtype=jnp.uint32) * jnp.uint32(0x85EBCA77)
+    return fmix32(total.astype(jnp.uint32) ^ lmix[:, None] ^ lane_salt[None, :])
 
 
 @jax.jit

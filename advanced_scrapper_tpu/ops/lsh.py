@@ -248,6 +248,12 @@ def candidate_keys(
     keys = band_keys(sig, jnp.asarray(band_salt))
     if not cand_subbands:
         return keys
+    num_perm = sig.shape[-1]
+    if num_perm % cand_subbands:
+        raise ValueError(
+            f"cand_subbands {cand_subbands} must divide num_perm {num_perm} "
+            "(each sub-band folds num_perm/cand_subbands signature rows)"
+        )
     fine = band_keys(sig, jnp.asarray(subband_salt(cand_subbands)))
     return jnp.concatenate([keys, fine], axis=1)
 

@@ -59,7 +59,7 @@ def make_sharded_dedup(
     jump_rounds: int = 16,
     hist_bins: int = 1 << 16,
     backend: str = "scan",
-    cand_subbands: int = 32,
+    cand_subbands: int | None = None,
 ):
     """Build the jitted batch-sharded dedup step for ``mesh``.
 
@@ -79,6 +79,11 @@ def make_sharded_dedup(
     salt = jnp.asarray(params.band_salt)
     k = params.shingle_k
     _sig_fn = resolve_signature_fn(backend)
+    if cand_subbands is None:
+        # single source of the default: the certified engine's config
+        from advanced_scrapper_tpu.config import DedupConfig
+
+        cand_subbands = DedupConfig().cand_subbands
 
     def local_step(tokens, lengths):
         # tokens: uint8[B/n, L] local shard

@@ -20,7 +20,6 @@ from advanced_scrapper_tpu.config import DedupConfig
 from advanced_scrapper_tpu.core.hashing import MinHashParams, make_params
 from advanced_scrapper_tpu.core.tokenizer import (
     bucket_len,
-    encode_batch,
     encode_blocks,
     to_bytes,
 )
@@ -193,21 +192,17 @@ class ExactDedup:
 
     def __init__(self, hasher: ExactHasher | None = None, max_len: int = 4096):
         self.hasher = hasher or ExactHasher()
+        # Historical name: rows are hashed blockwise at this width, so it no
+        # longer caps item length — any size hashes exactly (the linear hash
+        # splits across blocks; see ``ExactHasher.hash_docs``).
         self.max_len = max_len
 
     def keep_indices(self, items: Sequence[str]) -> list[int]:
         if not items:
             return []
-        longest = max(len(s.encode("utf-8", "replace")) for s in items)
-        if longest > self.max_len:
-            raise ValueError(
-                f"item of {longest} bytes exceeds max_len {self.max_len}; "
-                "raise max_len so hashing covers every byte (truncated hashing "
-                "would break the byte-identical guarantee)"
-            )
-        L = bucket_len(max(longest, 1))
-        tok, lens = encode_batch(items, block_len=L)
-        h = np.asarray(self.hasher(tok, lens))  # uint32[N, 4]
+        raw = [to_bytes(s) for s in items]
+        block = bucket_len(max(1, min(max(len(r) for r in raw), self.max_len)))
+        h = self.hasher.hash_docs(raw, block_len=block)  # uint32[N, 4]
         first_by_hash: dict[bytes, list[int]] = {}
         kept: list[int] = []
         for i in range(len(items)):

@@ -41,9 +41,26 @@ def test_exact_dedup_matches_pandas_drop_duplicates():
     assert got == expected
 
 
-def test_exact_dedup_rejects_overlong_items():
-    with pytest.raises(ValueError):
-        ExactDedup(max_len=16).keep_indices(["x" * 100])
+def test_exact_dedup_handles_items_beyond_block_width():
+    """max_len no longer caps item length — it is the blockwise hash width;
+    multi-kB bodies exact-dedup byte-identically (VERDICT r2 item 5)."""
+    rng = np.random.RandomState(2)
+    body = rng.randint(32, 127, size=100_000, dtype=np.uint8).tobytes().decode()
+    tail_variant = body[:-1] + "!"
+    items = [body, "short", body, tail_variant, "short"]
+    assert ExactDedup(max_len=16).keep_indices(items) == [0, 1, 3]
+    assert ExactDedup().keep_indices(items) == [0, 1, 3]
+
+
+def test_exact_dedup_blockwise_hash_matches_single_block_hash():
+    """The blockwise combine must hash identically to the one-block path so
+    mixed-length corpora group correctly regardless of block width."""
+    from advanced_scrapper_tpu.ops.exact import ExactHasher
+
+    docs = [b"", b"\x00", b"ab", b"ab\x00", b"x" * 5000, b"y" * 123]
+    a = ExactHasher().hash_docs(docs, block_len=64)
+    b = ExactHasher().hash_docs(docs, block_len=8192)
+    assert (a == b).all()
 
 
 def test_near_dup_engine_blockwise_long_articles():
@@ -61,3 +78,11 @@ def test_near_dup_engine_blockwise_long_articles():
 
 def test_near_dup_engine_empty_corpus():
     assert NearDupEngine().dedup_reps([]).shape == (0,)
+
+
+def test_exact_hasher_rejects_pathological_blob_loudly():
+    from advanced_scrapper_tpu.ops.exact import MAX_DOC_LEN, ExactHasher
+
+    doc = b"x" * (MAX_DOC_LEN + 1)
+    with pytest.raises(ValueError, match="MAX_DOC_LEN"):
+        ExactHasher().hash_docs([doc])
