@@ -82,6 +82,9 @@ def _cmd_scrape(args: argparse.Namespace) -> int:
 
 
 def _cmd_enrich(args: argparse.Namespace) -> int:
+    if getattr(args, "crypto", False):
+        run_crypto = _import_pipeline("enrich", "run_crypto_enrich")
+        return run_crypto(default_config().enrich)
     run_enrich = _import_pipeline("enrich", "run_enrich")
     return run_enrich(default_config().enrich)
 
@@ -320,7 +323,12 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--transport", default=None)
     s.set_defaults(fn=_cmd_scrape)
 
-    e = sub.add_parser("enrich", help="Wikidata ticker enrichment")
+    e = sub.add_parser("enrich", help="Wikidata ticker/crypto enrichment")
+    e.add_argument(
+        "--crypto",
+        action="store_true",
+        help="enrich the crypto symbol list into info/crypto/ instead",
+    )
     e.set_defaults(fn=_cmd_enrich)
 
     m = sub.add_parser("match", help="ticker→article entity matching")

@@ -64,7 +64,7 @@ class ScraperConfig:
     page_load_timeout: float = 30.0     # ref :139
     ready_state_timeout: float = 10.0   # ref :151
     result_timeout: float = 60.0        # ref :439
-    transport: str = "auto"             # auto|selenium|requests|mock
+    transport: str = "auto"  # auto|selenium|stealth-chrome|requests|mock
     out_dir: str = "."
 
 
@@ -94,6 +94,9 @@ class EnrichConfig:
     connect_timeout: float = 15.0       # ref protected :212
     read_timeout: float = 60.0          # ref protected :212
     progress_file: str = "progress.json"  # ref protected :340
+    crypto_symbols_csv: str = "crypto_list.csv"   # crypto flow symbol source
+    crypto_out_dir: str = "info/crypto"           # beside info/ticker (SURVEY §L4)
+    crypto_progress_file: str = "progress_crypto.json"
     cooldown_every3: tuple = (15.0, 25.0)   # ref protected :419-421
     cooldown_every10: tuple = (60.0, 120.0)  # ref protected :423-426
 

@@ -9,6 +9,9 @@ transports are swappable:
 - :class:`SeleniumTransport` — headless Firefox with the reference's
   preferences (images off, JS off, 30 s page-load timeout, readyState wait);
   available only where selenium + geckodriver exist.
+- :class:`StealthChromeTransport` — anti-bot Chrome via
+  undetected-chromedriver (the reference's experimental fleet substrate,
+  ``experiental/00_worker.py:2,31-33``); explicit opt-in, never auto-picked.
 - :class:`RequestsTransport` — plain HTTP with a browser UA (the substrate
   of ``ticker_symbol_query*.py``).
 - :class:`MockTransport` — fixture pages for tests and offline runs.
@@ -21,6 +24,7 @@ exceptions (``constant_rate_scrapper.py:190-193``).
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Callable, Mapping
 
@@ -171,30 +175,13 @@ class RequestsTransport:
         self._session.close()
 
 
-class SeleniumTransport:
-    """Headless Firefox via geckodriver, reference preferences
-    (``constant_rate_scrapper.py:33-41,136-153``)."""
+class _WebDriverTransport:
+    """Shared WebDriver fetch contract: navigation + readyState wait,
+    scroll-until-stable, error wrapping, quit.  Subclasses provide
+    ``self._driver`` and ``self._ready_timeout`` in ``__init__``."""
 
-    def __init__(
-        self,
-        page_load_timeout: float = 30.0,
-        ready_state_timeout: float = 10.0,
-        executable_path: str = "geckodriver",
-    ):
-        from selenium import webdriver
-        from selenium.webdriver.firefox.options import Options
-        from selenium.webdriver.firefox.service import Service
-
-        options = Options()
-        options.set_preference("permissions.default.image", 2)
-        options.set_preference("javascript.enabled", False)
-        options.set_preference("dom.ipc.plugins.enabled.libflashplayer.so", False)
-        options.add_argument("-headless")
-        self._driver = webdriver.Firefox(
-            service=Service(executable_path=executable_path), options=options
-        )
-        self._driver.set_page_load_timeout(page_load_timeout)
-        self._ready_timeout = ready_state_timeout
+    _driver = None
+    _ready_timeout: float = 10.0
 
     def fetch(self, url: str) -> str:
         from selenium.webdriver.support.ui import WebDriverWait
@@ -246,6 +233,79 @@ class SeleniumTransport:
         self._driver.quit()
 
 
+class SeleniumTransport(_WebDriverTransport):
+    """Headless Firefox via geckodriver, reference preferences
+    (``constant_rate_scrapper.py:33-41,136-153``)."""
+
+    def __init__(
+        self,
+        page_load_timeout: float = 30.0,
+        ready_state_timeout: float = 10.0,
+        executable_path: str = "geckodriver",
+    ):
+        from selenium import webdriver
+        from selenium.webdriver.firefox.options import Options
+        from selenium.webdriver.firefox.service import Service
+
+        options = Options()
+        options.set_preference("permissions.default.image", 2)
+        options.set_preference("javascript.enabled", False)
+        options.set_preference("dom.ipc.plugins.enabled.libflashplayer.so", False)
+        options.add_argument("-headless")
+        self._driver = webdriver.Firefox(
+            service=Service(executable_path=executable_path), options=options
+        )
+        self._driver.set_page_load_timeout(page_load_timeout)
+        self._ready_timeout = ready_state_timeout
+
+
+class StealthChromeTransport(_WebDriverTransport):
+    """Anti-bot Chrome via undetected-chromedriver — the reference's
+    experimental fleet substrate (``experiental/00_worker.py:2,31-33``,
+    ``03_worker_multi.py:64``), which patches Chrome to evade
+    navigator.webdriver/CDP fingerprinting where stock Firefox is blocked.
+
+    Same ``fetch()`` contract as every other transport, so engines and
+    pools are substrate-agnostic; select with
+    ``ScraperConfig.transport = "stealth-chrome"``.  The import is lazy and
+    optional — without the package this raises ImportError at construction
+    (``make_transport("auto")`` never picks it implicitly; anti-bot
+    crawling should be an explicit operator choice).
+    """
+
+    #: uc.Chrome() runs a binary patcher over a shared cached chromedriver;
+    #: concurrent instantiation (engine workers each build their transport)
+    #: can collide in the patcher — construction is serialized process-wide.
+    _construct_lock = threading.Lock()
+
+    def __init__(
+        self,
+        page_load_timeout: float = 30.0,
+        ready_state_timeout: float = 10.0,
+        headless: bool = True,
+        options=None,
+    ):
+        import undetected_chromedriver as uc
+
+        if options is None:
+            options = uc.ChromeOptions()
+            if headless:
+                options.add_argument("--headless=new")
+        with StealthChromeTransport._construct_lock:
+            self._driver = uc.Chrome(options=options)
+        self._driver.set_page_load_timeout(page_load_timeout)
+        self._ready_timeout = ready_state_timeout
+
+
+def stealth_chrome_available() -> bool:
+    """True when the undetected-chromedriver package is importable."""
+    try:
+        import undetected_chromedriver  # noqa: F401
+    except ImportError:
+        return False
+    return True
+
+
 def selenium_available() -> bool:
     """True only when the whole stack exists: the selenium package AND a
     geckodriver binary (the external WebDriver shim the reference ships,
@@ -285,6 +345,12 @@ def make_transport(
         name = "requests"
     if name == "selenium":
         return SeleniumTransport(
+            page_load_timeout=page_load_timeout,
+            ready_state_timeout=ready_state_timeout,
+            **kw,
+        )
+    if name == "stealth-chrome":
+        return StealthChromeTransport(
             page_load_timeout=page_load_timeout,
             ready_state_timeout=ready_state_timeout,
             **kw,

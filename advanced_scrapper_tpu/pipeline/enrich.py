@@ -310,3 +310,32 @@ def run_enrich(
                 sleep(rng.uniform(*cfg.cooldown_every3))
     print(f"Enrichment finished: {done} symbols attempted.")
     return 0
+
+
+def run_crypto_enrich(
+    cfg: EnrichConfig,
+    *,
+    symbols: list[str] | None = None,
+    **kw,
+) -> int:
+    """Crypto-symbol enrichment: the same Wikidata Q1/Q2/Q3 flow routed to
+    the crypto artifact tree.
+
+    The reference keeps ``info/crypto/*.json`` beside ``info/ticker/*.json``
+    (SURVEY.md §L4 artifact map; the commented legacy flow at
+    ``ticker_symbol_query.py:205-265`` wrote ``info/<symbol>_info.json``).
+    Here the crypto list rides the hardened client unchanged — only the
+    symbol source (``crypto_symbols_csv``), output dir, and progress ledger
+    are swapped, so retries/cool-downs/resume behave identically to the
+    ticker flow.
+    """
+    import dataclasses
+
+    crypto_cfg = dataclasses.replace(
+        cfg,
+        symbols_csv=cfg.crypto_symbols_csv,
+        out_dir=cfg.crypto_out_dir,
+        progress_file=cfg.crypto_progress_file,
+    )
+    os.makedirs(crypto_cfg.out_dir, exist_ok=True)
+    return run_enrich(crypto_cfg, symbols=symbols, **kw)

@@ -55,7 +55,15 @@ SUCCESS_FIELDS = [
 ]  # ref constant_rate_scrapper.py:320-329
 FAILED_FIELDS = ["url", "error"]  # ref :330
 
-_RATE_LIMIT_FINGERPRINTS = ("contentEncodingError", "about:neterror")  # ref :190
+_RATE_LIMIT_FINGERPRINTS = (
+    "contentEncodingError",  # Firefox/geckodriver (ref :190)
+    "about:neterror",        # Firefox/geckodriver (ref :190)
+    "net::ERR_",             # Chrome/CDP network errors (stealth-chrome
+    #                          transport: net::ERR_CONNECTION_RESET etc. —
+    #                          without these the circuit breaker is blind
+    #                          on the Chrome substrate)
+    "ERR_HTTP2_PROTOCOL_ERROR",
+)
 
 
 class PauseController:
@@ -214,11 +222,18 @@ class ScraperEngine:
         cs, cf = self.stats.get_cumulative_stats()
         total = cs + cf + already
         progress = (total / initial_total * 100) if initial_total else 0.0
-        return (
+        line = (
             f"Threads: {self.cfg.max_threads} | Requests: {rate:.2f}/s | "
             f"Last {int(self.cfg.stats_time_window)} s: {s} Success, {f} Fail | "
             f"Count: {total} | Progress: {progress:.4f}%"
         )  # format ref :236-242
+        # Surface the circuit-break countdown so an operator can tell a
+        # rate-limit pause from a stall (ref :244-249 renders this as a
+        # per-second "resuming in N s" ticker).
+        pause_left = self.pause.remaining()
+        if pause_left > 0:
+            line += f" | PAUSED: rate limit, resuming in {pause_left:.0f} s"
+        return line
 
     # -- run ---------------------------------------------------------------
 

@@ -167,3 +167,46 @@ def test_run_enrich_ledger_resume_and_cooldowns(tmp_path, monkeypatch):
     rc = run_enrich(cfg, session=sess2, sleep=lambda s: None,
                     rng=random.Random(1), symbols=symbols)
     assert rc == 0 and sess2.queries == []
+
+
+def test_run_crypto_enrich_writes_crypto_artifact_tree(tmp_path, monkeypatch):
+    """The crypto flow (ref ticker_symbol_query.py:205-265 legacy; SURVEY §L4
+    artifact map) rides the same hardened client but writes info/crypto/
+    artifacts from the crypto symbol list, with its own progress ledger."""
+    import csv
+
+    from advanced_scrapper_tpu.pipeline.enrich import run_crypto_enrich
+
+    monkeypatch.chdir(tmp_path)
+    with open("crypto_list.csv", "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=["Symbol"])
+        w.writeheader()
+        w.writerows([{"Symbol": "BTC"}, {"Symbol": "ETH"}])
+    cfg = EnrichConfig(
+        hardened=True,
+        out_dir=str(tmp_path / "info" / "ticker"),  # must NOT be used
+        crypto_out_dir=str(tmp_path / "info" / "crypto"),
+        crypto_symbols_csv="crypto_list.csv",
+        crypto_progress_file="progress_crypto.json",
+    )
+    script = [
+        _resp(bindings=[_binding(idLabels="Bitcoin", ticker="BTC")]),
+        _resp(bindings=[]),
+        _resp(bindings=[]),
+        _resp(bindings=[_binding(idLabels="Ethereum", ticker="ETH")]),
+        _resp(bindings=[]),
+        _resp(bindings=[]),
+    ]
+    rc = run_crypto_enrich(
+        cfg, session=FakeSession(script), sleep=lambda s: None,
+        rng=random.Random(0),
+    )
+    assert rc == 0
+    assert sorted(os.listdir(tmp_path / "info" / "crypto")) == [
+        "BTC_info.json", "ETH_info.json",
+    ]
+    assert not os.path.exists(tmp_path / "info" / "ticker")
+    data = json.load(open(tmp_path / "info" / "crypto" / "BTC_info.json"))
+    assert data[0]["ticker"] == "BTC"
+    led = json.load(open("progress_crypto.json"))
+    assert sorted(led["processed"]) == ["BTC", "ETH"]

@@ -183,3 +183,29 @@ def test_mock_transport_error_does_not_trip_rate_limit(tmp_path):
     eng, _ = _engine({}, _cfg())
     s = eng.run(["https://x/missing.html"], ok, bad)
     assert s.failed == 1 and s.rate_limit_trips == 0
+
+
+def test_stats_line_shows_pause_countdown():
+    """Operator-visible circuit-break state (ref constant_rate_scrapper.py:
+    244-249): while the global pause is active the stats line carries the
+    resume countdown; once expired it reverts to the plain format."""
+    eng, _ = _engine({}, cfg=_cfg(max_threads=4))
+    assert "PAUSED" not in eng._stats_line(10, 0)
+    eng.pause.trigger(42.0)
+    line = eng._stats_line(10, 0)
+    assert "PAUSED: rate limit, resuming in" in line
+    assert "42 s" in line or "41 s" in line
+
+
+def test_chrome_network_fingerprints_trip_the_circuit():
+    """The rate-limit circuit breaker must fire on Chrome/CDP error strings
+    too, or the stealth-chrome substrate keeps hammering a limiting site."""
+    from advanced_scrapper_tpu.pipeline.scraper import _RATE_LIMIT_FINGERPRINTS
+
+    for msg in (
+        "Message: unknown error: net::ERR_CONNECTION_RESET",
+        "Message: unknown error: net::ERR_HTTP2_PROTOCOL_ERROR",
+        "Message: Reached error page: about:neterror?e=contentEncodingError",
+    ):
+        assert any(fp in msg for fp in _RATE_LIMIT_FINGERPRINTS), msg
+    assert not any(fp in "HTTP 404 for url" for fp in _RATE_LIMIT_FINGERPRINTS)

@@ -185,3 +185,72 @@ def test_fetch_scrolled_respects_max_scrolls(fake_selenium):
     d.heights = list(range(100, 10000, 100))  # never stabilises
     t.fetch_scrolled("https://x/topic", max_scrolls=4, sleep=lambda s: None)
     assert len([s for s in d.scripts if "scrollTo" in s]) == 4
+
+
+@pytest.fixture()
+def fake_uc(monkeypatch, fake_selenium):
+    """Install a minimal undetected_chromedriver module (the fake selenium
+    fixture supplies WebDriverWait for the shared fetch contract)."""
+    created: dict = {}
+
+    class ChromeOptions:
+        def __init__(self):
+            self.args: list[str] = []
+
+        def add_argument(self, a):
+            self.args.append(a)
+
+    def Chrome(options):
+        d = FakeDriver(options)
+        created["driver"] = d
+        return d
+
+    uc = types.ModuleType("undetected_chromedriver")
+    uc.ChromeOptions = ChromeOptions
+    uc.Chrome = Chrome
+    monkeypatch.setitem(sys.modules, "undetected_chromedriver", uc)
+    return created
+
+
+def test_stealth_chrome_same_fetch_contract(fake_uc):
+    from advanced_scrapper_tpu.net.transport import StealthChromeTransport
+
+    t = StealthChromeTransport(page_load_timeout=25.0)
+    d = fake_uc["driver"]
+    assert "--headless=new" in d.options.args
+    assert d.page_load_timeout == 25.0
+    d.ready_after = 2
+    html = t.fetch("https://x/a.html")
+    assert d.visited == ["https://x/a.html"] and "page0" in html
+    # scroll-until-stable rides the shared WebDriver contract
+    d.heights = [100, 300, 300]
+    t.fetch_scrolled("https://x/feed", sleep=lambda s: None)
+    assert any("scrollTo" in s for s in d.scripts)
+    t.close()
+    assert d.quit_called
+
+
+def test_stealth_chrome_selected_by_name(fake_uc):
+    from advanced_scrapper_tpu.net.transport import (
+        StealthChromeTransport,
+        make_transport,
+    )
+
+    t = make_transport("stealth-chrome", page_load_timeout=12.0)
+    assert isinstance(t, StealthChromeTransport)
+    assert fake_uc["driver"].page_load_timeout == 12.0
+
+
+def test_stealth_chrome_errors_wrap_as_fetch_error(fake_uc):
+    from advanced_scrapper_tpu.net.transport import FetchError, StealthChromeTransport
+
+    t = StealthChromeTransport()
+    fake_uc["driver"].raise_on_get = RuntimeError("ERR_CONNECTION_RESET")
+    with pytest.raises(FetchError, match="ERR_CONNECTION_RESET"):
+        t.fetch("https://x/blocked.html")
+
+
+def test_stealth_chrome_availability_probe(fake_uc):
+    from advanced_scrapper_tpu.net.transport import stealth_chrome_available
+
+    assert stealth_chrome_available() is True
