@@ -279,8 +279,65 @@ def _bench_matcher(n_articles: int) -> float:
     return n_articles / dt
 
 
+def _jax_or_cpu_fallback(timeout_s: float = 240.0):
+    """Initialise the jax backend under a watchdog.
+
+    On the tunneled dev chip, backend init can hang FOREVER when the
+    transport is down (interpreter startup and ``import jax`` still work —
+    only device discovery blocks).  Rather than leave the driver with no
+    bench record at all, a dead transport re-execs this script on a
+    scrubbed single-CPU environment and the JSON line carries
+    ``platform: cpu-fallback`` so the numbers are labeled, never silently
+    compared against TPU rounds.
+    """
+    if os.environ.get("ASTPU_BENCH_PLATFORM_FALLBACK"):
+        import jax
+
+        return jax, "cpu-fallback"
+    ready = threading.Event()
+    probe_error: list[BaseException] = []
+
+    def probe():
+        try:
+            import jax
+
+            jax.devices()
+        except BaseException as e:  # an ERROR is not a hang: fail fast below
+            probe_error.append(e)
+        finally:
+            ready.set()
+
+    threading.Thread(target=probe, daemon=True).start()
+    if ready.wait(timeout_s):
+        if probe_error:
+            raise probe_error[0]
+        import jax
+
+        return jax, jax.devices()[0].platform
+    import subprocess
+    import sys
+
+    sys.stderr.write(
+        f"bench: device backend init hung >{timeout_s:.0f}s (dead tunnel?); "
+        "re-running on CPU with platform=cpu-fallback\n"
+    )
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+    from __graft_entry__ import virtual_mesh_env
+
+    env = virtual_mesh_env(dict(os.environ), 1)
+    env["ASTPU_BENCH_PLATFORM_FALLBACK"] = "1"
+    raise SystemExit(
+        subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            timeout=3600,  # a CPU full run is slow but bounded; never hang
+        ).returncode
+    )
+
+
 def main() -> None:
-    import jax
+    jax, platform = _jax_or_cpu_fallback()
 
     from advanced_scrapper_tpu.core.hashing import make_params
     from advanced_scrapper_tpu.core.mesh import build_mesh
@@ -307,6 +364,7 @@ def main() -> None:
         json.dumps(
             {
                 "metric": "minhash_lsh_dedup_articles_per_sec",
+                "platform": platform,
                 "value": round(uniform, 1),
                 "unit": "articles/s",
                 "vs_baseline": round(uniform / 50000.0, 4),

@@ -86,3 +86,23 @@ def test_exact_hasher_rejects_pathological_blob_loudly():
     doc = b"x" * (MAX_DOC_LEN + 1)
     with pytest.raises(ValueError, match="MAX_DOC_LEN"):
         ExactHasher().hash_docs([doc])
+
+
+def test_dedup_reps_async_streaming_matches_sync():
+    """The firehose API must produce exactly the sync results when several
+    corpora are in flight concurrently (bench.py's ragged regime)."""
+    import numpy as np
+
+    def corpus(seed):
+        r = np.random.RandomState(seed)
+        docs = [r.randint(32, 127, size=int(n), dtype=np.uint8).tobytes()
+                for n in r.randint(100, 5000, size=24)]
+        docs[7] = docs[3]                         # exact dup
+        docs[11] = docs[5][:-20] + b"x" * 20      # near dup
+        return docs
+
+    eng = NearDupEngine()
+    corpora = [corpus(s) for s in (1, 2, 3)]
+    async_reps = [eng.dedup_reps_async(c) for c in corpora]  # all in flight
+    for c, r in zip(corpora, async_reps):
+        assert (np.asarray(r)[: len(c)] == eng.dedup_reps(c)).all()
