@@ -58,6 +58,13 @@ def _load() -> ctypes.CDLL | None:
             fn.argtypes = [
                 ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
             ]
+        for name in ("fm_partial_ratio_cutoff", "fm_partial_ratio_cutoff_u32"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_double
+            fn.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                ctypes.c_double,
+            ]
         _lib = lib
         BACKEND = "native"
         return lib
@@ -67,17 +74,21 @@ def _enc(s: str | bytes) -> bytes:
     return s if isinstance(s, bytes) else s.encode("utf-8", "replace")
 
 
-def _call(byte_fn, u32_fn, py_fn, s1: str | bytes, s2: str | bytes) -> float:
+def _call(
+    byte_fn, u32_fn, py_fn, s1: str | bytes, s2: str | bytes, *extra
+) -> float:
     """Dispatch: bytes/ASCII → byte kernel; non-ASCII str → UTF-32 kernel
     (rapidfuzz scores code points, not bytes — byte-level scoring diverges
-    on curly quotes/accents/CJK); no compiler → pure-Python oracle."""
+    on curly quotes/accents/CJK); no compiler → pure-Python oracle.
+    ``extra`` args (e.g. a score cutoff) forward to every backend, so the
+    routing rules live here once for all entry points."""
     lib = _load()
     if lib is None:
         from advanced_scrapper_tpu.cpu import fuzz
 
         a = s1.decode("utf-8", "replace") if isinstance(s1, bytes) else s1
         b = s2.decode("utf-8", "replace") if isinstance(s2, bytes) else s2
-        return py_fn(fuzz, a, b)
+        return py_fn(fuzz, a, b, *extra)
     if isinstance(s1, str) and isinstance(s2, str) and not (
         s1.isascii() and s2.isascii()
     ):
@@ -85,9 +96,9 @@ def _call(byte_fn, u32_fn, py_fn, s1: str | bytes, s2: str | bytes) -> float:
         # scores raw ord() values, and strict utf-32 would raise on them
         a32 = s1.encode("utf-32-le", "surrogatepass")
         b32 = s2.encode("utf-32-le", "surrogatepass")
-        return getattr(lib, u32_fn)(a32, len(s1), b32, len(s2))
+        return getattr(lib, u32_fn)(a32, len(s1), b32, len(s2), *extra)
     a, b = _enc(s1), _enc(s2)
-    return getattr(lib, byte_fn)(a, len(a), b, len(b))
+    return getattr(lib, byte_fn)(a, len(a), b, len(b), *extra)
 
 
 def ratio(s1: str | bytes, s2: str | bytes) -> float:
@@ -98,4 +109,22 @@ def partial_ratio(s1: str | bytes, s2: str | bytes) -> float:
     return _call(
         "fm_partial_ratio", "fm_partial_ratio_u32",
         lambda f, a, b: f.partial_ratio(a, b), s1, s2,
+    )
+
+
+def partial_ratio_cutoff(s1: str | bytes, s2: str | bytes, cutoff: float) -> float:
+    """rapidfuzz ``score_cutoff`` semantics: the exact partial_ratio when it
+    reaches ``cutoff``, else 0.0.  The native kernel skips windows whose
+    sliding character-multiset bound cannot reach the cutoff — at the
+    matcher's >95 verify this is ~10-50× the full scan on non-matching
+    (name, article) pairs, with fuzzed parity vs
+    ``rapidfuzz.fuzz.partial_ratio(score_cutoff=...)``."""
+
+    def py_fallback(f, a, b, c):
+        score = f.partial_ratio(a, b)
+        return score if score >= c else 0.0
+
+    return _call(
+        "fm_partial_ratio_cutoff", "fm_partial_ratio_cutoff_u32",
+        py_fallback, s1, s2, cutoff,
     )

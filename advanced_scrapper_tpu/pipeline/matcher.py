@@ -282,12 +282,17 @@ def match_article(
         else:
             # the score is the decision; positions recorded even if empty
             # (ref :174-180)
+            # cutoff variant: identical >threshold decision, but windows the
+            # multiset bound proves sub-threshold skip the LCS entirely
             text_possible = text_pruned is None or j not in text_pruned
-            if text_possible and native.partial_ratio(text, e.name) > threshold:
+            if (
+                text_possible
+                and native.partial_ratio_cutoff(text, e.name, threshold) > threshold
+            ):
                 slot(e.ticker)["text"][e.name] = _find_positions_literal_fallback(
                     e.name, text
                 )
-            if native.partial_ratio(title, e.name) > threshold:
+            if native.partial_ratio_cutoff(title, e.name, threshold) > threshold:
                 slot(e.ticker)["title"][e.name] = _find_positions_literal_fallback(
                     e.name, title
                 )

@@ -202,13 +202,13 @@ def test_matcher_refine_skips_host_scoring_without_changing_output(monkeypatch):
     df = pd.DataFrame(rows)
 
     calls = {"n": 0}
-    real = native.partial_ratio
+    real = native.partial_ratio_cutoff
 
-    def counting(text, name):
+    def counting(text, name, cutoff):
         calls["n"] += 1
-        return real(text, name)
+        return real(text, name, cutoff)
 
-    monkeypatch.setattr(M.native, "partial_ratio", counting)
+    monkeypatch.setattr(M.native, "partial_ratio_cutoff", counting)
 
     calls["n"] = 0
     refined = M.match_chunk(df, idx, use_screen=True, use_refine=True)

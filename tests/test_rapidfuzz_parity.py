@@ -160,3 +160,33 @@ def test_entity_index_skips_empty_names():
         {"TST": {"label": {"": (None, None), "Acme Corp": (None, None)}}}
     )
     assert [e.name for e in idx.entries] == ["Acme Corp"]
+
+
+def test_partial_ratio_cutoff_parity_fuzzed():
+    """fm_partial_ratio_cutoff must equal rapidfuzz
+    fuzz.partial_ratio(score_cutoff=c) exactly: the exact score when it
+    reaches the cutoff, 0.0 below — including at the boundary, on unicode,
+    and on the equal-length bidirectional rule."""
+    import numpy as np
+    from rapidfuzz import fuzz as rf
+
+    from advanced_scrapper_tpu.cpu import native
+
+    rng = np.random.RandomState(17)
+    alpha = "abcdefgh çé—汉"
+    cases = []
+    for _ in range(300):
+        m = int(rng.randint(0, 20))
+        n = int(rng.randint(0, 200))
+        s1 = "".join(alpha[i] for i in rng.randint(0, len(alpha), m))
+        s2 = "".join(alpha[i] for i in rng.randint(0, len(alpha), n))
+        if rng.rand() < 0.3 and m > 0 and n >= m:  # plant the needle
+            p = int(rng.randint(0, n - m + 1))
+            s2 = s2[:p] + s1 + s2[p + m:]
+        cases.append((s1, s2))
+    cases += [("", ""), ("", "x"), ("abc", "abc"), ("abcd", "dcba")]
+    for cutoff in (0.0, 50.0, 90.0, 95.0, 100.0):
+        for s1, s2 in cases:
+            want = rf.partial_ratio(s1, s2, score_cutoff=cutoff)
+            got = native.partial_ratio_cutoff(s1, s2, cutoff)
+            assert abs(got - want) < 1e-9, (s1, s2, cutoff, got, want)
