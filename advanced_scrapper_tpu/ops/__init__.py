@@ -1,12 +1,17 @@
 from advanced_scrapper_tpu.ops.shingle import fmix32, shingle_hash
 from advanced_scrapper_tpu.ops.minhash import (
-    minhash_signatures,
+    accumulate_block_signatures,
     combine_block_signatures,
+    minhash_signatures,
 )
 from advanced_scrapper_tpu.ops.lsh import (
     band_keys,
-    duplicate_reps,
     bucket_histogram,
+    candidate_keys,
+    duplicate_rep_bands,
+    duplicate_reps,
+    resolve_rep_bands,
+    resolve_reps,
 )
 from advanced_scrapper_tpu.ops.exact import row_hash128
 
@@ -15,8 +20,13 @@ __all__ = [
     "shingle_hash",
     "minhash_signatures",
     "combine_block_signatures",
+    "accumulate_block_signatures",
     "band_keys",
+    "candidate_keys",
     "duplicate_reps",
+    "duplicate_rep_bands",
+    "resolve_reps",
+    "resolve_rep_bands",
     "bucket_histogram",
     "row_hash128",
 ]
