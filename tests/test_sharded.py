@@ -92,3 +92,26 @@ def test_mesh_validation():
         build_mesh(3, 2)  # 6 != 8
     with pytest.raises(ValueError):
         build_mesh(-1, 3)  # 3 does not divide 8
+
+
+def test_sharded_resolution_matches_certified_engine(mesh8):
+    """The streamed/sharded step must cluster exactly like the certified
+    batch engine — same candidate construction (candidate_keys) and same
+    verified-edge connected-components resolution — including near-dup
+    pairs at moderate similarity, not just exact copies."""
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    rng = np.random.RandomState(9)
+    texts = _random_corpus(64, 200, seed=9)
+    texts[10] = texts[4]                              # exact dup
+    texts[21] = texts[7][:-30] + bytes(rng.randint(32, 127, 30, dtype=np.uint8))
+    # ~J 0.63: BELOW the 0.70 threshold — a negative control that must
+    # stay unmerged in both paths
+    texts[33] = texts[7][:-45] + bytes(rng.randint(32, 127, 45, dtype=np.uint8))
+    tok, ln = encode_batch(texts, block_len=256)
+    t, l = shard_batch(tok, ln, mesh8)
+    rep_sharded, _ = make_sharded_dedup(mesh8, PARAMS)(t, l)
+    rep_engine = NearDupEngine().dedup_reps(texts)
+    np.testing.assert_array_equal(np.asarray(rep_sharded), rep_engine)
+    assert rep_engine[10] == 4 and rep_engine[21] == 7  # merges happened
+    assert rep_engine[33] == 33  # negative control stayed unmerged
