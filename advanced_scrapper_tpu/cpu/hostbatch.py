@@ -431,6 +431,8 @@ class HostBatcher:
                     # per-document push_blocking semantics)
                     deadline = time.monotonic() + timeout_s
                 if batch:
+                    if self.closed():
+                        return n  # nobody will accept the rest — stop now
                     if time.monotonic() >= deadline:
                         return n
                     time.sleep(0.005)

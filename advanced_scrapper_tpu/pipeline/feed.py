@@ -53,6 +53,7 @@ class DeviceFeed:
         self.sharding = sharding
         self.poll_timeout_ms = poll_timeout_ms
         self._out: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._error: BaseException | None = None
         self._jax = jax
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
@@ -66,25 +67,37 @@ class DeviceFeed:
         tok_spec = len_spec = None
         if self.sharding is not None:
             tok_spec, len_spec = self.sharding
-        while True:
-            n, tok, lens, tags = self.batcher.pop_batch(
-                self.batch_size, timeout_ms=self.poll_timeout_ms
-            )
-            if n == 0:
-                # 0 rows = timeout (retry) or closed-and-drained (done);
-                # close() is one-way so this check is race-free.
-                if self.batcher.closed() and self.batcher.size() == 0:
-                    break
-                continue
-            t_dev = self._put_device(tok, tok_spec)
-            l_dev = self._put_device(lens, len_spec)
-            self._out.put((n, t_dev, l_dev, tags))
-        self._out.put(None)  # sentinel
+        try:
+            while True:
+                n, tok, lens, tags = self.batcher.pop_batch(
+                    self.batch_size, timeout_ms=self.poll_timeout_ms
+                )
+                if n == 0:
+                    # 0 rows = timeout (retry) or closed-and-drained (done);
+                    # close() is one-way so this check is race-free.
+                    if self.batcher.closed() and self.batcher.size() == 0:
+                        break
+                    continue
+                t_dev = self._put_device(tok, tok_spec)
+                l_dev = self._put_device(lens, len_spec)
+                self._out.put((n, t_dev, l_dev, tags))
+        except BaseException as e:  # a dying feed thread must not hang the
+            self._error = e         # consumer: deliver the error, then the
+        finally:                    # sentinel, and re-raise at the iterator
+            self._out.put(None)
 
     def __iter__(self) -> Iterator[tuple[int, object, object, np.ndarray]]:
         while True:
             item = self._out.get()
             if item is None:
+                # re-plant the sentinel so termination is idempotent — a
+                # caller that catches the error (or re-iterates an
+                # exhausted feed) must terminate again, not block forever
+                self._out.put(None)
+                if self._error is not None:
+                    raise RuntimeError(
+                        "DeviceFeed worker died mid-stream"
+                    ) from self._error
                 return
             yield item
 
@@ -144,22 +157,29 @@ def stream_signatures(
     # computes (the D2H path is the narrow link on tunneled devices — see
     # .claude/skills/verify/SKILL.md).
     pending = None  # (tags, n, sig_dev, keys_dev)
-    for n, tok_dev, len_dev, tags in feed:
-        sig = minhash_signatures(tok_dev, len_dev, params)
-        keys = band_keys(sig, salt_j)
-        if sig_bits == 16:
-            sig = (sig & jnp.uint32(0xFFFF)).astype(jnp.uint16)
-        for arr in (sig, keys):
-            try:
-                arr.copy_to_host_async()
-            except AttributeError:
-                pass
+    try:
+        for n, tok_dev, len_dev, tags in feed:
+            sig = minhash_signatures(tok_dev, len_dev, params)
+            keys = band_keys(sig, salt_j)
+            if sig_bits == 16:
+                sig = (sig & jnp.uint32(0xFFFF)).astype(jnp.uint16)
+            for arr in (sig, keys):
+                try:
+                    arr.copy_to_host_async()
+                except AttributeError:
+                    pass
+            if pending is not None:
+                ptags, pn, psig, pkeys = pending
+                yield ptags[:pn], np.asarray(psig)[:pn], np.asarray(pkeys)[:pn]
+            pending = (tags, n, sig, keys)
         if pending is not None:
             ptags, pn, psig, pkeys = pending
             yield ptags[:pn], np.asarray(psig)[:pn], np.asarray(pkeys)[:pn]
-        pending = (tags, n, sig, keys)
-    if pending is not None:
-        ptags, pn, psig, pkeys = pending
-        yield ptags[:pn], np.asarray(psig)[:pn], np.asarray(pkeys)[:pn]
-    producer.join(timeout=30)
-    feed.join()
+    finally:
+        # on any exit — exhaustion, a dead feed worker, or the consumer
+        # abandoning the generator — stop the producer promptly: a closed
+        # batcher rejects further pushes, so feed() returns instead of
+        # buffering the rest of `docs` into an undrained arena
+        batcher.close()
+        producer.join(timeout=30)
+        feed.join()

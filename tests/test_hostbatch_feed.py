@@ -201,3 +201,63 @@ def test_push_many_accepts_sized_unsliceable_tags(batcher_factory):
     got, _, _, tags = b.pop_batch(5, timeout_ms=100)
     assert got == 5
     assert set(tags.tolist()) == {10, 11, 12, 20, 21}
+
+
+def test_device_feed_worker_death_raises_instead_of_hanging():
+    """A feed thread that dies mid-stream (e.g. the device transport
+    dropping) must surface its error at the iterator — never leave the
+    consumer blocked forever on a sentinel that will not arrive."""
+    import pytest
+
+    from advanced_scrapper_tpu.pipeline.feed import DeviceFeed
+
+    class ExplodingBatcher:
+        def pop_batch(self, batch, timeout_ms=-1):
+            raise RuntimeError("transport dropped")
+
+        def closed(self):
+            return False
+
+        def size(self):
+            return 1
+
+    feed = DeviceFeed(ExplodingBatcher(), batch_size=4)
+    with pytest.raises(RuntimeError, match="died mid-stream"):
+        for _ in feed:
+            pass
+    feed.join(timeout=5)
+
+
+def test_stream_signatures_consumer_error_stops_producer_promptly():
+    """If the device side of stream_signatures dies, the producer must stop
+    instead of buffering the rest of an unbounded docs iterable."""
+    import itertools
+    import time as _time
+
+    from advanced_scrapper_tpu.pipeline import feed as feed_mod
+
+    pulled = {"n": 0}
+
+    def docs():
+        for i in itertools.count():
+            pulled["n"] += 1
+            yield b"doc %d" % i
+
+    gen = feed_mod.stream_signatures(docs(), batch_size=8, block=64)
+    next(gen)          # stream is live
+    gen.close()        # consumer abandons the generator
+    _time.sleep(0.3)   # producer must notice the closed batcher and stop
+    before = pulled["n"]
+    _time.sleep(0.3)
+    assert pulled["n"] == before, "producer kept consuming after close"
+
+
+def test_feed_returns_promptly_on_closed_batcher():
+    from advanced_scrapper_tpu.cpu.hostbatch import HostBatcher
+
+    b = HostBatcher(64, max_docs=4)
+    b.close()
+    t0 = __import__("time").monotonic()
+    n = b.feed([b"a"] * 100, timeout_s=60.0)
+    assert n == 0
+    assert __import__("time").monotonic() - t0 < 5.0
