@@ -10,8 +10,10 @@ Re-implements the reference's CSV maintenance trio (``experiental/drop.py``,
   reference's manual multi-machine data parallelism;
 - :func:`new_links` — write the anti-join result to a new CSV.
 
-Membership checks run through :class:`pipeline.dedup.ExactDedup`'s
-byte-identical guarantee when deduping within the list itself.
+Membership checks are host-side set lookups (the done-URL sets are read
+via ``storage.csvio.scraped_url_set``); corpus-internal dedup of article
+bodies lives in :class:`pipeline.dedup.ExactDedup`, which these utilities
+do NOT route through.
 """
 
 from __future__ import annotations
