@@ -114,6 +114,108 @@ class TpuBatchBackend:
         self._kept_sigs: list[np.ndarray] = []
         self._kept_keys: list[str] = []
 
+    # -- checkpoint/resume -------------------------------------------------
+
+    def _config_fingerprint(self) -> np.ndarray:
+        cfg = self.cfg
+        return np.array(
+            [cfg.num_perm, cfg.num_bands, cfg.shingle_k, cfg.seed,
+             cfg.cand_subbands, 1 if self._bloom_mode else 0,
+             # bloom geometry: num_hashes changes _positions() without
+             # changing any array shape — a mismatch would corrupt
+             # membership silently, so it must break the fingerprint
+             cfg.bloom_bits, cfg.bloom_hashes],
+            dtype=np.int64,
+        )
+
+    def save_index(self, path: str) -> None:
+        """Persist the cross-batch stream-index state (npz).
+
+        The reference resumes every long job from its artifacts (SURVEY
+        §5.4: CSV anti-join, shard files, ledger, ``is_scraped``); the
+        streaming dedup index is the one piece of long-lived state those
+        artifacts cannot rebuild cheaply — without it a restarted scraper
+        re-admits near-dups of everything already streamed.  Exact mode
+        stores keys + kept signatures (band buckets are a deterministic
+        function of the signatures and are rebuilt on load); bloom mode
+        stores the filter bit-planes.
+        """
+        if self._buffer:
+            raise ValueError(
+                "flush() before save_index(): buffered records would be lost"
+            )
+        state: dict = {
+            "fingerprint": self._config_fingerprint(),
+            "stats": np.array(
+                [self.stats.submitted, self.stats.batches, self.stats.exact_dups,
+                 self.stats.near_dups, self.stats.kept], dtype=np.int64,
+            ),
+        }
+        if self._bloom_mode:
+            for name, idx in (("bloom", self._bloom), ("bloom_urls", self._bloom_urls)):
+                for k, v in idx.state().items():
+                    state[f"{name}_{k}"] = v
+        else:
+            state["seen_keys"] = np.array(sorted(self._seen_keys), dtype="U")
+            state["kept_keys"] = np.array(self._kept_keys, dtype="U")
+            state["kept_sigs"] = (
+                np.stack(self._kept_sigs)
+                if self._kept_sigs
+                else np.zeros((0, self.params.num_perm), np.uint32)
+            )
+        # atomic replace: a crash mid-write must never leave a truncated
+        # checkpoint where the resume artifact used to be
+        import os
+
+        tmp = f"{path}.tmp-{os.getpid()}"
+        try:
+            np.savez_compressed(tmp, **state)
+            # savez appends .npz when missing — normalise before replacing
+            written = tmp if os.path.exists(tmp) else f"{tmp}.npz"
+            os.replace(written, path)
+        finally:
+            for leftover in (tmp, f"{tmp}.npz"):
+                if os.path.exists(leftover):
+                    os.unlink(leftover)
+
+    def load_index(self, path: str) -> None:
+        """Inverse of :meth:`save_index`; the backend must be configured
+        identically (enforced via a config fingerprint — a mismatched
+        num_perm/banding/seed would corrupt membership silently)."""
+        with np.load(path) as data:
+            if not np.array_equal(data["fingerprint"], self._config_fingerprint()):
+                raise ValueError(
+                    f"stream-index checkpoint {path} was written under a "
+                    "different dedup config (num_perm/bands/k/seed/subbands/"
+                    "stream_index/bloom geometry); refusing to resume against it"
+                )
+            s = data["stats"]
+            self.stats = BatchStats(*(int(x) for x in s))
+            if self._bloom_mode:
+                for name, idx in (
+                    ("bloom", self._bloom), ("bloom_urls", self._bloom_urls)
+                ):
+                    idx.restore(
+                        data[f"{name}_words"],
+                        int(data[f"{name}_inserted"]),
+                        int(data[f"{name}_key_bits"]),
+                    )
+                return
+            self._seen_keys = set(data["seen_keys"].tolist())
+            self._kept_keys = [str(k) for k in data["kept_keys"].tolist()]
+            sigs = data["kept_sigs"]
+            self._kept_sigs = [sigs[i].copy() for i in range(sigs.shape[0])]
+        # buckets are a pure function of the kept signatures: recompute the
+        # same candidate keys the insertion path used, first-seen wins
+        self._buckets = {}
+        if sigs.shape[0]:
+            keys = np.asarray(
+                candidate_keys(sigs, self.params.band_salt, self.cfg.cand_subbands)
+            )
+            for i in range(keys.shape[0]):
+                for b in range(keys.shape[1]):
+                    self._buckets.setdefault((b, int(keys[i, b])), i)
+
     # -- submission --------------------------------------------------------
 
     def submit(self, record: dict) -> list[dict]:

@@ -438,6 +438,11 @@ def run_scraper(
                 }
             ),
         )
+        # the fifth resume artifact: without the stream index a restarted
+        # run re-admits near-dups of everything already annotated
+        index_ckpt = os.path.join(cfg.out_dir, f"stream_index_{cfg.website}.npz")
+        if os.path.exists(index_ckpt):
+            backend.load_index(index_ckpt)
         on_success = backend.submit
 
     console = ConsoleMux().start()
@@ -458,11 +463,19 @@ def run_scraper(
             show_stats=show_stats,
         )
     finally:
-        if backend is not None:
-            backend.flush()
-        if ann_csv is not None:
-            ann_csv.close()
-        console.stop()
+        # nested so a failing flush/save (disk full, ...) can neither mask
+        # the run's own exception with a half-cleaned console nor skip
+        # closing the annotation CSV
+        try:
+            if backend is not None:
+                backend.flush()
+                backend.save_index(index_ckpt)
+        finally:
+            try:
+                if ann_csv is not None:
+                    ann_csv.close()
+            finally:
+                console.stop()
     print(
         f"\nScraping completed: {summary.succeeded} success, "
         f"{summary.failed} failed, {summary.rate_limited_skipped} rate-limited, "

@@ -205,6 +205,29 @@ class BloomBandIndex:
         np.bitwise_or(self._words, other._words, out=self._words)
         self.inserted += other.inserted
 
+    def state(self) -> dict:
+        """Arrays/scalars that fully reconstruct membership — for
+        checkpointing the stream index across process restarts."""
+        return {
+            "words": self._words,
+            "inserted": np.int64(self.inserted),
+            "key_bits": np.int64(self.key_bits if self.key_bits is not None else -1),
+        }
+
+    def restore(self, words: np.ndarray, inserted: int, key_bits: int) -> None:
+        """Inverse of :meth:`state`; the index must be constructed with the
+        same (num_bands, bits, num_hashes, seed) — hash positions depend on
+        all four, so mismatched params would corrupt membership silently."""
+        if words.shape != self._words.shape or words.dtype != np.uint64:
+            raise ValueError(
+                f"checkpoint shape {words.shape}/{words.dtype} does not match "
+                f"this index ({self._words.shape}); was it saved with the "
+                "same bits/num_bands config?"
+            )
+        self._words[...] = words
+        self.inserted = int(inserted)
+        self.key_bits = None if int(key_bits) < 0 else int(key_bits)
+
     @property
     def memory_bytes(self) -> int:
         return self._words.nbytes

@@ -81,3 +81,69 @@ def test_keyless_records_never_become_dup_targets():
     # and the keyed record IS registered as a future target
     out2 = be.submit(_rec("later", text)) + be.submit(_rec("x", "unrelated totally different body"))
     assert out2[0]["near_dup_of"] == "real"
+
+
+def test_stream_index_checkpoint_roundtrip_exact(tmp_path):
+    """A restarted backend resumed from a checkpoint must keep annotating
+    dups against everything the dead process already streamed — the one
+    piece of resume state (SURVEY §5.4) CSVs cannot rebuild cheaply."""
+    cfg = DedupConfig(batch_size=2, block_len=512)
+    be = TpuBatchBackend(cfg)
+    rng = np.random.RandomState(3)
+    texts = [_corpus_text(rng) for _ in range(4)]
+    for i in range(4):
+        be.submit(_rec(f"u{i}", texts[i]))
+    ckpt = str(tmp_path / "stream_index.npz")
+    be.save_index(ckpt)
+
+    be2 = TpuBatchBackend(cfg)  # "restarted process"
+    be2.load_index(ckpt)
+    out = []
+    for rec in [
+        _rec("u1", texts[1]),                     # exact url dup from before
+        _rec("u9", texts[2]),                     # same text, new url → near dup
+        _rec("u8", _corpus_text(rng)),            # fresh
+        _rec("u7", _corpus_text(rng)),
+    ]:
+        out += be2.submit(rec)
+    assert out[0]["dup_of"] == "u1"
+    assert out[1]["near_dup_of"] == "u2"
+    assert out[2]["dup_of"] is None and out[2]["near_dup_of"] is None
+    assert be2.stats.submitted == 8  # carried over + new
+
+
+def test_stream_index_checkpoint_roundtrip_bloom(tmp_path):
+    cfg = DedupConfig(batch_size=2, block_len=512, stream_index="bloom",
+                      bloom_bits=1 << 16)
+    be = TpuBatchBackend(cfg)
+    rng = np.random.RandomState(5)
+    texts = [_corpus_text(rng) for _ in range(2)]
+    for i in range(2):
+        be.submit(_rec(f"u{i}", texts[i]))
+    ckpt = str(tmp_path / "bloom_index.npz")
+    be.save_index(ckpt)
+
+    be2 = TpuBatchBackend(cfg)
+    be2.load_index(ckpt)
+    out = []
+    for rec in [_rec("u0", texts[0]), _rec("u9", texts[1])]:
+        out += be2.submit(rec)
+    from advanced_scrapper_tpu.extractors.tpu_batch import BLOOM_SENTINEL
+
+    assert out[0]["dup_of"] == BLOOM_SENTINEL     # url membership survived
+    assert out[1]["near_dup_of"] == BLOOM_SENTINEL  # band membership survived
+
+
+def test_stream_index_checkpoint_guards(tmp_path):
+    import pytest
+
+    cfg = DedupConfig(batch_size=4, block_len=512)
+    be = TpuBatchBackend(cfg)
+    be.submit(_rec("u0", "x" * 300))  # buffered, unflushed
+    with pytest.raises(ValueError, match="flush"):
+        be.save_index(str(tmp_path / "x.npz"))
+    be.flush()
+    be.save_index(str(tmp_path / "x.npz"))
+    other = TpuBatchBackend(DedupConfig(batch_size=4, block_len=512, seed=2))
+    with pytest.raises(ValueError, match="different dedup config"):
+        other.load_index(str(tmp_path / "x.npz"))
