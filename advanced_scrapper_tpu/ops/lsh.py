@@ -210,7 +210,14 @@ def resolve_rep_bands(
         cand_sig = jnp.take(sig, rep_bands[:, c0 : c0 + 8], axis=0)
         agree = (sig[:, None, :] == cand_sig).mean(axis=2)
         ok_parts.append(agree >= threshold)
-    ok = jnp.concatenate(ok_parts, axis=1) & valid[:, None]
+    # an edge needs BOTH endpoints valid: invalid rows (padding, sub-k
+    # texts) must neither merge nor be merged into, structurally — not
+    # just because their all-U32_MAX signatures happen to disagree
+    ok = (
+        jnp.concatenate(ok_parts, axis=1)
+        & valid[:, None]
+        & jnp.take(valid, rep_bands)
+    )
     cand = jnp.where(ok, rep_bands, idx[:, None])  # self-edges are no-ops
     lab = idx
     for _ in range(jump_rounds):

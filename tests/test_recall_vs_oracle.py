@@ -181,3 +181,58 @@ def test_fast_oracle_bit_identical_to_slow_oracle():
     fast = oracle_signatures_fast(docs, PARAMS)
     assert slow.shape == fast.shape
     assert (slow == fast).all()
+
+
+def test_resolve_rep_bands_fuzzed_vs_union_find_oracle():
+    """Device CC resolution must equal a brute-force union-find over the
+    verified edge set on arbitrary candidate graphs — including invalid
+    rows, which structurally may neither merge nor be merged into."""
+    import jax.numpy as jnp
+
+    from advanced_scrapper_tpu.ops.lsh import resolve_rep_bands
+
+    def oracle_cc(rep_bands, sigs, valid, thr):
+        B, _ = rep_bands.shape
+        parent = list(range(B))
+
+        def find(i):
+            while parent[i] != i:
+                parent[i] = parent[parent[i]]
+                i = parent[i]
+            return i
+
+        for i in range(B):
+            if not valid[i]:
+                continue
+            for c in rep_bands[i]:
+                c = int(c)
+                if c == i or not valid[c]:
+                    continue
+                if (sigs[i] == sigs[c]).mean() >= thr:
+                    ra, rb = find(i), find(c)
+                    if ra != rb:
+                        parent[max(ra, rb)] = min(ra, rb)
+        return np.array([find(i) if valid[i] else i for i in range(B)])
+
+    rng = np.random.RandomState(123)
+    for _ in range(40):
+        B = int(rng.randint(3, 48))
+        nc = int(rng.randint(1, 7))
+        protos = rng.randint(0, 1 << 31, (max(2, B // 4), 128)).astype(np.uint32)
+        sigs = protos[rng.randint(0, protos.shape[0], B)].copy()
+        noise = rng.rand(B, 128) < rng.uniform(0, 0.5)
+        sigs[noise] = rng.randint(0, 1 << 31, int(noise.sum())).astype(np.uint32)
+        rep_bands = np.stack(
+            [rng.randint(0, i + 1, nc) for i in range(B)]
+        ).astype(np.int32)
+        valid = rng.rand(B) > 0.15
+        rep_bands[~valid] = np.arange(B, dtype=np.int32)[~valid, None]
+        thr = float(rng.choice([0.5, 0.7, 0.9]))
+        got = np.asarray(
+            resolve_rep_bands(
+                jnp.asarray(rep_bands), jnp.asarray(sigs), jnp.asarray(valid),
+                thr, jump_rounds=8,
+            )
+        )
+        want = oracle_cc(rep_bands, sigs, valid, thr)
+        assert (got == want).all()
