@@ -279,6 +279,44 @@ def _bench_matcher(n_articles: int) -> float:
     return n_articles / dt
 
 
+def _looks_like_transport_death(e: BaseException) -> bool:
+    """True for the tunneled chip's mid-run failure signatures.
+
+    The dev chip rides an HTTP tunnel that can die *between* dispatches
+    (observed 2026-07-30: ``JaxRuntimeError: UNAVAILABLE: …/remote_compile:
+    Connection refused`` 30 minutes into a run that initialised fine).
+    Init hangs are caught by the watchdog below; this classifies the
+    mid-run flavor so ``main`` can still deliver a labeled JSON line
+    instead of leaving the driver with no bench record for the round.
+    """
+    msg = str(e)
+    return type(e).__name__ == "JaxRuntimeError" and (
+        "UNAVAILABLE" in msg or "Connection" in msg or "transport" in msg
+    )
+
+
+def _reexec_cpu_fallback() -> None:
+    """Re-run this script on a scrubbed single-CPU env, labeled
+    ``platform: cpu-fallback`` (numbers never silently compared against
+    TPU rounds); exits with the child's return code."""
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+    from __graft_entry__ import virtual_mesh_env
+
+    env = virtual_mesh_env(dict(os.environ), 1)
+    env["ASTPU_BENCH_PLATFORM_FALLBACK"] = "1"
+    raise SystemExit(
+        subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            timeout=3600,  # a CPU full run is slow but bounded; never hang
+        ).returncode
+    )
+
+
 def _jax_or_cpu_fallback(timeout_s: float = 240.0):
     """Initialise the jax backend under a watchdog.
 
@@ -314,26 +352,13 @@ def _jax_or_cpu_fallback(timeout_s: float = 240.0):
         import jax
 
         return jax, jax.devices()[0].platform
-    import subprocess
     import sys
 
     sys.stderr.write(
         f"bench: device backend init hung >{timeout_s:.0f}s (dead tunnel?); "
         "re-running on CPU with platform=cpu-fallback\n"
     )
-    here = os.path.dirname(os.path.abspath(__file__))
-    sys.path.insert(0, here)
-    from __graft_entry__ import virtual_mesh_env
-
-    env = virtual_mesh_env(dict(os.environ), 1)
-    env["ASTPU_BENCH_PLATFORM_FALLBACK"] = "1"
-    raise SystemExit(
-        subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            env=env,
-            timeout=3600,  # a CPU full run is slow but bounded; never hang
-        ).returncode
-    )
+    _reexec_cpu_fallback()
 
 
 def main() -> None:
@@ -343,8 +368,6 @@ def main() -> None:
     from advanced_scrapper_tpu.core.mesh import build_mesh
 
     params = make_params()
-    n_dev = len(jax.devices())
-    mesh = build_mesh(n_dev, 1)
     # scan is the measured-fastest backend on v5e (oph: sort-bound, ~16×
     # slower; pallas: relayout-bound — see ops/oph.py, ops/pallas_minhash.py)
     backend = os.environ.get("ASTPU_BENCH_BACKEND", "scan")
@@ -353,12 +376,30 @@ def main() -> None:
     batch = 4096 if quick else 65536  # 65536: ~15% over 32768 on v5e (2026-07)
     block = 1024   # bytes/article (typical short news article body)
 
-    uniform = _bench_uniform(jax, mesh, params, backend, batch, block)
-    ragged = _bench_ragged(1024 if quick else 8192)
-    stream = _bench_stream(jax, mesh, params, backend, batch, block, 2 if quick else 4)
-    recall, recall_pairs = _bench_recall(64 if quick else 512)
-    exact, exact_vs_pandas = _bench_exact(16384 if quick else 262144)
-    matcher = _bench_matcher(256 if quick else 1024)
+    try:
+        # device enumeration + mesh build dispatch against the tunnel too —
+        # they must sit inside the death handler, not ahead of it
+        mesh = build_mesh(len(jax.devices()), 1)
+        uniform = _bench_uniform(jax, mesh, params, backend, batch, block)
+        ragged = _bench_ragged(1024 if quick else 8192)
+        stream = _bench_stream(jax, mesh, params, backend, batch, block, 2 if quick else 4)
+        recall, recall_pairs = _bench_recall(64 if quick else 512)
+        exact, exact_vs_pandas = _bench_exact(16384 if quick else 262144)
+        matcher = _bench_matcher(256 if quick else 1024)
+    except Exception as e:
+        # A tunnel that came up can still die between dispatches (it has).
+        # Better one labeled cpu-fallback line than no round record at all.
+        if _looks_like_transport_death(e) and not os.environ.get(
+            "ASTPU_BENCH_PLATFORM_FALLBACK"
+        ):
+            import sys
+
+            sys.stderr.write(
+                f"bench: device transport died mid-run ({type(e).__name__}: "
+                f"{e}); re-running on CPU with platform=cpu-fallback\n"
+            )
+            _reexec_cpu_fallback()
+        raise
 
     print(
         json.dumps(

@@ -1,0 +1,35 @@
+"""bench.py transport-death fallback contract.
+
+The tunneled dev chip's transport can die *between* dispatches (observed
+2026-07-30: ``JaxRuntimeError: UNAVAILABLE: …/remote_compile: Connection
+refused`` 30 minutes into a run whose backend initialised fine).  The
+bench must classify that flavor and re-exec as labeled ``cpu-fallback``
+rather than crash with no JSON record for the driver's round.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+
+def test_classifier_matches_observed_mid_run_signature():
+    import jax
+
+    e = jax.errors.JaxRuntimeError(
+        "UNAVAILABLE: http://127.0.0.1:8093/remote_compile: transport: "
+        "Connection Failed: Connect error: Connection refused (os error 111)"
+    )
+    assert bench._looks_like_transport_death(e)
+
+
+def test_classifier_ignores_ordinary_errors():
+    import jax
+
+    assert not bench._looks_like_transport_death(ValueError("UNAVAILABLE"))
+    assert not bench._looks_like_transport_death(
+        jax.errors.JaxRuntimeError("INVALID_ARGUMENT: shapes do not match")
+    )

@@ -225,8 +225,10 @@ def test_resolve_rep_bands_fuzzed_vs_union_find_oracle():
         rep_bands = np.stack(
             [rng.randint(0, i + 1, nc) for i in range(B)]
         ).astype(np.int32)
+        # invalid rows keep their random candidate lists: the source-side
+        # half of the both-endpoints guard (an invalid row may not merge
+        # OUT either) must be fuzzed, not neutralised before dispatch
         valid = rng.rand(B) > 0.15
-        rep_bands[~valid] = np.arange(B, dtype=np.int32)[~valid, None]
         thr = float(rng.choice([0.5, 0.7, 0.9]))
         got = np.asarray(
             resolve_rep_bands(
