@@ -200,24 +200,39 @@ class ExactDedup:
     def keep_indices(self, items: Sequence[str]) -> list[int]:
         if not items:
             return []
+        n = len(items)
         raw = [to_bytes(s) for s in items]
         block = bucket_len(max(1, min(max(len(r) for r in raw), self.max_len)))
         h = self.hasher.hash_docs(raw, block_len=block)  # uint32[N, 4]
-        first_by_hash: dict[bytes, list[int]] = {}
-        kept: list[int] = []
-        for i in range(len(items)):
-            key = h[i].tobytes()
-            group = first_by_hash.get(key)
+        # Group rows by their 128-bit hash with one C-speed lexsort instead
+        # of a per-row Python dict walk: rows whose hash is unique are kept
+        # outright, and only multi-member groups (true duplicates or 2⁻¹²⁸
+        # collisions) ever reach the Python string-confirm below.
+        hi = (h[:, 0].astype(np.uint64) << 32) | h[:, 1]
+        lo = (h[:, 2].astype(np.uint64) << 32) | h[:, 3]
+        order = np.lexsort((lo, hi))
+        shi, slo = hi[order], lo[order]
+        new_group = np.empty(n, bool)
+        new_group[0] = True
+        new_group[1:] = (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])
+        gid = np.empty(n, np.int64)
+        gid[order] = np.cumsum(new_group) - 1
+        counts = np.bincount(gid)
+        keep = counts[gid] == 1  # singleton hash ⇒ provably first-seen unique
+        multi_rows = np.flatnonzero(~keep)  # ascending ⇒ original order
+        groups: dict[int, list[int]] = {}
+        for i in multi_rows.tolist():
+            group = groups.get(gid[i])
             if group is None:
-                first_by_hash[key] = [i]
-                kept.append(i)
+                groups[gid[i]] = [i]  # first member of its hash group
+                keep[i] = True
             else:
-                # hash collision group: confirm a true string match
+                # hash-equal group: confirm a true string match
                 if any(items[j] == items[i] for j in group):
                     continue
                 group.append(i)
-                kept.append(i)
-        return kept
+                keep[i] = True
+        return np.flatnonzero(keep).tolist()
 
     def keep_mask(self, items: Sequence[str]) -> np.ndarray:
         mask = np.zeros(len(items), dtype=bool)

@@ -289,10 +289,17 @@ def _looks_like_transport_death(e: BaseException) -> bool:
     mid-run flavor so ``main`` can still deliver a labeled JSON line
     instead of leaving the driver with no bench record for the round.
     """
-    msg = str(e)
-    return type(e).__name__ == "JaxRuntimeError" and (
-        "UNAVAILABLE" in msg or "Connection" in msg or "transport" in msg
-    )
+    seen: set[int] = set()
+    cur: BaseException | None = e
+    while cur is not None and id(cur) not in seen:  # wrappers rewrap: walk
+        seen.add(id(cur))                           # the cause/context chain
+        msg = str(cur)
+        if type(cur).__name__ == "JaxRuntimeError" and (
+            "UNAVAILABLE" in msg or "Connection" in msg or "transport" in msg
+        ):
+            return True
+        cur = cur.__cause__ or cur.__context__
+    return False
 
 
 def _reexec_cpu_fallback() -> None:
@@ -348,6 +355,15 @@ def _jax_or_cpu_fallback(timeout_s: float = 240.0):
     threading.Thread(target=probe, daemon=True).start()
     if ready.wait(timeout_s):
         if probe_error:
+            if _looks_like_transport_death(probe_error[0]):
+                import sys
+
+                sys.stderr.write(
+                    f"bench: device backend init failed fast "
+                    f"({type(probe_error[0]).__name__}: {probe_error[0]}); "
+                    "re-running on CPU with platform=cpu-fallback\n"
+                )
+                _reexec_cpu_fallback()
             raise probe_error[0]
         import jax
 

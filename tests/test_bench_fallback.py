@@ -33,3 +33,29 @@ def test_classifier_ignores_ordinary_errors():
     assert not bench._looks_like_transport_death(
         jax.errors.JaxRuntimeError("INVALID_ARGUMENT: shapes do not match")
     )
+
+
+def test_classifier_walks_wrapper_chain():
+    """DeviceFeed rewraps a worker's death as a plain RuntimeError
+    (``pipeline/feed.py``); the classifier must see through the
+    cause/context chain or the stream regime's deaths escape fallback."""
+    import jax
+
+    inner = jax.errors.JaxRuntimeError("UNAVAILABLE: transport: Connection refused")
+    try:
+        raise RuntimeError("DeviceFeed worker died mid-stream") from inner
+    except RuntimeError as wrapped:
+        assert bench._looks_like_transport_death(wrapped)
+    # context (no explicit cause) is walked too
+    try:
+        try:
+            raise jax.errors.JaxRuntimeError("UNAVAILABLE: Connection refused")
+        except Exception:
+            raise RuntimeError("while prefetching batch 3")
+    except RuntimeError as ctx_wrapped:
+        assert bench._looks_like_transport_death(ctx_wrapped)
+    # a benign wrapper chain stays benign
+    try:
+        raise RuntimeError("outer") from ValueError("UNAVAILABLE")
+    except RuntimeError as benign:
+        assert not bench._looks_like_transport_death(benign)
