@@ -37,6 +37,8 @@ def _build() -> bool:
 
 def _load() -> ctypes.CDLL | None:
     global _lib, BACKEND
+    if BACKEND != "unloaded":  # hot path: no lock once resolved (set-once)
+        return _lib
     with _lock:
         if BACKEND != "unloaded":
             return _lib
@@ -65,6 +67,15 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
                 ctypes.c_double,
             ]
+        select = lib.fm_partial_ratio_cutoff_select
+        select.restype = None
+        select.argtypes = [
+            ctypes.c_char_p, ctypes.c_int,           # haystack
+            ctypes.c_char_p, ctypes.c_void_p,        # needle arena + offsets
+            ctypes.c_void_p,                         # lengths
+            ctypes.c_void_p, ctypes.c_int,           # select rows + count
+            ctypes.c_double, ctypes.c_void_p,        # cutoff + out scores
+        ]
         _lib = lib
         BACKEND = "native"
         return lib
@@ -128,3 +139,84 @@ def partial_ratio_cutoff(s1: str | bytes, s2: str | bytes, cutoff: float) -> flo
         "fm_partial_ratio_cutoff", "fm_partial_ratio_cutoff_u32",
         py_fallback, s1, s2, cutoff,
     )
+
+
+def partial_ratio_cutoff_many(
+    haystack: str | bytes, needles: list[str | bytes], cutoff: float
+):
+    """``float64[len(needles)]`` of :func:`partial_ratio_cutoff` scores of
+    one haystack against many needles in one native call.  One-shot
+    convenience over :class:`CutoffArena` (which repeated callers with a
+    fixed needle set should hold directly) so the ASCII/fallback routing
+    rules live in exactly one place."""
+    return CutoffArena(needles).scores(haystack, range(len(needles)), cutoff)
+
+
+class CutoffArena:
+    """Persistent packed-needle arena for repeated cutoff scoring.
+
+    Built once per fixed name set (an entity index); each call ships only
+    the selected row ids to the native kernel — no per-article re-encoding
+    or arena rebuild (the per-call overhead :func:`partial_ratio_cutoff_many`
+    still pays).  Non-ASCII names, non-ASCII haystacks, and no-compiler
+    hosts transparently take the per-pair route with identical scores.
+    """
+
+    def __init__(self, names: list[str | bytes]):
+        import numpy as np
+
+        self.names = list(names)
+        self._per_pair_rows = {
+            i for i, nd in enumerate(self.names)
+            if isinstance(nd, str) and not nd.isascii()
+        }
+        enc = [
+            b"" if i in self._per_pair_rows else _enc(nd)
+            for i, nd in enumerate(self.names)
+        ]
+        self._lengths = np.array([len(e) for e in enc], dtype=np.int32)
+        self._offsets = np.zeros(len(enc), dtype=np.int64)
+        if len(enc) > 1:
+            self._offsets[1:] = np.cumsum(self._lengths[:-1], dtype=np.int64)
+        self._arena = b"".join(enc)
+
+    def scores(self, haystack: str | bytes, rows, cutoff: float):
+        """``float64[len(rows)]`` — ``partial_ratio_cutoff(haystack,
+        names[r], cutoff)`` for each selected row ``r``."""
+        import numpy as np
+
+        rows = np.asarray(rows, dtype=np.int32)
+        out = np.zeros(len(rows), dtype=np.float64)
+        if len(rows) == 0:
+            return out
+        lib = _load()
+        hay_ascii = isinstance(haystack, bytes) or haystack.isascii()
+        if lib is None or not hay_ascii:
+            for i, r in enumerate(rows):
+                out[i] = partial_ratio_cutoff(haystack, self.names[r], cutoff)
+            return out
+        if self._per_pair_rows:
+            batch = np.array(
+                [r for r in rows if int(r) not in self._per_pair_rows],
+                dtype=np.int32,
+            )
+        else:
+            batch = rows
+        if len(batch):
+            hay = _enc(haystack)
+            scores = np.zeros(len(batch), dtype=np.float64)
+            lib.fm_partial_ratio_cutoff_select(
+                hay, len(hay), self._arena, self._offsets.ctypes.data,
+                self._lengths.ctypes.data, batch.ctypes.data, len(batch),
+                cutoff, scores.ctypes.data,
+            )
+            if len(batch) == len(rows):
+                return scores
+            by_row = dict(zip(batch.tolist(), scores.tolist()))
+            for i, r in enumerate(rows.tolist()):
+                if r in by_row:
+                    out[i] = by_row[r]
+        for i, r in enumerate(rows.tolist()):
+            if r in self._per_pair_rows:
+                out[i] = partial_ratio_cutoff(haystack, self.names[r], cutoff)
+        return out

@@ -338,4 +338,22 @@ void fm_partial_ratio_batch(
   }
 }
 
+// Batch with score_cutoff: ONE haystack (an article/title) against a
+// PERSISTENT packed needle arena (entity names, built once per index) with
+// a per-call int32 row selection — the matcher's verify shape.  One call
+// replaces a ctypes round trip (plus a fresh haystack encode) per name;
+// each pair scores exactly like fm_partial_ratio_cutoff (the impl's
+// shorter/longer swap makes argument order irrelevant).  scores[i]
+// corresponds to select[i] and must point at n_select doubles.
+void fm_partial_ratio_cutoff_select(
+    const uint8_t* hay, int hay_len,
+    const uint8_t* arena, const int64_t* offsets, const int32_t* lengths,
+    const int32_t* select, int n_select, double cutoff, double* scores) {
+  for (int i = 0; i < n_select; ++i) {
+    const int r = select[i];
+    scores[i] = fm_partial_ratio_cutoff(arena + offsets[r], lengths[r],
+                                        hay, hay_len, cutoff);
+  }
+}
+
 }  // extern "C"

@@ -213,6 +213,7 @@ class EntityIndex:
                     # pure-lowercase-alpha names are skipped (ref :174)
         self._tables: dict | None = None
         self._refine_tables: tuple | None = None
+        self._verify_arena = None
 
     @classmethod
     def from_info_dir(cls, folder: str) -> "EntityIndex":
@@ -226,6 +227,18 @@ class EntityIndex:
             fuzzy = np.array([not e.is_exact_upper for e in self.entries], bool)
             self._tables = prepare_names(names, fuzzy=fuzzy)
         return self._tables
+
+    def verify_arena(self):
+        """Packed-needle arena over all entry names (rows = entry index),
+        built lazily once per EntityIndex — the native verify scores
+        screened rows against it without per-article re-encoding.  Pool
+        workers rebuild the index from ``processed`` at init and each build
+        their own arena on first use; the parent's is never shipped."""
+        if self._verify_arena is None:
+            self._verify_arena = native.CutoffArena(
+                [e.name for e in self.entries]
+            )
+        return self._verify_arena
 
 
 # -- matching ----------------------------------------------------------------
@@ -265,11 +278,32 @@ def match_article(
     def slot(ticker: str) -> dict:
         return per_ticker.setdefault(ticker, {"text": {}, "title": {}})
 
+    # Pass 1: filter (screen mask + date window) and split by rule kind.
+    # Fuzzy scores batch into ONE native call per (article, side) —
+    # per-name calls re-encode the whole article and pay a ctypes round
+    # trip each (measured ~65 screened names/article); decisions and the
+    # j-ascending insert order below are identical to the per-name loop.
+    pending: list[tuple[int, object]] = []
+    text_rows: list[int] = []   # entry indices j to score against the text
+    title_rows: list[int] = []  # entry indices j to score against the title
     for j, e in enumerate(index.entries):
         if candidate_mask is not None and not candidate_mask[j]:
             continue
         if not is_within_period(article_date, e.start, e.end):
             continue
+        pending.append((j, e))
+        if not e.is_exact_upper:
+            # text side skipped when the device bound proved it ≤ threshold
+            if text_pruned is None or j not in text_pruned:
+                text_rows.append(j)
+            title_rows.append(j)
+
+    arena = index.verify_arena()
+    text_score = dict(zip(text_rows, arena.scores(text, text_rows, threshold)))
+    title_score = dict(zip(title_rows, arena.scores(title, title_rows, threshold)))
+
+    # Pass 2: apply the decisions in the original j order.
+    for j, e in pending:
         if e.is_exact_upper:
             # positions are the decision (ref :165-173)
             pattern = r"\b" + re.escape(e.name) + r"\b"
@@ -281,18 +315,12 @@ def match_article(
                 slot(e.ticker)["title"][e.name] = title_pos
         else:
             # the score is the decision; positions recorded even if empty
-            # (ref :174-180)
-            # cutoff variant: identical >threshold decision, but windows the
-            # multiset bound proves sub-threshold skip the LCS entirely
-            text_possible = text_pruned is None or j not in text_pruned
-            if (
-                text_possible
-                and native.partial_ratio_cutoff(text, e.name, threshold) > threshold
-            ):
+            # (ref :174-180); cutoff semantics: sub-threshold scores are 0
+            if text_score.get(j, 0.0) > threshold:
                 slot(e.ticker)["text"][e.name] = _find_positions_literal_fallback(
                     e.name, text
                 )
-            if native.partial_ratio_cutoff(title, e.name, threshold) > threshold:
+            if title_score.get(j, 0.0) > threshold:
                 slot(e.ticker)["title"][e.name] = _find_positions_literal_fallback(
                     e.name, title
                 )

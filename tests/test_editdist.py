@@ -201,14 +201,17 @@ def test_matcher_refine_skips_host_scoring_without_changing_output(monkeypatch):
         )
     df = pd.DataFrame(rows)
 
+    # count scored PAIRS through the arena verify entry (match_article
+    # makes one arena call per article side; each selected row is one
+    # host score)
     calls = {"n": 0}
-    real = native.partial_ratio_cutoff
+    real_scores = native.CutoffArena.scores
 
-    def counting(text, name, cutoff):
-        calls["n"] += 1
-        return real(text, name, cutoff)
+    def counting(self, haystack, rows, cutoff):
+        calls["n"] += len(rows)
+        return real_scores(self, haystack, rows, cutoff)
 
-    monkeypatch.setattr(M.native, "partial_ratio_cutoff", counting)
+    monkeypatch.setattr(M.native.CutoffArena, "scores", counting)
 
     calls["n"] = 0
     refined = M.match_chunk(df, idx, use_screen=True, use_refine=True)

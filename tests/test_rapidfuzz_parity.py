@@ -190,3 +190,66 @@ def test_partial_ratio_cutoff_parity_fuzzed():
             want = rf.partial_ratio(s1, s2, score_cutoff=cutoff)
             got = native.partial_ratio_cutoff(s1, s2, cutoff)
             assert abs(got - want) < 1e-9, (s1, s2, cutoff, got, want)
+
+
+def test_partial_ratio_cutoff_many_matches_per_pair():
+    """The arena-batched verify entry must score each (haystack, needle)
+    pair exactly like the per-pair call — including mixed ASCII/unicode
+    needles (which take the UTF-32 route inside the batch), a non-ASCII
+    haystack (whole batch falls back per-pair), and empty needles."""
+    import numpy as np
+
+    from advanced_scrapper_tpu.cpu import native
+
+    rng = np.random.RandomState(23)
+    alpha = "abcdefgh çé—汉"
+    needles = ["", "abc", "Tim Cook", "çé—", "汉abc汉", "Gadget7 Pro"] + [
+        "".join(alpha[i] for i in rng.randint(0, len(alpha), int(rng.randint(1, 15))))
+        for _ in range(40)
+    ]
+    for hay in (
+        "the quick brown fox says abc and Tim Cook spoke at çé length",
+        "pure ascii haystack with Gadget7 Pro mentioned near the end abc",
+        "",
+    ):
+        for cutoff in (0.0, 90.0, 95.0):
+            got = native.partial_ratio_cutoff_many(hay, needles, cutoff)
+            want = [native.partial_ratio_cutoff(hay, nd, cutoff) for nd in needles]
+            assert np.allclose(got, want, atol=1e-9), (hay, cutoff)
+
+
+def test_cutoff_arena_matches_per_pair():
+    """CutoffArena (persistent arena + row selection, the matcher's verify
+    path) must score exactly like per-pair calls on any row subset —
+    including duplicate rows, empty selections, non-ASCII names routed
+    per-pair, and a non-ASCII haystack (whole call falls back per-pair)."""
+    import numpy as np
+
+    from advanced_scrapper_tpu.cpu import native
+
+    rng = np.random.RandomState(31)
+    alpha = "abcdefgh çé—汉"
+    names = ["", "abc", "Tim Cook", "çé—", "汉abc汉", "Gadget7 Pro"] + [
+        "".join(alpha[i] for i in rng.randint(0, len(alpha), int(rng.randint(1, 15))))
+        for _ in range(30)
+    ]
+    arena = native.CutoffArena(names)
+    for hay in (
+        "the quick brown fox says abc and Tim Cook spoke at çé length",
+        "pure ascii haystack with Gadget7 Pro mentioned near the end abc",
+        "",
+    ):
+        for rows in (
+            [],
+            [0],
+            list(range(len(names))),
+            [3, 3, 5, 2, 4, 4],  # duplicates + mixed ascii/unicode rows
+            rng.randint(0, len(names), 20).tolist(),
+        ):
+            for cutoff in (0.0, 95.0):
+                got = arena.scores(hay, rows, cutoff)
+                want = [
+                    native.partial_ratio_cutoff(hay, names[r], cutoff)
+                    for r in rows
+                ]
+                assert np.allclose(got, want, atol=1e-9), (hay, rows, cutoff)
