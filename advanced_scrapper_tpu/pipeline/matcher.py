@@ -409,7 +409,7 @@ def _refine_batch(
     return out
 
 
-def match_chunk(
+def match_chunk_async(
     chunk: pd.DataFrame,
     index: EntityIndex,
     *,
@@ -419,18 +419,16 @@ def match_chunk(
     screen_block: int = 1 << 16,
     threshold: float = 95.0,
     pool=None,
-) -> list[tuple[str, dict, dict]]:
-    """Match a frame of articles → [(ticker, matches, row_record), …].
+):
+    """Screen + submit a frame NOW; return a zero-arg ``collect()`` whose
+    call yields :func:`match_chunk`'s result.
 
-    Accepts both the reference dataset schema (``article_text``/``date_time``)
-    and this framework's scraper schema (``article``/``datetime``).
-
-    ``pool`` (an executor from :func:`make_verify_pool`) fans the host-side
-    exact-verify stage out across processes — the successor of the
-    reference's ``np.array_split`` × ``mp.Pool.starmap(cpu_count)``
-    (``match_keywords.py:231-238``).  The device screen always runs in THIS
-    process (one device context); only the CPU verify work ships out.
-    Output order is identical with and without a pool.
+    With a pool, the verify slices are already in flight when this
+    returns, so a streaming caller (``run_matcher``) can screen chunk
+    i+1 on the device while chunk i's verify work runs in the pool —
+    the reference's own overlap (its ``mp.Pool`` never sits idle between
+    20k-row chunks, ``match_keywords.py:227-238``).  Without a pool,
+    ``collect()`` does the verify work serially when called.
     """
     if use_refine and not use_screen:
         # refine lives inside the screen path; silently no-opping here would
@@ -439,7 +437,9 @@ def match_chunk(
         raise ValueError("use_refine requires use_screen (see DESIGN.md §4)")
 
     rows = []
-    for _, row in chunk.iterrows():
+    # plain dicts, not Series: ~100 µs/row cheaper to build, identical
+    # mapping access in _get_col, and far cheaper to pickle to pool workers
+    for row in chunk.to_dict("records"):
         text = _get_col(row, "article_text", "article")
         title = _get_col(row, "title")
         raw_date = _get_col(row, "date_time", "datetime", default="")
@@ -489,7 +489,7 @@ def match_chunk(
                     text_prunes[start + i] = pr
 
     if pool is not None and len(rows) > 1:
-        # ship (text, title, date, row-INDEX) out; the full pandas row stays
+        # ship (text, title, date, row-INDEX) out; the full row record stays
         # here and is re-attached on return (half the IPC volume)
         light = [(t, ti, d, i) for i, (t, ti, d, _r) in enumerate(rows)]
         n_slices = min(getattr(pool, "_max_workers", 4), len(rows))
@@ -502,17 +502,60 @@ def match_chunk(
             for lo, hi in zip(bounds[:-1], bounds[1:])
             if hi > lo
         ]
+
+        def collect():
+            out = []
+            for f in futures:  # slice order == row order
+                out.extend((ticker, m, rows[i][3]) for ticker, m, i in f.result())
+            return out
+
+        collect.futures = futures  # introspectable: the in-flight slices
+        return collect
+
+    def collect():
         out = []
-        for f in futures:  # slice order == row order
-            out.extend((ticker, m, rows[i][3]) for ticker, m, i in f.result())
+        for (text, title, adate, row), mask, pruned in zip(rows, masks, text_prunes):
+            matches = match_article(text, title, adate, index, mask, threshold, pruned)
+            for ticker, m in matches.items():
+                out.append((ticker, m, row))
         return out
 
-    out = []
-    for (text, title, adate, row), mask, pruned in zip(rows, masks, text_prunes):
-        matches = match_article(text, title, adate, index, mask, threshold, pruned)
-        for ticker, m in matches.items():
-            out.append((ticker, m, row))
-    return out
+    return collect
+
+
+def match_chunk(
+    chunk: pd.DataFrame,
+    index: EntityIndex,
+    *,
+    use_screen: bool = True,
+    use_refine: bool = False,
+    screen_batch: int = 128,
+    screen_block: int = 1 << 16,
+    threshold: float = 95.0,
+    pool=None,
+) -> list[tuple[str, dict, dict]]:
+    """Match a frame of articles → [(ticker, matches, row_record), …].
+
+    Accepts both the reference dataset schema (``article_text``/``date_time``)
+    and this framework's scraper schema (``article``/``datetime``).
+
+    ``pool`` (an executor from :func:`make_verify_pool`) fans the host-side
+    exact-verify stage out across processes — the successor of the
+    reference's ``np.array_split`` × ``mp.Pool.starmap(cpu_count)``
+    (``match_keywords.py:231-238``).  The device screen always runs in THIS
+    process (one device context); only the CPU verify work ships out.
+    Output order is identical with and without a pool.
+    """
+    return match_chunk_async(
+        chunk,
+        index,
+        use_screen=use_screen,
+        use_refine=use_refine,
+        screen_batch=screen_batch,
+        screen_block=screen_block,
+        threshold=threshold,
+        pool=pool,
+    )()
 
 
 # -- verify-stage process pool (ref match_keywords.py:231-238) ---------------
@@ -653,18 +696,37 @@ def run_matcher(
         workers = cfg.verify_workers
     pool = make_verify_pool(index, workers)  # 0/None normalise to cpu_count
     n_matches = 0
+
+    def drain(collect) -> None:
+        nonlocal n_matches
+        for ticker, matches, row in collect():
+            if append_match(out_dir, ticker, matches, row):
+                n_matches += 1
+
     try:
+        # bounded two-deep pipeline: chunk i+1's device screen runs while
+        # chunk i's verify slices execute in the pool; appends stay in this
+        # process, in chunk order (single CSV writer by construction)
+        from collections import deque
+
+        in_flight: deque = deque()
         for chunk in pd.read_csv(articles_csv, chunksize=cfg.chunk_size):
-            for ticker, matches, row in match_chunk(
-                chunk,
-                index,
-                use_screen=use_screen,
-                use_refine=use_refine,
-                threshold=cfg.fuzzy_threshold,
-                pool=pool,
-            ):
-                if append_match(out_dir, ticker, matches, row):
-                    n_matches += 1
+            in_flight.append(
+                match_chunk_async(
+                    chunk,
+                    index,
+                    use_screen=use_screen,
+                    use_refine=use_refine,
+                    threshold=cfg.fuzzy_threshold,
+                    pool=pool,
+                )
+            )
+            # without a pool collect() is lazy serial work — drain at once
+            # so only one chunk's rows stay resident (no overlap to gain)
+            if pool is None or len(in_flight) > 1:
+                drain(in_flight.popleft())
+        while in_flight:
+            drain(in_flight.popleft())
     finally:
         if pool is not None:
             pool.shutdown()

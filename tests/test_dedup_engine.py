@@ -106,3 +106,34 @@ def test_dedup_reps_async_streaming_matches_sync():
     async_reps = [eng.dedup_reps_async(c) for c in corpora]  # all in flight
     for c, r in zip(corpora, async_reps):
         assert (np.asarray(r)[: len(c)] == eng.dedup_reps(c)).all()
+
+
+def test_exact_dedup_collision_groups_confirm_strings():
+    """Distinct strings whose 128-bit hashes collide must ALL be kept, and
+    true duplicates inside a collision group must still be dropped — the
+    sort-based grouping proposes, the string confirm decides.  A degenerate
+    hasher forces every row into ONE hash group, so the multi-group path is
+    exercised for both cases at once."""
+
+    class AllCollide:
+        def hash_docs(self, raw, *, block_len=4096):
+            return np.zeros((len(raw), 4), np.uint32)
+
+    items = ["a", "b", "a", "c", "b", "a", "d", "c"]
+    expected = pd.DataFrame({"u": items}).drop_duplicates(subset=["u"]).index.tolist()
+    got = ExactDedup(hasher=AllCollide()).keep_indices(items)
+    assert got == expected == [0, 1, 3, 6]
+
+
+def test_exact_dedup_fuzz_vs_pandas_mixed_group_sizes():
+    """Random corpora with heavy duplication + singletons, fuzzing the
+    lexsort grouping (singleton fast path, multi groups, original-order
+    preservation) against pandas first-seen semantics."""
+    rng = np.random.RandomState(11)
+    for _ in range(25):
+        n = int(rng.randint(1, 500))
+        pool_n = max(1, int(n * rng.uniform(0.2, 1.0)))
+        pool = [f"item-{i}-{'x' * int(rng.randint(0, 9))}" for i in range(pool_n)]
+        items = [pool[rng.randint(pool_n)] for _ in range(n)]
+        want = pd.DataFrame({"u": items}).drop_duplicates(subset=["u"]).index.tolist()
+        assert ExactDedup().keep_indices(items) == want

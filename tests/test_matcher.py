@@ -14,8 +14,10 @@ from advanced_scrapper_tpu.pipeline.matcher import (
     EntityIndex,
     extract_time_periods,
     is_within_period,
+    make_verify_pool,
     match_article,
     match_chunk,
+    match_chunk_async,
     process_json_data,
     read_info_dir,
     run_matcher,
@@ -345,3 +347,41 @@ def test_match_chunk_rejects_refine_without_screen():
     }])
     with pytest.raises(ValueError, match="use_refine requires use_screen"):
         match_chunk(df, _index(), use_screen=False, use_refine=True)
+
+
+def test_match_chunk_async_equals_sync_and_overlaps(tmp_path):
+    """match_chunk_async's collect() must return exactly match_chunk's
+    result (pool and serial), and with a pool the verify futures must be
+    IN FLIGHT before collect() is called — that overlap is the point."""
+    entities = [_entity()]
+    index = EntityIndex(process_json_data(entities))
+    rows = []
+    for i in range(24):
+        rows.append(
+            {
+                "article_text": ARTICLE if i % 3 == 0 else "nothing relevant here",
+                "title": TITLE if i % 5 == 0 else "wrap",
+                "date_time": "2020-06-01T12:00:00Z",
+                "url": f"https://x/{i}.html",
+            }
+        )
+    df = pd.DataFrame(rows)
+
+    def norm(res):
+        return [(t, json.dumps(m, sort_keys=True), r["url"]) for t, m, r in res]
+
+    sync = match_chunk(df, index)
+    assert norm(match_chunk_async(df, index)()) == norm(sync)
+
+    pool = make_verify_pool(index, workers=2)
+    if pool is not None:
+        try:
+            collect = match_chunk_async(df, index, pool=pool)
+            # verify slices were submitted during the async call itself
+            from concurrent.futures import Future
+
+            futures = collect.futures
+            assert futures and all(isinstance(f, Future) for f in futures)
+            assert norm(collect()) == norm(sync)
+        finally:
+            pool.shutdown()
