@@ -1,0 +1,65 @@
+"""Host-only throughput of the stream composition.
+
+Measures ``HostBatcher.push_many`` → ``DeviceFeed`` iteration → tag
+re-indexing with ``jax.device_put`` stubbed to identity and the device
+step replaced by a zero array — i.e. every host-side cost of the stream
+regime and none of the device/transport cost.  If this number clears the
+50k/s north star by a wide margin (measured 770k articles/s on the dev
+host, 2026-07-30 — DESIGN.md §5), any stream-regime shortfall is
+H2D/dispatch transport, not host composition.
+
+Usage (CPU env so the axon plugin never dials a tunnel):
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python tools/profile_host_composition.py
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main(batch: int = 65536, block: int = 1024, n_batches: int = 4) -> None:
+    import jax
+
+    from advanced_scrapper_tpu.cpu.hostbatch import HostBatcher
+    from advanced_scrapper_tpu.pipeline.feed import DeviceFeed
+
+    total = batch * n_batches
+    rng = np.random.RandomState(3)
+    base = rng.randint(32, 127, size=(batch, block), dtype=np.uint8)
+    docs = [base[i].tobytes() for i in range(batch)]
+
+    real_put = jax.device_put
+    jax.device_put = lambda x, *a, **k: x  # isolate: host path only
+    try:
+        batcher = HostBatcher(block)
+        feed = DeviceFeed(batcher, batch, depth=4)
+
+        def produce():
+            for b in range(n_batches):
+                batcher.feed(docs, start_tag=b * batch, chunk=4096)
+            batcher.close()
+
+        t0 = time.perf_counter()
+        threading.Thread(target=produce, daemon=True).start()
+        seen, reps = 0, []
+        for n, tok, lens, tags in feed:
+            reps.append(tags[np.zeros(n, np.int32)])  # device-step stand-in
+            seen += n
+        dt = time.perf_counter() - t0
+        feed.join()
+    finally:
+        jax.device_put = real_put
+    assert seen == total, (seen, total)
+    print(f"host-only composition: {total / dt:.0f} articles/s "
+          f"({dt:.2f}s for {total})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Per-stage breakdown of the stream regime on the attached device.
+
+Times each stage of ``bench.py``'s stream path separately — producer
+push, feed pop wait, step dispatch, final result sync — so a shortfall
+vs the kernel ceiling names its stage instead of hiding in one number
+(VERDICT r2 item 3).  Run against the real chip (default env) when the
+tunnel is healthy; the CPU mesh works too but measures compute, not
+transport.
+
+Usage:
+    python tools/profile_stream.py            # real chip
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python tools/profile_stream.py 4096 1024 2   # CPU, small shapes
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main(batch: int = 65536, block: int = 1024, n_batches: int = 4) -> None:
+    import jax
+
+    from advanced_scrapper_tpu.core.hashing import make_params
+    from advanced_scrapper_tpu.core.mesh import build_mesh
+    from advanced_scrapper_tpu.cpu.hostbatch import HostBatcher
+    from advanced_scrapper_tpu.parallel.sharded import (
+        make_sharded_dedup,
+        shard_batch,
+    )
+    from advanced_scrapper_tpu.pipeline.feed import DeviceFeed
+
+    total = batch * n_batches
+    params = make_params()
+    mesh = build_mesh(len(jax.devices()), 1)
+    rng = np.random.RandomState(3)
+    base = rng.randint(32, 127, size=(batch, block), dtype=np.uint8)
+    docs = [base[i].tobytes() for i in range(batch)]
+    step = make_sharded_dedup(mesh, params, backend="scan")
+    warm = shard_batch(base, np.full((batch,), block, np.int32), mesh)
+    jax.block_until_ready(step(*warm))  # compile outside the timed region
+
+    batcher = HostBatcher(block)
+    feed = DeviceFeed(batcher, batch, depth=4)
+    t_push = [0.0]
+
+    def produce():
+        t0 = time.perf_counter()
+        for b in range(n_batches):
+            batcher.feed(docs, start_tag=b * batch, chunk=4096)
+        batcher.close()
+        t_push[0] = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    threading.Thread(target=produce, daemon=True).start()
+    pending, t_pop, t_disp = [], 0.0, 0.0
+    tp = time.perf_counter()
+    for n, tok_dev, len_dev, tags in feed:
+        t_pop += time.perf_counter() - tp
+        td = time.perf_counter()
+        rep, _hist = step(tok_dev, len_dev)
+        try:
+            rep.copy_to_host_async()
+        except AttributeError:
+            pass
+        t_disp += time.perf_counter() - td
+        pending.append((rep, tags, n))
+        tp = time.perf_counter()
+    t_loop = time.perf_counter() - t0
+    ts = time.perf_counter()
+    outs = [tags[np.asarray(rep)[:n]] for rep, tags, n in pending]
+    t_sync = time.perf_counter() - ts
+    dt = time.perf_counter() - t0
+    feed.join()
+    assert sum(o.shape[0] for o in outs) == total
+    print(
+        f"stream {total / dt:.0f} articles/s | producer={t_push[0]:.2f}s "
+        f"pop_wait={t_pop:.2f}s dispatch={t_disp:.2f}s "
+        f"final_sync={t_sync:.2f}s loop={t_loop:.2f}s total={dt:.2f}s"
+    )
+
+
+if __name__ == "__main__":
+    args = [int(a) for a in sys.argv[1:4]]
+    main(*args)
