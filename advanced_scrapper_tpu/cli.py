@@ -82,11 +82,17 @@ def _cmd_scrape(args: argparse.Namespace) -> int:
 
 
 def _cmd_enrich(args: argparse.Namespace) -> int:
+    # --simple: the un-hardened single-pass flow (ref ticker_symbol_query.py)
+    # — no retry ladder, no progress ledger, no cool-downs
+    cfg = _with_overrides(
+        default_config().enrich,
+        hardened=False if getattr(args, "simple", False) else None,
+    )
     if getattr(args, "crypto", False):
         run_crypto = _import_pipeline("enrich", "run_crypto_enrich")
-        return run_crypto(default_config().enrich)
+        return run_crypto(cfg)
     run_enrich = _import_pipeline("enrich", "run_enrich")
-    return run_enrich(default_config().enrich)
+    return run_enrich(cfg)
 
 
 def _cmd_match(args: argparse.Namespace) -> int:
@@ -328,6 +334,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--crypto",
         action="store_true",
         help="enrich the crypto symbol list into info/crypto/ instead",
+    )
+    e.add_argument(
+        "--simple",
+        action="store_true",
+        help="un-hardened single-pass queries (ref ticker_symbol_query.py; "
+        "default is the rate-limit-protected flow)",
     )
     e.set_defaults(fn=_cmd_enrich)
 

@@ -182,13 +182,18 @@ def zip_results(data_1: dict, data_2: dict, data_3: dict, symbol: str) -> list[d
     return out
 
 
-def create_session():
-    """Retry-hardened requests session (ref protected ``:11-31``)."""
+def create_session(hardened: bool = True):
+    """Requests session: retry-hardened (ref protected ``:11-31``) or, for
+    the simple flow, a bare session with no transport-level retries (the
+    un-hardened script used plain ``requests.get``)."""
     import requests
+
+    session = requests.Session()
+    if not hardened:
+        return session
     from requests.adapters import HTTPAdapter
     from urllib3.util.retry import Retry
 
-    session = requests.Session()
     retry = Retry(
         total=5,
         backoff_factor=2,
@@ -221,7 +226,9 @@ class EnrichClient:
         rng: random.Random | None = None,
     ):
         self.cfg = cfg
-        self.session = session if session is not None else create_session()
+        self.session = (
+            session if session is not None else create_session(cfg.hardened)
+        )
         self.sleep = sleep
         self.rng = rng or random.Random()
 
@@ -233,15 +240,23 @@ class EnrichClient:
         )
 
     def query_symbol(self, symbol: str) -> bool:
-        """Fetch + persist one symbol; True on success (ref protected :176-335)."""
+        """Fetch + persist one symbol; True on success (ref protected :176-335).
+
+        With ``cfg.hardened`` False this is the simple script's single pass
+        — one attempt, no inter-query jitter, no politeness sleep, no
+        backoff ladder (ref ``ticker_symbol_query.py``'s plain flow)."""
         q1, q2, q3 = build_queries(symbol)
         base = self.cfg.base_delay
-        for attempt in range(self.cfg.max_retries):
+        hardened = self.cfg.hardened
+        attempts = self.cfg.max_retries if hardened else 1
+        for attempt in range(attempts):
             try:
                 r1 = self._get(q1)
-                self.sleep(self.rng.uniform(1, 3))
+                if hardened:
+                    self.sleep(self.rng.uniform(1, 3))
                 r2 = self._get(q2)
-                self.sleep(self.rng.uniform(1, 3))
+                if hardened:
+                    self.sleep(self.rng.uniform(1, 3))
                 r3 = self._get(q3)
                 if r1.ok and r2.ok and r3.ok:
                     entries = zip_results(r1.json(), r2.json(), r3.json(), symbol)
@@ -249,18 +264,19 @@ class EnrichClient:
                     path = os.path.join(self.cfg.out_dir, f"{symbol}_info.json")
                     with open(path, "w", encoding="utf-8") as f:
                         json.dump(entries, f, indent=4, ensure_ascii=False)
-                    self.sleep(self.rng.uniform(5, 10))  # politeness (ref :287)
+                    if hardened:
+                        self.sleep(self.rng.uniform(5, 10))  # politeness (ref :287)
                     return True
                 # 429 escalates faster than other failures (ref :302-315)
                 if any(r.status_code == 429 for r in (r1, r2, r3)):
-                    if attempt < self.cfg.max_retries - 1:
+                    if attempt < attempts - 1:
                         self.sleep(base * (3**attempt) + self.rng.uniform(10, 20))
                     else:
                         return False
-                elif attempt < self.cfg.max_retries - 1:
+                elif attempt < attempts - 1:
                     self.sleep(base * (2**attempt) + self.rng.uniform(2, 8))
             except Exception:
-                if attempt < self.cfg.max_retries - 1:
+                if attempt < attempts - 1:
                     self.sleep(base * (2**attempt) + self.rng.uniform(5, 15))
                 else:
                     return False

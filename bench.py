@@ -109,6 +109,17 @@ def _bench_ragged(n_articles: int, n_corpora: int = 4) -> float:
     return n_articles * n_corpora / dt
 
 
+def _stream_corpus(batch: int, block: int, seed: int = 3):
+    """The stream regime's doc corpus: uniform rows, 25% planted dups.
+    Shared with ``tools/profile_stream.py`` / ``profile_host_composition.py``
+    so the per-stage profilers decompose EXACTLY this benchmark's pipeline."""
+    rng = np.random.RandomState(seed)
+    base = rng.randint(32, 127, size=(batch, block), dtype=np.uint8)
+    dup_src = rng.randint(0, batch // 2, size=batch // 4)
+    base[batch // 2 : batch // 2 + batch // 4] = base[dup_src]
+    return base, [base[i].tobytes() for i in range(batch)]
+
+
 def _bench_stream(
     jax, mesh, params, backend: str, batch: int, block: int, n_batches: int
 ) -> float:
@@ -118,11 +129,7 @@ def _bench_stream(
     from advanced_scrapper_tpu.pipeline.feed import DeviceFeed
 
     total = batch * n_batches
-    rng = np.random.RandomState(3)
-    base = rng.randint(32, 127, size=(batch, block), dtype=np.uint8)
-    dup_src = rng.randint(0, batch // 2, size=batch // 4)
-    base[batch // 2 : batch // 2 + batch // 4] = base[dup_src]
-    docs = [base[i].tobytes() for i in range(batch)]
+    base, docs = _stream_corpus(batch, block)
 
     step = make_sharded_dedup(mesh, params, backend=backend)
     warm = shard_batch(base, np.full((batch,), block, np.int32), mesh)

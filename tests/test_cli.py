@@ -121,3 +121,21 @@ def test_match_cli_flags(tmp_path, monkeypatch):
     seen.clear()
     assert main(["match"]) == 0
     assert seen == {}
+
+
+def test_enrich_simple_flag_disables_hardened(monkeypatch):
+    """`astpu enrich --simple` must run the un-hardened single-pass flow
+    (ref ticker_symbol_query.py) — cfg.hardened False — while the default
+    stays the rate-limit-protected flow."""
+    import advanced_scrapper_tpu.pipeline.enrich as enrich_mod
+
+    seen = []
+
+    def fake_run(cfg, **kw):
+        seen.append(cfg.hardened)
+        return 0
+
+    monkeypatch.setattr(enrich_mod, "run_enrich", fake_run)
+    assert main(["enrich", "--simple"]) == 0
+    assert main(["enrich"]) == 0
+    assert seen == [False, True]

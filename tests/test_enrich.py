@@ -210,3 +210,43 @@ def test_run_crypto_enrich_writes_crypto_artifact_tree(tmp_path, monkeypatch):
     assert data[0]["ticker"] == "BTC"
     led = json.load(open("progress_crypto.json"))
     assert sorted(led["processed"]) == ["BTC", "ETH"]
+
+
+def test_simple_flow_is_a_true_single_pass(tmp_path):
+    """hardened=False (astpu enrich --simple, ref ticker_symbol_query.py)
+    must make exactly ONE pass: three GETs, zero sleeps, no retry after a
+    failure — the hardened ladder is entirely disabled, not just the ledger."""
+    import requests
+
+    # success: 3 queries, artifact written, and NO sleeps of any kind
+    sleeps = []
+    ok3 = [
+        _resp(bindings=[_binding(idLabels="Apple Inc.", ticker="AAPL")]),
+        _resp(bindings=[]),
+        _resp(bindings=[]),
+    ]
+    sess = FakeSession(ok3)
+    cli = EnrichClient(
+        _cfg(tmp_path, hardened=False), session=sess,
+        sleep=sleeps.append, rng=random.Random(0),
+    )
+    assert cli.query_symbol("AAPL")
+    assert len(sess.queries) == 3
+    assert sleeps == []
+    assert os.path.exists(tmp_path / "info" / "AAPL_info.json")
+
+    # failure: one attempt only, no backoff sleeps, no artifact
+    sleeps2 = []
+    sess2 = FakeSession([requests.ConnectionError("boom")] * 9)
+    cli2 = EnrichClient(
+        _cfg(tmp_path, hardened=False), session=sess2,
+        sleep=sleeps2.append, rng=random.Random(0),
+    )
+    assert not cli2.query_symbol("FAIL")
+    assert len(sess2.queries) == 1 and sleeps2 == []
+
+    # and the un-hardened session carries no urllib3 Retry adapter
+    from advanced_scrapper_tpu.pipeline.enrich import create_session
+
+    bare = create_session(hardened=False)
+    assert bare.get_adapter("https://x").max_retries.total == 0

@@ -30,10 +30,10 @@ def main(batch: int = 65536, block: int = 1024, n_batches: int = 4) -> None:
     from advanced_scrapper_tpu.cpu.hostbatch import HostBatcher
     from advanced_scrapper_tpu.pipeline.feed import DeviceFeed
 
+    import bench
+
     total = batch * n_batches
-    rng = np.random.RandomState(3)
-    base = rng.randint(32, 127, size=(batch, block), dtype=np.uint8)
-    docs = [base[i].tobytes() for i in range(batch)]
+    _base, docs = bench._stream_corpus(batch, block)  # bench's exact corpus
 
     real_put = jax.device_put
     jax.device_put = lambda x, *a, **k: x  # isolate: host path only

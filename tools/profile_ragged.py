@@ -27,7 +27,6 @@ sys.path.insert(0, ".")
 def main(n_articles: int = 8192) -> None:
     import jax
 
-    sys.path.insert(0, ".")
     import bench
     from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
 

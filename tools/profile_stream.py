@@ -36,12 +36,12 @@ def main(batch: int = 65536, block: int = 1024, n_batches: int = 4) -> None:
     )
     from advanced_scrapper_tpu.pipeline.feed import DeviceFeed
 
+    import bench
+
     total = batch * n_batches
     params = make_params()
     mesh = build_mesh(len(jax.devices()), 1)
-    rng = np.random.RandomState(3)
-    base = rng.randint(32, 127, size=(batch, block), dtype=np.uint8)
-    docs = [base[i].tobytes() for i in range(batch)]
+    base, docs = bench._stream_corpus(batch, block)  # bench's exact corpus
     step = make_sharded_dedup(mesh, params, backend="scan")
     warm = shard_batch(base, np.full((batch,), block, np.int32), mesh)
     jax.block_until_ready(step(*warm))  # compile outside the timed region
