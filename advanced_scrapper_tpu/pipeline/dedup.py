@@ -210,28 +210,37 @@ class ExactDedup:
         # collisions) ever reach the Python string-confirm below.
         hi = (h[:, 0].astype(np.uint64) << 32) | h[:, 1]
         lo = (h[:, 2].astype(np.uint64) << 32) | h[:, 3]
-        order = np.lexsort((lo, hi))
+        order = np.lexsort((lo, hi))  # stable ⇒ ties stay in original order
         shi, slo = hi[order], lo[order]
         new_group = np.empty(n, bool)
         new_group[0] = True
         new_group[1:] = (shi[1:] != shi[:-1]) | (slo[1:] != slo[:-1])
         gid = np.empty(n, np.int64)
         gid[order] = np.cumsum(new_group) - 1
+        # per-group leader = smallest original index (stability of lexsort)
+        leader_of = order[np.flatnonzero(new_group)]
         counts = np.bincount(gid)
         keep = counts[gid] == 1  # singleton hash ⇒ provably first-seen unique
         multi_rows = np.flatnonzero(~keep)  # ascending ⇒ original order
-        groups: dict[int, list[int]] = {}
-        for i in multi_rows.tolist():
-            group = groups.get(gid[i])
-            if group is None:
-                groups[gid[i]] = [i]  # first member of its hash group
-                keep[i] = True
-            else:
-                # hash-equal group: confirm a true string match
-                if any(items[j] == items[i] for j in group):
-                    continue
-                group.append(i)
-                keep[i] = True
+        if len(multi_rows):
+            # The overwhelming case is a true-duplicate group: every member
+            # equals its leader.  One C-level object compare settles all of
+            # them; only groups holding a member that DIFFERS from the
+            # leader (a 2⁻¹²⁸ hash collision) take the per-group walk.
+            obj = np.array(items, dtype=object)
+            leaders = leader_of[gid[multi_rows]]
+            eq_leader = obj[multi_rows] == obj[leaders]
+            keep[multi_rows[multi_rows == leaders]] = True
+            rare = np.unique(gid[multi_rows[~eq_leader]])
+            for g in rare.tolist():
+                members = multi_rows[gid[multi_rows] == g]
+                kept_distinct: list[int] = []
+                for i in members.tolist():
+                    if not any(items[j] == items[i] for j in kept_distinct):
+                        kept_distinct.append(i)
+                        keep[i] = True
+                    else:
+                        keep[i] = False
         return np.flatnonzero(keep).tolist()
 
     def keep_mask(self, items: Sequence[str]) -> np.ndarray:
