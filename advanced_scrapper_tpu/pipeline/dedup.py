@@ -230,7 +230,7 @@ class ExactDedup:
             obj = np.array(items, dtype=object)
             leaders = leader_of[gid[multi_rows]]
             eq_leader = obj[multi_rows] == obj[leaders]
-            keep[multi_rows[multi_rows == leaders]] = True
+            keep[leader_of] = True  # singleton leaders were already True
             rare = np.unique(gid[multi_rows[~eq_leader]])
             for g in rare.tolist():
                 members = multi_rows[gid[multi_rows] == g]

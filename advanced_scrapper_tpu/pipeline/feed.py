@@ -45,7 +45,13 @@ class DeviceFeed:
         depth: int = 2,
         sharding=None,
         poll_timeout_ms: int = 200,
+        workers: int = 1,
     ):
+        """``workers > 1`` runs several pop→device_put threads: on a
+        transport whose per-put round trip serializes (the tunneled dev
+        chip), concurrent puts overlap that latency.  Batches may then
+        arrive out of submission order — safe for the dedup path, where
+        every batch is independent and tags ride with their batch."""
         import jax
 
         self.batcher = batcher
@@ -55,8 +61,14 @@ class DeviceFeed:
         self._out: "queue.Queue" = queue.Queue(maxsize=depth)
         self._error: BaseException | None = None
         self._jax = jax
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
+        self._exit_lock = threading.Lock()
+        self._remaining = max(1, workers)
+        self._threads = [
+            threading.Thread(target=self._run, daemon=True)
+            for _ in range(max(1, workers))
+        ]
+        for t in self._threads:
+            t.start()
 
     def _put_device(self, arr: np.ndarray, spec=None):
         if self.sharding is not None and spec is not None:
@@ -68,7 +80,7 @@ class DeviceFeed:
         if self.sharding is not None:
             tok_spec, len_spec = self.sharding
         try:
-            while True:
+            while self._error is None:  # a peer's death stops this worker too
                 n, tok, lens, tags = self.batcher.pop_batch(
                     self.batch_size, timeout_ms=self.poll_timeout_ms
                 )
@@ -82,9 +94,15 @@ class DeviceFeed:
                 l_dev = self._put_device(lens, len_spec)
                 self._out.put((n, t_dev, l_dev, tags))
         except BaseException as e:  # a dying feed thread must not hang the
-            self._error = e         # consumer: deliver the error, then the
-        finally:                    # sentinel, and re-raise at the iterator
-            self._out.put(None)
+            with self._exit_lock:    # consumer: deliver the FIRST error,
+                if self._error is None:  # then the sentinel, and re-raise
+                    self._error = e      # at the iterator once all workers
+        finally:                         # exit
+            with self._exit_lock:
+                self._remaining -= 1
+                last = self._remaining == 0
+            if last:
+                self._out.put(None)
 
     def __iter__(self) -> Iterator[tuple[int, object, object, np.ndarray]]:
         while True:
@@ -102,7 +120,16 @@ class DeviceFeed:
             yield item
 
     def join(self, timeout: float | None = 30.0) -> None:
-        self._thread.join(timeout=timeout)
+        """Wait for every worker; ``timeout`` bounds the TOTAL wait."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for t in self._threads:
+            t.join(
+                timeout=None
+                if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            )
 
 
 def stream_signatures(

@@ -136,7 +136,11 @@ def _bench_stream(
     jax.block_until_ready(step(*warm))  # compile outside the timed region
 
     batcher = HostBatcher(block)
-    feed = DeviceFeed(batcher, batch, depth=4)
+    # >1 worker overlaps device_put round trips on serializing transports
+    feed = DeviceFeed(
+        batcher, batch, depth=4,
+        workers=int(os.environ.get("ASTPU_BENCH_FEED_WORKERS", "1")),
+    )
 
     def produce():
         # feed() chunks through push_many with bounded-backpressure retries —
@@ -396,7 +400,8 @@ def main() -> None:
     backend = os.environ.get("ASTPU_BENCH_BACKEND", "scan")
     quick = bool(os.environ.get("ASTPU_BENCH_QUICK"))
 
-    batch = 4096 if quick else 65536  # 65536: ~15% over 32768 on v5e (2026-07)
+    # 65536: ~15% over 32768 on v5e (2026-07); ASTPU_BENCH_BATCH sweeps it
+    batch = int(os.environ.get("ASTPU_BENCH_BATCH", 4096 if quick else 65536))
     block = 1024   # bytes/article (typical short news article body)
 
     try:

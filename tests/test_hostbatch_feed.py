@@ -261,3 +261,65 @@ def test_feed_returns_promptly_on_closed_batcher():
     n = b.feed([b"a"] * 100, timeout_s=60.0)
     assert n == 0
     assert __import__("time").monotonic() - t0 < 5.0
+
+
+def test_device_feed_multi_worker_delivers_every_batch_once():
+    """workers=2: concurrent pop→device_put threads (overlapping put round
+    trips on serializing transports).  Batches may arrive out of order but
+    the tag multiset must be exactly the pushed documents, each once, and
+    termination must wait for BOTH workers (single sentinel)."""
+    from advanced_scrapper_tpu.pipeline.feed import DeviceFeed
+
+    b = HostBatcher(32)
+    feed = DeviceFeed(b, 8, depth=3, workers=2)
+    total = 64
+
+    def produce():
+        for i in range(total):
+            assert b.push(b"doc-%d" % i, 1000 + i)
+        b.close()
+
+    threading.Thread(target=produce, daemon=True).start()
+    seen: list[int] = []
+    for n, tok_dev, len_dev, tags in feed:
+        assert n > 0
+        seen.extend(tags[:n].tolist())
+    assert sorted(seen) == [1000 + i for i in range(total)]
+    feed.join()
+    # exhausted feed terminates again instead of blocking (idempotent)
+    assert list(iter(feed)) == []
+
+
+def test_device_feed_multi_worker_death_raises_promptly():
+    """With workers=2 and a poisoned device_put, the consumer must get the
+    error PROMPTLY — peers stop on a sibling's death instead of draining
+    (or, with a never-closed batcher, serving) the rest of the stream."""
+    from advanced_scrapper_tpu.pipeline import feed as feed_mod
+
+    b = HostBatcher(32)
+    feed = feed_mod.DeviceFeed(b, 4, depth=2, workers=2, poll_timeout_ms=50)
+    boom = RuntimeError("transport died")
+
+    def bad_put(arr, spec=None):
+        raise boom
+
+    feed._put_device = bad_put  # poison AFTER construction
+    for i in range(8):
+        b.push(b"x%d" % i, i)
+    # batcher deliberately NEVER closed: only stop-on-error can end the feed
+    got: list[BaseException] = []
+
+    def consume():
+        try:
+            for _ in feed:
+                pass
+        except BaseException as e:
+            got.append(e)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive(), "consumer hung: peers did not stop on death"
+    assert got and "DeviceFeed worker died" in str(got[0])
+    assert got[0].__cause__ is boom
+    feed.join()
