@@ -140,6 +140,7 @@ def stream_signatures(
     batch_size: int | None = None,
     prefer_native: bool = True,
     sig_bits: int = 32,
+    feed_workers: int = 1,
 ) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Stream ``(tags, signatures, band_keys)`` batches for a document feed.
 
@@ -151,6 +152,11 @@ def stream_signatures(
     (uint16) — lane-agreement still estimates Jaccard (collision noise
     2⁻¹⁶/lane) and the device→host volume halves, which matters on
     D2H-constrained links; band keys are always full uint32.
+
+    ``feed_workers > 1`` overlaps device_put round trips on serializing
+    transports (see :class:`DeviceFeed`); batches may then arrive out of
+    submission order, which this path tolerates — tags ride with their
+    batch and each batch's kernels are independent.
     """
     if sig_bits not in (16, 32):
         raise ValueError(f"sig_bits must be 16 or 32, got {sig_bits}")
@@ -166,7 +172,7 @@ def stream_signatures(
     salt = np.asarray(params.band_salt)
 
     batcher = HostBatcher(block, prefer_native=prefer_native)
-    feed = DeviceFeed(batcher, batch_size)
+    feed = DeviceFeed(batcher, batch_size, workers=feed_workers)
 
     def produce():
         try:

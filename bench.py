@@ -109,6 +109,12 @@ def _bench_ragged(n_articles: int, n_corpora: int = 4) -> float:
     return n_articles * n_corpora / dt
 
 
+def _feed_workers() -> int:
+    """DeviceFeed worker count for the stream regime (and its profiler —
+    one lookup so the decomposition always matches the benchmark)."""
+    return int(os.environ.get("ASTPU_BENCH_FEED_WORKERS", "1"))
+
+
 def _stream_corpus(batch: int, block: int, seed: int = 3):
     """The stream regime's doc corpus: uniform rows, 25% planted dups.
     Shared with ``tools/profile_stream.py`` / ``profile_host_composition.py``
@@ -137,10 +143,7 @@ def _bench_stream(
 
     batcher = HostBatcher(block)
     # >1 worker overlaps device_put round trips on serializing transports
-    feed = DeviceFeed(
-        batcher, batch, depth=4,
-        workers=int(os.environ.get("ASTPU_BENCH_FEED_WORKERS", "1")),
-    )
+    feed = DeviceFeed(batcher, batch, depth=4, workers=_feed_workers())
 
     def produce():
         # feed() chunks through push_many with bounded-backpressure retries —

@@ -46,13 +46,8 @@ def main(batch: int = 65536, block: int = 1024, n_batches: int = 4) -> None:
     warm = shard_batch(base, np.full((batch,), block, np.int32), mesh)
     jax.block_until_ready(step(*warm))  # compile outside the timed region
 
-    import os
-
     batcher = HostBatcher(block)
-    feed = DeviceFeed(
-        batcher, batch, depth=4,
-        workers=int(os.environ.get("ASTPU_BENCH_FEED_WORKERS", "1")),
-    )
+    feed = DeviceFeed(batcher, batch, depth=4, workers=bench._feed_workers())
     t_push = [0.0]
 
     def produce():
