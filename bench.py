@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
 
@@ -321,7 +322,6 @@ def _reexec_cpu_fallback() -> None:
     ``platform: cpu-fallback`` (numbers never silently compared against
     TPU rounds); exits with the child's return code."""
     import subprocess
-    import sys
 
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, here)
@@ -370,8 +370,6 @@ def _jax_or_cpu_fallback(timeout_s: float = 240.0):
     if ready.wait(timeout_s):
         if probe_error:
             if _looks_like_transport_death(probe_error[0]):
-                import sys
-
                 sys.stderr.write(
                     f"bench: device backend init failed fast "
                     f"({type(probe_error[0]).__name__}: {probe_error[0]}); "
@@ -382,8 +380,6 @@ def _jax_or_cpu_fallback(timeout_s: float = 240.0):
         import jax
 
         return jax, jax.devices()[0].platform
-    import sys
-
     sys.stderr.write(
         f"bench: device backend init hung >{timeout_s:.0f}s (dead tunnel?); "
         "re-running on CPU with platform=cpu-fallback\n"
@@ -407,24 +403,34 @@ def main() -> None:
     batch = int(os.environ.get("ASTPU_BENCH_BATCH", 4096 if quick else 65536))
     block = 1024   # bytes/article (typical short news article body)
 
+    def note(msg: str) -> None:
+        # stderr breadcrumbs: if a regime dies mid-run, the driver's tail
+        # names the stage instead of showing an unattributed traceback
+        print(f"bench: {msg}", file=sys.stderr, flush=True)
+
     try:
         # device enumeration + mesh build dispatch against the tunnel too —
         # they must sit inside the death handler, not ahead of it
         mesh = build_mesh(len(jax.devices()), 1)
+        note(f"platform={platform} devices={len(jax.devices())} batch={batch}")
         uniform = _bench_uniform(jax, mesh, params, backend, batch, block)
+        note(f"uniform done: {uniform:.0f}/s")
         ragged = _bench_ragged(1024 if quick else 8192)
+        note(f"ragged done: {ragged:.0f}/s")
         stream = _bench_stream(jax, mesh, params, backend, batch, block, 2 if quick else 4)
+        note(f"stream done: {stream:.0f}/s")
         recall, recall_pairs = _bench_recall(64 if quick else 512)
+        note(f"recall done: {recall:.4f} over {recall_pairs} pairs")
         exact, exact_vs_pandas = _bench_exact(16384 if quick else 262144)
+        note(f"exact done: {exact:.0f}/s ({exact_vs_pandas:.2f}x pandas)")
         matcher = _bench_matcher(256 if quick else 1024)
+        note(f"matcher done: {matcher:.0f}/s")
     except Exception as e:
         # A tunnel that came up can still die between dispatches (it has).
         # Better one labeled cpu-fallback line than no round record at all.
         if _looks_like_transport_death(e) and not os.environ.get(
             "ASTPU_BENCH_PLATFORM_FALLBACK"
         ):
-            import sys
-
             sys.stderr.write(
                 f"bench: device transport died mid-run ({type(e).__name__}: "
                 f"{e}); re-running on CPU with platform=cpu-fallback\n"
