@@ -176,7 +176,7 @@ def _bench_stream(
     return total / dt
 
 
-def _bench_recall(n_bases: int) -> tuple[float, int]:
+def _bench_recall(n_bases: int) -> tuple[float, int, float, int]:
     """Measured near-dup recall vs datasketch-semantics oracle on the
     hardened certification corpus (ragged 100 B–100 kB lengths, pairs
     planted across the Jaccard knee) — the driver-visible twin of
@@ -185,16 +185,20 @@ def _bench_recall(n_bases: int) -> tuple[float, int]:
     from advanced_scrapper_tpu.core.hashing import make_params
     from advanced_scrapper_tpu.cpu.oracle import (
         build_certification_corpus,
+        measured_precision,
         measured_recall,
     )
     from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
 
     rng = np.random.RandomState(7)
+    params = make_params()
     texts = build_certification_corpus(rng, n_bases, n_long=min(12, n_bases // 8))
     reps = NearDupEngine().dedup_reps(texts)
-    return measured_recall(
-        texts, reps, make_params(), threshold=0.7
+    recall, pairs = measured_recall(texts, reps, params, threshold=0.7)
+    precision, _merged, unchained = measured_precision(
+        texts, reps, params.shingle_k, 0.7
     )
+    return recall, pairs, precision, unchained
 
 
 def _bench_exact(n_urls: int) -> tuple[float, float]:
@@ -419,8 +423,13 @@ def main() -> None:
         note(f"ragged done: {ragged:.0f}/s")
         stream = _bench_stream(jax, mesh, params, backend, batch, block, 2 if quick else 4)
         note(f"stream done: {stream:.0f}/s")
-        recall, recall_pairs = _bench_recall(64 if quick else 512)
-        note(f"recall done: {recall:.4f} over {recall_pairs} pairs")
+        recall, recall_pairs, precision, unchained = _bench_recall(
+            64 if quick else 512
+        )
+        note(
+            f"recall done: {recall:.4f} over {recall_pairs} pairs "
+            f"(precision {precision:.4f}, unchained {unchained})"
+        )
         exact, exact_vs_pandas = _bench_exact(16384 if quick else 262144)
         note(f"exact done: {exact:.0f}/s ({exact_vs_pandas:.2f}x pandas)")
         matcher = _bench_matcher(256 if quick else 1024)
@@ -452,6 +461,8 @@ def main() -> None:
                 "stream_vs_baseline": round(stream / 50000.0, 4),
                 "recall_vs_oracle": round(recall, 4),
                 "recall_pairs": recall_pairs,
+                "precision_vs_oracle": round(precision, 4),
+                "unchained_merges": unchained,
                 "exact_urls_per_sec": round(exact, 1),
                 "exact_vs_pandas": round(exact_vs_pandas, 3),
                 "matcher_articles_per_sec": round(matcher, 1),
