@@ -1,0 +1,37 @@
+"""Smoke tests for the measurement tools in ``tools/`` — tiny shapes,
+in-process, so the profilers can't silently rot as the paths they
+decompose evolve (they reuse bench's corpus/config helpers by design)."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for p in (REPO, os.path.join(REPO, "tools")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+
+def test_profile_host_composition_smoke(capsys):
+    import profile_host_composition as t
+
+    t.main(batch=256, block=64, n_batches=2)
+    out = capsys.readouterr().out
+    assert "host-only composition:" in out and "articles/s" in out
+
+
+def test_profile_stream_smoke(devices8, capsys):
+    import profile_stream as t
+
+    t.main(batch=256, block=64, n_batches=2)
+    out = capsys.readouterr().out
+    assert "stream" in out and "dispatch=" in out and "final_sync=" in out
+
+
+def test_profile_ragged_smoke(capsys):
+    import profile_ragged as t
+
+    t.main(n_articles=64)
+    out = capsys.readouterr().out
+    assert "ragged 64 articles" in out and "articles/s one-shot" in out
