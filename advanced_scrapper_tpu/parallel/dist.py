@@ -10,7 +10,7 @@ module brings up the device world and runs the sharded dedup step over the
 candidate resolution rides the same ``all_gather``/``psum`` collectives as
 the single-host path (``parallel/sharded.py``), and the replicated outputs
 are addressable on every host.  Exercised for real by
-``tests/test_multihost.py``: two ``jax.distributed`` processes on one box
+``tests/test_multihost.py``: 2- and 4-process ``jax.distributed`` worlds on one box
 (the reference tests its distributed stack the same way — server and client
 both default to localhost, ``server1.py:17-18``).
 """

@@ -1,11 +1,11 @@
 """Subprocess worker for tests/test_multihost.py.
 
-One jax.distributed process of a two-process CPU world (4 virtual devices
-per process → 8 global).  Builds a local batch with one article that
-duplicates an article held by the *other* process, runs the global-mesh
-dedup, and prints the replicated result as one JSON line.
+One jax.distributed process of an N-process CPU world (8//N virtual
+devices per process → 8 global).  Builds a local batch with one article
+that duplicates an article held by a *different* process, runs the
+global-mesh dedup, and prints the replicated result as one JSON line.
 
-Usage: python multihost_worker.py <process_id> <coordinator_port>
+Usage: python multihost_worker.py <process_id> <coordinator_port> [n_procs]
 """
 
 import json
@@ -14,14 +14,16 @@ import sys
 
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
-# Force exactly 4 local devices even if the parent (pytest conftest) already
-# exported a different xla_force_host_platform_device_count.
+_N_PROCS = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+assert 8 % _N_PROCS == 0, f"n_procs must divide the 8-device world, got {_N_PROCS}"
+# Force exactly 8//N local devices even if the parent (pytest conftest)
+# already exported a different xla_force_host_platform_device_count.
 _flags = [
     f
     for f in os.environ.get("XLA_FLAGS", "").split()
     if "xla_force_host_platform_device_count" not in f
 ]
-_flags.append("--xla_force_host_platform_device_count=4")
+_flags.append(f"--xla_force_host_platform_device_count={max(1, 8 // _N_PROCS)}")
 os.environ["XLA_FLAGS"] = " ".join(_flags)
 
 import jax  # noqa: E402
@@ -34,6 +36,7 @@ import numpy as np  # noqa: E402
 def main() -> None:
     pid = int(sys.argv[1])
     port = int(sys.argv[2])
+    n = _N_PROCS
 
     from advanced_scrapper_tpu.parallel.dist import (
         initialize_multihost,
@@ -41,7 +44,7 @@ def main() -> None:
         world_info,
     )
 
-    ok = initialize_multihost(f"localhost:{port}", 2, pid)
+    ok = initialize_multihost(f"localhost:{port}", n, pid)
     if not ok:
         raise RuntimeError("jax.distributed initialization did not run")
     info = world_info()
@@ -50,10 +53,11 @@ def main() -> None:
 
     params = make_params()
     B_local, L = 8, 256
-    rng = np.random.RandomState(7)  # same seed on both hosts
-    corpus = rng.randint(32, 127, size=(2 * B_local, L)).astype(np.uint8)
-    # cross-host duplicate: global row 12 (host 1) copies global row 3 (host 0)
-    corpus[12] = corpus[3]
+    rng = np.random.RandomState(7)  # same seed on every host
+    corpus = rng.randint(32, 127, size=(n * B_local, L)).astype(np.uint8)
+    # cross-host duplicate: a row on the LAST host copies row 3 (host 0)
+    dup_row = (n - 1) * B_local + 4
+    corpus[dup_row] = corpus[3]
     tokens = corpus[pid * B_local : (pid + 1) * B_local]
     lengths = np.full((B_local,), L, dtype=np.int32)
 
@@ -63,6 +67,7 @@ def main() -> None:
             {
                 "process_id": pid,
                 "world": info,
+                "dup_row": dup_row,
                 "rep": rep.tolist(),
                 "hist_sum": int(hist.sum()),
             }

@@ -1,12 +1,14 @@
-"""True multi-host test: two ``jax.distributed`` processes, one box.
+"""True multi-host test: N ``jax.distributed`` processes, one box.
 
 The reference exercises its only multi-node backend the same way — server
 and client both default to localhost (``server1.py:17-18``,
-``client1.py:14-15``).  Here each subprocess owns 4 virtual CPU devices
+``client1.py:14-15``).  Here each subprocess owns 8//N virtual CPU devices
 (8-device global world), contributes its local batch shard, and the global
 dedup must find a duplicate pair whose two members live on *different
 hosts* — which forces the candidate-resolution ``all_gather`` and the
 bucket-histogram ``psum`` across the process boundary (the DCN path).
+N=2 is the reference-shaped pair; N=4 exercises a wider world (more
+boundary crossings per collective, coordinator with >1 follower).
 """
 
 import json
@@ -27,19 +29,20 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_global_dedup():
+@pytest.mark.parametrize("n_procs", [2, 4])
+def test_multi_process_global_dedup(n_procs):
     port = _free_port()
     env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(pid), str(port)],
+            [sys.executable, _WORKER, str(pid), str(port), str(n_procs)],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             env=env,
             text=True,
         )
-        for pid in range(2)
+        for pid in range(n_procs)
     ]
     outs = []
     for p in procs:
@@ -53,17 +56,20 @@ def test_two_process_global_dedup():
         outs.append(json.loads(out.strip().splitlines()[-1]))
 
     by_pid = {o["process_id"]: o for o in outs}
-    assert set(by_pid) == {0, 1}
+    assert set(by_pid) == set(range(n_procs))
     for o in outs:
-        assert o["world"]["process_count"] == 2
+        assert o["world"]["process_count"] == n_procs
         assert o["world"]["global_devices"] == 8
         rep = o["rep"]
-        # cross-host duplicate: host 1's row 12 resolved to host 0's row 3
-        assert rep[12] == 3
+        total = len(rep)
+        dup_row = o["dup_row"]  # worker reports its geometry; don't mirror it
+        # cross-host duplicate: the last host's row resolved to host 0's row 3
+        assert rep[dup_row] == 3
         # everyone else is their own representative
-        assert all(rep[i] == i for i in range(16) if i != 12)
-        # 16 valid articles hashed into 16 bands each, merged over all shards
-        assert o["hist_sum"] == 16 * 16
-    # replicated outputs agree across hosts
-    assert by_pid[0]["rep"] == by_pid[1]["rep"]
-    assert by_pid[0]["hist_sum"] == by_pid[1]["hist_sum"]
+        assert all(rep[i] == i for i in range(total) if i != dup_row)
+        # every valid article hashed into 16 bands, merged over all shards
+        assert o["hist_sum"] == total * 16
+    # replicated outputs agree across all hosts
+    for o in outs[1:]:
+        assert o["rep"] == outs[0]["rep"]
+        assert o["hist_sum"] == outs[0]["hist_sum"]
