@@ -30,6 +30,52 @@ def _cmd_config(args: argparse.Namespace) -> int:
 
 def _cmd_dedup(args: argparse.Namespace) -> int:
     """Near-dup dedup of a newline-delimited text file (one doc per line)."""
+
+    def open_sink():
+        # opened only after the input is readable: creating it earlier
+        # would truncate a pre-existing output on any early failure
+        return (
+            open(args.output, "w", encoding="utf-8")
+            if args.output
+            else contextlib.nullcontext(sys.stdout)
+        )
+
+    if getattr(args, "index", None) and not getattr(args, "stream", False):
+        print("astpu dedup: --index requires --stream", file=sys.stderr)
+        return 2
+    if getattr(args, "stream", False):
+        # bounded-memory path: lines flow through the streaming batch
+        # backend (cross-batch stream index) instead of being read whole —
+        # the corpus never has to fit in host memory, and --index bloom
+        # fixes the index size forever (utils/bloom.py)
+        from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+
+        cfg = _with_overrides(
+            default_config().dedup,
+            backend=args.backend,
+            stream_index=getattr(args, "index", None),
+        )
+        kept = total = 0
+        with open(args.input, "r", encoding="utf-8", errors="replace") as f, (
+            open_sink()
+        ) as out:
+
+            def emit(rec: dict) -> None:
+                nonlocal kept
+                if rec.get("dup_of") is None and rec.get("near_dup_of") is None:
+                    kept += 1
+                    out.write(rec["article"] + "\n")
+
+            backend = TpuBatchBackend(cfg, sink=emit)
+            for i, line in enumerate(f):
+                total += 1
+                # line number as key: unique (exact stage idle), makes each
+                # line a referenceable near-dup target
+                backend.submit({"article": line.rstrip("\n"), "url": f"L{i}"})
+            backend.flush()
+        print(f"kept {kept}/{total} docs (streamed)", file=sys.stderr)
+        return 0
+
     from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
 
     cfg = _with_overrides(default_config().dedup, backend=args.backend)
@@ -38,12 +84,7 @@ def _cmd_dedup(args: argparse.Namespace) -> int:
         docs = [line.rstrip("\n") for line in f]
     reps = engine.dedup_reps(docs)
     kept = 0
-    sink = (
-        open(args.output, "w", encoding="utf-8")
-        if args.output
-        else contextlib.nullcontext(sys.stdout)
-    )
-    with sink as out:
+    with open_sink() as out:
         for i, r in enumerate(reps):
             if r == i:
                 kept += 1
@@ -318,6 +359,16 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument(
         "--backend", default=None, choices=["scan", "oph", "pallas"],
         help="signature backend (default: config; scan is measured-fastest)",
+    )
+    d.add_argument(
+        "--stream", action="store_true",
+        help="bounded-memory streaming dedup (corpus never read whole; "
+        "first-seen-wins across batches via the stream index)",
+    )
+    d.add_argument(
+        "--index", default=None, choices=["exact", "bloom"],
+        help="stream index: exact (attributed, grows with kept docs) or "
+        "bloom (LSHBloom, fixed memory forever); --stream only",
     )
     d.set_defaults(fn=_cmd_dedup)
 

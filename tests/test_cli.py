@@ -139,3 +139,40 @@ def test_enrich_simple_flag_disables_hardened(monkeypatch):
     assert main(["enrich", "--simple"]) == 0
     assert main(["enrich"]) == 0
     assert seen == [False, True]
+
+
+def test_dedup_stream_mode(tmp_path, capsys, monkeypatch):
+    """`astpu dedup --stream` must keep first-seen lines and drop exact and
+    near duplicates across batch boundaries without reading the corpus
+    whole, for both stream-index modes."""
+    import numpy as np
+
+    rng = np.random.RandomState(4)
+    base = "".join(chr(c) for c in rng.randint(97, 123, size=600))
+    near = base[:300] + "x" + base[301:]  # 1-char edit: well above threshold
+    uniq = ["".join(chr(c) for c in rng.randint(97, 123, size=600)) for _ in range(6)]
+    # duplicates placed far apart so they land in different device batches
+    lines = [base] + uniq[:3] + [near] + uniq[3:] + [base]
+    src = tmp_path / "docs.txt"
+    src.write_text("\n".join(lines) + "\n")
+
+    monkeypatch.setenv("ASTPU_DEDUP_BATCH_SIZE", "4")  # force multiple batches
+    for index in ("exact", "bloom"):
+        out = tmp_path / f"kept_{index}.txt"
+        assert main(
+            ["dedup", str(src), "-o", str(out), "--stream", "--index", index]
+        ) == 0
+        kept = out.read_text().splitlines()
+        assert base in kept, "first occurrence kept"
+        assert kept.count(base) == 1, "exact re-occurrence dropped"
+        assert near not in kept, "near duplicate dropped"
+        for u in uniq:
+            assert u in kept, "unique lines kept"
+    # --index without --stream is an explicit error, not a silent ignore
+    assert main(["dedup", str(src), "--index", "bloom"]) == 2
+    # a failing input must NOT truncate a pre-existing output
+    keep = tmp_path / "precious.txt"
+    keep.write_text("do not clobber\n")
+    with pytest.raises(FileNotFoundError):
+        main(["dedup", str(tmp_path / "missing.txt"), "-o", str(keep)])
+    assert keep.read_text() == "do not clobber\n"
