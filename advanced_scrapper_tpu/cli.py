@@ -66,11 +66,13 @@ def _cmd_dedup(args: argparse.Namespace) -> int:
                     kept += 1
                     out.write(rec["article"] + "\n")
 
-            backend = TpuBatchBackend(cfg, sink=emit)
+            # line-number keys are unique by construction: they make every
+            # line a referenceable near-dup target, and exact_stage=False
+            # keeps them OUT of the exact-key filter (in bloom mode they
+            # would saturate it into false drops at stream scale)
+            backend = TpuBatchBackend(cfg, sink=emit, exact_stage=False)
             for i, line in enumerate(f):
                 total += 1
-                # line number as key: unique (exact stage idle), makes each
-                # line a referenceable near-dup target
                 backend.submit({"article": line.rstrip("\n"), "url": f"L{i}"})
             backend.flush()
         print(f"kept {kept}/{total} docs (streamed)", file=sys.stderr)
@@ -146,6 +148,8 @@ def _cmd_match(args: argparse.Namespace) -> int:
         kw["use_screen"] = False
     if args.refine:
         kw["use_refine"] = True
+    if getattr(args, "workers", None) is not None:
+        kw["workers"] = args.workers
     try:
         return run_matcher(default_config().match, **kw)
     except ValueError as e:
@@ -402,6 +406,11 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument(
         "--refine", action="store_true",
         help="enable the device alignment-bound prune (see DESIGN.md §4)",
+    )
+    m.add_argument(
+        "--workers", type=int, default=None,
+        help="exact-verify process fan-out (0 = cpu_count, the reference's "
+        "mp.Pool width; 1 = inline; default: config verify_workers)",
     )
     m.set_defaults(fn=_cmd_match)
 

@@ -68,7 +68,14 @@ class TpuBatchBackend:
         text_field: str = "article",
         key_field: str = "url",
         sink: Callable[[dict], None] | None = None,
+        exact_stage: bool = True,
     ):
+        """``exact_stage=False`` skips the exact-key dup filter while keys
+        stay usable as near-dup targets — for callers whose keys are
+        unique BY CONSTRUCTION (e.g. the streaming dedup CLI's line
+        numbers).  Load-bearing in bloom mode: inserting millions of
+        never-colliding keys into the fixed-size url filter would
+        saturate it into false "exact dup" drops."""
         self.cfg = cfg or DedupConfig()
         self.params = make_params(
             num_perm=self.cfg.num_perm,
@@ -80,6 +87,7 @@ class TpuBatchBackend:
         self.text_field = text_field
         self.key_field = key_field
         self.sink = sink
+        self.exact_stage = exact_stage
         self.stats = BatchStats()
         self._buffer: list[dict] = []
         # cross-batch state — two interchangeable stream indexes:
@@ -239,7 +247,10 @@ class TpuBatchBackend:
 
         # exact stage: host dict over record keys (urls); bloom mode uses a
         # fixed-size 1-band filter over a url hash instead of the growing set
-        if self._bloom_mode:
+        if not self.exact_stage:
+            for rec in records:
+                rec["dup_of"] = None
+        elif self._bloom_mode:
             # 64-bit url hash: a collision here is an unverifiable false
             # "exact dup" drop, so 32-bit (crc32) key width was the dominant
             # error term at stream scale (~n/2³²)

@@ -121,6 +121,12 @@ def test_match_cli_flags(tmp_path, monkeypatch):
     seen.clear()
     assert main(["match"]) == 0
     assert seen == {}
+    seen.clear()
+    assert main(["match", "--workers", "3"]) == 0
+    assert seen == {"workers": 3}
+    seen.clear()
+    assert main(["match", "--workers", "0"]) == 0  # 0 = cpu_count, not "unset"
+    assert seen == {"workers": 0}
 
 
 def test_enrich_simple_flag_disables_hardened(monkeypatch):
@@ -157,6 +163,10 @@ def test_dedup_stream_mode(tmp_path, capsys, monkeypatch):
     src.write_text("\n".join(lines) + "\n")
 
     monkeypatch.setenv("ASTPU_DEDUP_BATCH_SIZE", "4")  # force multiple batches
+    from advanced_scrapper_tpu.config import default_config
+
+    # the cross-batch claim below rests on this env hook taking effect
+    assert default_config().dedup.batch_size == 4
     for index in ("exact", "bloom"):
         out = tmp_path / f"kept_{index}.txt"
         assert main(

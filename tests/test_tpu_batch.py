@@ -147,3 +147,34 @@ def test_stream_index_checkpoint_guards(tmp_path):
     other = TpuBatchBackend(DedupConfig(batch_size=4, block_len=512, seed=2))
     with pytest.raises(ValueError, match="different dedup config"):
         other.load_index(str(tmp_path / "x.npz"))
+
+
+def test_exact_stage_off_keeps_keys_as_near_dup_targets():
+    """exact_stage=False: repeated keys never mark dup_of (the caller
+    vouches keys are unique / meaningless for exact dedup), but keys still
+    attribute near-dup targets and identical text is caught by signatures.
+    In bloom mode this also keeps synthetic keys out of the fixed-size url
+    filter (saturation = false drops at stream scale)."""
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+
+    body = "the quick brown fox jumps over the lazy dog " * 8
+    other = "completely different text about markets and rates " * 8
+    for stream_index in ("exact", "bloom"):
+        backend = TpuBatchBackend(
+            DedupConfig(batch_size=4, stream_index=stream_index),
+            exact_stage=False,
+        )
+        out = []
+        out += backend.submit({"url": "K", "article": body})
+        out += backend.submit({"url": "K", "article": other})   # same key!
+        out += backend.submit({"url": "K2", "article": body})   # same text
+        out += backend.submit({"url": "K3", "article": "tiny"})
+        out += backend.flush()
+        by_key = {r["url"]: r for r in out}
+        assert by_key["K"]["dup_of"] is None  # repeated key not exact-dup'd
+        assert all(r["dup_of"] is None for r in out)
+        dup = by_key["K2"]
+        assert dup["near_dup_of"] is not None  # identical text caught
+        if stream_index == "exact":
+            assert dup["near_dup_of"] == "K"
