@@ -114,26 +114,66 @@ class NearDupEngine:
             w = bucket_len(max(len(r), 1), max_bucket=cfg.block_len)
             by_width.setdefault(w, []).append(i)
 
+        def host_batches():
+            # a generator: encode stays lazy, overlapping device dispatch
+            # in both consumption modes below
+            for w, idx in sorted(by_width.items()):
+                tok, lens, owners_local = encode_blocks(
+                    [raw[i] for i in idx], w, overlap=params.shingle_k - 1
+                )
+                owners = np.asarray(idx, np.int32)[owners_local]
+                n_blocks = tok.shape[0]
+                # cfg.batch_size keeps its pre-bucketing meaning — the peak
+                # device bytes per dispatch stay batch_size × block_len — so
+                # the row count scales up as the width bucket narrows.
+                bs = min(max(cfg.batch_size * cfg.block_len // w, 64), 16384)
+                for start in range(0, n_blocks, bs):
+                    t = tok[start : start + bs]
+                    l = lens[start : start + bs]
+                    o = owners[start : start + bs]
+                    if t.shape[0] < bs:
+                        pad = bs - t.shape[0]
+                        t = np.concatenate([t, np.zeros((pad, w), np.uint8)])
+                        l = np.concatenate([l, np.zeros((pad,), np.int32)])
+                        o = np.concatenate([o, np.zeros((pad,), np.int32)])
+                    yield (t, l, o)
+
+        # cfg.put_workers > 1 (ASTPU_DEDUP_PUT_WORKERS) issues the H2D puts
+        # from a thread pool: on transports where each put is a serialized
+        # round trip (see DESIGN.md §5 stream-tuning note) concurrent puts
+        # overlap that latency.  The min-combine is order-independent, so
+        # batch order never matters; the default (1) keeps the original
+        # inline put→accumulate interleaving untouched.
         running = jnp.full((n_bucket, params.num_perm), U32_MAX, jnp.uint32)
-        for w, idx in sorted(by_width.items()):
-            tok, lens, owners_local = encode_blocks(
-                [raw[i] for i in idx], w, overlap=params.shingle_k - 1
-            )
-            owners = np.asarray(idx, np.int32)[owners_local]
-            n_blocks = tok.shape[0]
-            # cfg.batch_size keeps its pre-bucketing meaning — the peak
-            # device bytes per dispatch stay batch_size × block_len — so the
-            # row count scales up as the width bucket narrows.
-            bs = min(max(cfg.batch_size * cfg.block_len // w, 64), 16384)
-            for start in range(0, n_blocks, bs):
-                t = tok[start : start + bs]
-                l = lens[start : start + bs]
-                o = owners[start : start + bs]
-                if t.shape[0] < bs:
-                    pad = bs - t.shape[0]
-                    t = np.concatenate([t, np.zeros((pad, w), np.uint8)])
-                    l = np.concatenate([l, np.zeros((pad,), np.int32)])
-                    o = np.concatenate([o, np.zeros((pad,), np.int32)])
+        if cfg.put_workers > 1:
+            from collections import deque
+            from concurrent.futures import ThreadPoolExecutor
+
+            def put(batch):
+                t, l, o = batch
+                return jax.device_put(t), jax.device_put(l), jax.device_put(o)
+
+            # bounded in-flight: at most put_workers+1 batches encoded /
+            # resident beyond the accumulate chain — Executor.map would
+            # drain the generator (and transfer the whole corpus) up front
+            with ThreadPoolExecutor(cfg.put_workers) as ex:
+                gen = host_batches()
+                pending: deque = deque()
+                for batch in gen:
+                    pending.append(ex.submit(put, batch))
+                    if len(pending) <= cfg.put_workers:
+                        continue
+                    t, l, o = pending.popleft().result()
+                    running = accumulate_block_signatures(
+                        running, block_fn(t, l, params), o, num_articles=n_bucket
+                    )
+                while pending:
+                    t, l, o = pending.popleft().result()
+                    running = accumulate_block_signatures(
+                        running, block_fn(t, l, params), o, num_articles=n_bucket
+                    )
+        else:
+            for t, l, o in host_batches():
                 t, l, o = jax.device_put(t), jax.device_put(l), jax.device_put(o)
                 running = accumulate_block_signatures(
                     running, block_fn(t, l, params), o, num_articles=n_bucket

@@ -137,3 +137,26 @@ def test_exact_dedup_fuzz_vs_pandas_mixed_group_sizes():
         items = [pool[rng.randint(pool_n)] for _ in range(n)]
         want = pd.DataFrame({"u": items}).drop_duplicates(subset=["u"]).index.tolist()
         assert ExactDedup().keep_indices(items) == want
+
+
+def test_ragged_put_workers_parity():
+    """cfg.put_workers issues H2D puts from a bounded thread pool; the
+    min-combine is order-independent, so signatures and reps must be
+    bit-identical to the default inline path on a ragged corpus."""
+    rng = np.random.RandomState(3)
+    docs = []
+    for i in range(60):
+        n = int(rng.randint(10, 9000))
+        docs.append(rng.randint(32, 127, size=n, dtype=np.uint8).tobytes())
+        if i and rng.rand() < 0.3:
+            docs.append(docs[rng.randint(0, len(docs))])
+
+    eng = NearDupEngine(DedupConfig(batch_size=8, block_len=1024))
+    base_sigs = eng.signatures(docs)
+    base_reps = eng.dedup_reps(docs)
+
+    threaded = NearDupEngine(
+        DedupConfig(batch_size=8, block_len=1024, put_workers=4)
+    )
+    np.testing.assert_array_equal(threaded.signatures(docs), base_sigs)
+    np.testing.assert_array_equal(threaded.dedup_reps(docs), base_reps)
