@@ -19,6 +19,13 @@ Prints ONE JSON line with three measured regimes:
 The baseline is the north-star target from BASELINE.json: 50,000
 articles/s on a TPU v5e-8 at ≥0.95 recall.  This driver runs on however
 many chips are visible (one, under the current harness).
+
+Sweep knobs (env):
+  ASTPU_BENCH_QUICK=1         small shapes for smoke runs
+  ASTPU_BENCH_BACKEND=...     scan (default) | oph | pallas
+  ASTPU_BENCH_BATCH=N         uniform/stream batch size (default 65536)
+  ASTPU_BENCH_FEED_WORKERS=N  DeviceFeed put threads for the stream regime
+  ASTPU_DEDUP_PUT_WORKERS=N   ragged-path H2D put threads (config knob)
 """
 
 from __future__ import annotations
