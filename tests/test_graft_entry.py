@@ -85,3 +85,20 @@ def test_child_fails_loud_instead_of_recursing():
     )
     assert proc.returncode != 0
     assert "need 4 devices" in proc.stderr
+
+
+def test_visible_device_count_distrusts_axon_hijack(monkeypatch):
+    """The r2 failure mode, pinned as a unit test: a CPU-mesh env with a
+    non-empty PALLAS_AXON_POOL_IPS must report 0 (the sitecustomize would
+    hijack the backend regardless of JAX_PLATFORMS), while the documented
+    empty-value disable and a clean env report the forced device count."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    assert graft._visible_device_count() == 0  # hijack: never trust the env
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "")
+    assert graft._visible_device_count() == 8  # empty = documented disable
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS")
+    assert graft._visible_device_count() == 8
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    assert graft._visible_device_count() == 0  # non-cpu platform: re-exec
