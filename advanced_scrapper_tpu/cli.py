@@ -71,9 +71,20 @@ def _cmd_dedup(args: argparse.Namespace) -> int:
             # keeps them OUT of the exact-key filter (in bloom mode they
             # would saturate it into false drops at stream scale)
             backend = TpuBatchBackend(cfg, sink=emit, exact_stage=False)
+            # Lines shorter than shingle_k can't produce a single shingle,
+            # so the near-dup stage passes them through untouched; with
+            # exact_stage=False that would keep every copy of e.g. a blank
+            # line — diverging from the whole-corpus path, which merges
+            # them.  Dedup those few byte-strings host-side by content.
+            short_seen: set[str] = set()
             for i, line in enumerate(f):
                 total += 1
-                backend.submit({"article": line.rstrip("\n"), "url": f"L{i}"})
+                text = line.rstrip("\n")
+                if len(text.encode("utf-8", "replace")) < cfg.shingle_k:
+                    if text in short_seen:
+                        continue
+                    short_seen.add(text)
+                backend.submit({"article": text, "url": f"L{i}"})
             backend.flush()
         print(f"kept {kept}/{total} docs (streamed)", file=sys.stderr)
         return 0

@@ -231,17 +231,59 @@ def build_certification_corpus(
     return [texts[i] for i in order]
 
 
+def oracle_reps(
+    texts: Sequence[str | bytes],
+    params: MinHashParams,
+    threshold: float,
+    *,
+    fast: bool = False,
+    pairs: set[tuple[int, int]] | None = None,
+) -> np.ndarray:
+    """Cluster representatives from the ORACLE's own pair set — i.e. what
+    "datasketch plus union-find" would keep (first-seen wins: every
+    cluster's rep is its smallest index).  This is the comparator for the
+    engine's precision: both sides threshold the same 128-lane estimator
+    and close transitively, so the engine's merged-pair precision is
+    certified against this clustering's, not against an unreachable 1.0.
+
+    ``pairs`` may carry a precomputed ``oracle_near_dup_pairs`` result —
+    the pair set is the expensive part, and recall metrics already
+    computed it for the same corpus.
+    """
+    parent = np.arange(len(texts))
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = int(parent[x])
+        return x
+
+    if pairs is None:
+        pairs = oracle_near_dup_pairs(texts, params, threshold, fast=fast)
+    for i, j in pairs:
+        ri, rj = find(i), find(j)
+        if ri != rj:
+            # union by min index so reps are first-seen, like the engine
+            lo, hi = (ri, rj) if ri < rj else (rj, ri)
+            parent[hi] = lo
+    return np.array([find(i) for i in range(len(texts))])
+
+
 def measured_recall(
     texts: Sequence[str | bytes],
     reps: np.ndarray,
     params: MinHashParams,
     threshold: float,
+    *,
+    pairs: set[tuple[int, int]] | None = None,
 ) -> tuple[float, int]:
     """(recall, n_oracle_pairs): fraction of datasketch-semantics near-dup
     pairs the engine clustered together (``reps`` from
     ``NearDupEngine.dedup_reps``).  The north-star bar is ≥0.95
-    (BASELINE.json)."""
-    pairs = oracle_near_dup_pairs(texts, params, threshold, fast=True)
+    (BASELINE.json).  ``pairs`` reuses a precomputed oracle pair set
+    (callers that also build ``oracle_reps`` share one computation)."""
+    if pairs is None:
+        pairs = oracle_near_dup_pairs(texts, params, threshold, fast=True)
     if not pairs:
         return 1.0, 0
     hit = sum(1 for i, j in pairs if reps[i] == reps[j])

@@ -113,6 +113,7 @@ class TpuBatchBackend:
                 1, bits=self.cfg.bloom_bits, num_hashes=self.cfg.bloom_hashes,
                 seed=self.cfg.seed + 1,
             )
+            self._bloom_fill_warned = False
         elif self.cfg.stream_index != "exact":
             raise ValueError(
                 f"unknown stream_index {self.cfg.stream_index!r}; use exact|bloom"
@@ -355,6 +356,29 @@ class TpuBatchBackend:
         dup = np.zeros(len(records), dtype=bool)
         if eligible.any():
             dup[eligible] = self._bloom.check_and_add_batch(keys[eligible])
+            # O(1) saturation gauge from the insert count (an actual
+            # fill_ratio() scan is O(filter bytes) — 1 GiB at 10M-doc
+            # sizing — far too hot for a per-batch check); the formula
+            # tracks the measured fill within a point (tools/soak_bloom.py)
+            import math
+
+            predicted_fill = 1.0 - math.exp(
+                -self._bloom.num_hashes * self._bloom.inserted / self._bloom.bits
+            )
+            if not self._bloom_fill_warned and predicted_fill > 0.5:
+                # past half fill the false-drop rate climbs steeply
+                # (measured curve in tools/soak_bloom.py / DESIGN.md);
+                # the fix is BloomBandIndex.for_capacity sizing
+                self._bloom_fill_warned = True
+                import sys
+
+                print(
+                    f"tpu_batch: bloom stream index past 50% fill "
+                    f"({self._bloom.inserted} docs inserted, predicted "
+                    f"false-drop rate {self._bloom.predicted_row_fp():.2%}); "
+                    f"size bloom_bits for the stream (for_capacity)",
+                    file=sys.stderr,
+                )
         for i, rec in enumerate(records):
             rec["near_dup_of"] = BLOOM_SENTINEL if dup[i] else None
             if dup[i]:

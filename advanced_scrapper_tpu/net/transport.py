@@ -184,14 +184,25 @@ class _WebDriverTransport:
     _ready_timeout: float = 10.0
 
     def fetch(self, url: str) -> str:
-        from selenium.webdriver.support.ui import WebDriverWait
-
         try:
             self._driver.get(url)
-            WebDriverWait(self._driver, self._ready_timeout).until(
-                lambda d: d.execute_script("return document.readyState") == "complete"
-            )
+            # readyState poll — selenium's WebDriverWait semantics (0.5 s
+            # poll, TimeoutException after the budget) implemented locally
+            # so the same code drives selenium drivers AND the stdlib wire
+            # client (net/webdriver.py), which has no selenium to import
+            deadline = time.monotonic() + self._ready_timeout
+            while (
+                self._driver.execute_script("return document.readyState")
+                != "complete"
+            ):
+                if time.monotonic() >= deadline:
+                    raise FetchError(
+                        f"timeout waiting for readyState complete on {url}"
+                    )
+                time.sleep(0.5)
             return self._driver.page_source
+        except FetchError:
+            raise
         except Exception as e:  # WebDriver raises many exception types
             raise FetchError(str(e)) from e
 
@@ -297,6 +308,29 @@ class StealthChromeTransport(_WebDriverTransport):
         self._ready_timeout = ready_state_timeout
 
 
+class WireFirefoxTransport(_WebDriverTransport):
+    """Headless Firefox via geckodriver over the FIRST-PARTY WebDriver wire
+    client (``net/webdriver.py``) — no selenium package needed.  Same
+    reference preferences and fetch contract as :class:`SeleniumTransport`;
+    ``remote_url`` attaches to an already-running driver (or grid/test
+    endpoint) instead of spawning geckodriver."""
+
+    def __init__(
+        self,
+        page_load_timeout: float = 30.0,
+        ready_state_timeout: float = 10.0,
+        executable_path: str = "geckodriver",
+        remote_url: str | None = None,
+    ):
+        from advanced_scrapper_tpu.net.webdriver import WireFirefoxDriver
+
+        self._driver = WireFirefoxDriver(
+            executable_path, remote_url=remote_url
+        )
+        self._driver.set_page_load_timeout(page_load_timeout)
+        self._ready_timeout = ready_state_timeout
+
+
 def stealth_chrome_available() -> bool:
     """True when the undetected-chromedriver package is importable."""
     try:
@@ -319,6 +353,14 @@ def selenium_available() -> bool:
     return shutil.which("geckodriver") is not None or os.path.exists("geckodriver")
 
 
+def geckodriver_available() -> bool:
+    """True when a geckodriver binary exists — all the wire transport
+    needs (the selenium package is optional with ``net/webdriver.py``)."""
+    import shutil
+
+    return shutil.which("geckodriver") is not None or os.path.exists("geckodriver")
+
+
 def make_transport(
     name: str = "auto",
     *,
@@ -327,10 +369,12 @@ def make_transport(
     pages=None,
     **kw,
 ):
-    """``auto`` prefers selenium (browser fidelity) and falls back to HTTP.
+    """``auto`` prefers browser fidelity and falls back to HTTP: selenium
+    if the package is installed, else the first-party wire client if a
+    geckodriver binary exists, else plain requests.
 
-    Timeouts map onto whichever transport is chosen: selenium gets both,
-    requests uses ``page_load_timeout`` as its request timeout.
+    Timeouts map onto whichever transport is chosen: browser transports get
+    both, requests uses ``page_load_timeout`` as its request timeout.
     """
     if name == "auto":
         if selenium_available():
@@ -342,6 +386,18 @@ def make_transport(
                 )
             except Exception:
                 pass  # broken browser stack → HTTP fallback, as documented
+        # fall-through, not elif: a selenium install that imports but fails
+        # to construct must still try the wire client before degrading to
+        # plain HTTP — the geckodriver binary is all the wire path needs
+        if geckodriver_available():
+            try:
+                return WireFirefoxTransport(
+                    page_load_timeout=page_load_timeout,
+                    ready_state_timeout=ready_state_timeout,
+                    **kw,
+                )
+            except Exception:
+                pass
         name = "requests"
     if name == "selenium":
         return SeleniumTransport(

@@ -13,16 +13,26 @@ Trade-offs vs the exact index (both are first-class; pick per workload):
 
 - **no attribution** — a Bloom hit says "a previously seen document shared
   this band", not *which* one, and no stored signature exists to verify
-  agreement against.  The false-drop rate has TWO terms: the filter's
-  ``ε_filter ≈ (1 - e^(-k·n/m))^k`` (< 1e-4 past ten million insertions at
-  the default 2²⁴ bits/band, k=4) **and the band-key collision rate**
-  ``ε_key ≈ n·num_bands/2^bits(key)`` — unverifiable here precisely
-  because nothing is stored.  With 32-bit keys ε_key dominates (~4% of
-  unique docs silently dropped at 10M); this index therefore expects
-  **uint64 keys** (``ops.lsh.band_keys_wide`` + :func:`pack_keys64`),
-  where ε_key ≈ 1e-11 at 10M and ε_filter dominates again.  uint32 keys
-  are still accepted for small/bounded streams.
-- **bounded memory** — 32 MiB total at defaults, forever.
+  agreement against.  The false-drop rate has TWO terms: the filter term
+  — per band ``ε_band = (1 - e^(-k·n/m))^k``, per ROW (any of ``nb``
+  bands hitting) ``ε_row = 1 - (1 - ε_band)^nb ≈ nb·ε_band`` — **and the
+  band-key collision rate** ``ε_key ≈ n·num_bands/2^bits(key)`` —
+  unverifiable here precisely because nothing is stored.  With 32-bit
+  keys ε_key dominates (~4% of unique docs silently dropped at 10M); this
+  index therefore expects **uint64 keys** (``ops.lsh.band_keys_wide`` +
+  :func:`pack_keys64`), where ε_key ≈ 1e-11 at 10M and the filter term
+  dominates.  uint32 keys are still accepted for small/bounded streams.
+- **capacity is a sizing decision, not a free lunch** — a Bloom filter
+  saturates: at the default 2²⁴ bits/band (k=4, 16 bands, 32 MiB total)
+  the MEASURED row false-drop rate is ~3e-3 at 500k kept docs, ~28% at
+  2M, and ~100% by 10M (saturated filters) — measured by
+  ``tools/soak_bloom.py`` (numbers in DESIGN.md), tracking the formula
+  above to within a few % at every checkpoint.  For a target stream size use
+  :meth:`BloomBandIndex.for_capacity`, which inverts the formula
+  (e.g. 10M kept docs at ε_row ≤ 1e-3 → 2²⁹ bits/band, 1 GiB total).
+  :meth:`fill_ratio` is the runtime saturation gauge; the streaming
+  backend warns once past 50% fill.
+- **bounded memory** — fixed at construction (32 MiB at defaults), forever.
 - **mergeable** — Bloom filters combine with bitwise OR, so per-shard /
   per-host indexes union exactly (the collective analogue of the band-key
   ``psum`` merge in ``parallel/sharded.py``).
@@ -96,6 +106,46 @@ class BloomBandIndex:
         # band content's uint64 key hash to different positions, so mixing
         # widths silently corrupts membership — fail loudly instead
         self.key_bits: int | None = None
+
+    @classmethod
+    def for_capacity(
+        cls,
+        capacity: int,
+        *,
+        num_bands: int = 16,
+        row_fp: float = 1e-3,
+        num_hashes: int = 4,
+        seed: int = 0,
+    ) -> "BloomBandIndex":
+        """Size the filters for ``capacity`` kept documents at a row-level
+        false-drop rate ≤ ``row_fp`` (inverts the saturation math in the
+        module docstring — measured to track it in ``tools/soak_bloom.py``).
+
+        Sizing, not magic: 10M docs at ε_row ≤ 1e-3 costs 2²⁹ bits/band
+        (1 GiB for 16 bands).  Memory stays fixed at that size forever.
+        """
+        import math
+
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        if not 0 < row_fp < 1:
+            raise ValueError("row_fp must be in (0, 1)")
+        eps_band = 1.0 - (1.0 - row_fp) ** (1.0 / num_bands)
+        k = num_hashes
+        denom = -math.log(1.0 - eps_band ** (1.0 / k))
+        bits = 1 << max(10, math.ceil(math.log2(k * capacity / denom)))
+        return cls(num_bands, bits=bits, num_hashes=num_hashes, seed=seed)
+
+    def predicted_row_fp(self, n: int | None = None) -> float:
+        """Formula row-level false-drop rate after ``n`` insertions
+        (default: what this index has actually inserted)."""
+        import math
+
+        n = self.inserted if n is None else n
+        eps_band = (1.0 - math.exp(-self.num_hashes * n / self.bits)) ** (
+            self.num_hashes
+        )
+        return 1.0 - (1.0 - eps_band) ** self.num_bands
 
     # -- core --------------------------------------------------------------
 

@@ -180,7 +180,25 @@ def test_dedup_stream_mode(tmp_path, capsys, monkeypatch):
             assert u in kept, "unique lines kept"
     # --index without --stream is an explicit error, not a silent ignore
     assert main(["dedup", str(src), "--index", "bloom"]) == 2
-    # a failing input must NOT truncate a pre-existing output
+
+
+def test_dedup_stream_short_lines(tmp_path):
+    """Lines shorter than shingle_k (blank lines, 'ok', …) can't form a
+    shingle, so the device near-dup stage passes them through; the stream
+    path must still merge identical copies host-side to match the
+    whole-corpus path's exact dedup."""
+    lines = ["", "ok", "a real long enough line of text here", "", "ok", "x"]
+    src = tmp_path / "docs.txt"
+    src.write_text("\n".join(lines) + "\n")
+    out = tmp_path / "kept.txt"
+    assert main(["dedup", str(src), "-o", str(out), "--stream"]) == 0
+    kept = out.read_text().splitlines()
+    assert kept.count("") == 1, "duplicate blank lines merged"
+    assert kept.count("ok") == 1, "duplicate short lines merged"
+    assert "x" in kept and "a real long enough line of text here" in kept
+
+
+def test_dedup_failing_input_does_not_clobber_output(tmp_path):
     keep = tmp_path / "precious.txt"
     keep.write_text("do not clobber\n")
     with pytest.raises(FileNotFoundError):

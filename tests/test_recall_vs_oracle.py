@@ -101,8 +101,12 @@ def test_near_dup_recall_certification_hardened():
     texts = build_certification_corpus(rng, 512)
     assert len(texts) == 2048
     assert max(len(t) for t in texts) >= 100_000  # blockwise combine forced
+    from advanced_scrapper_tpu.cpu.oracle import oracle_near_dup_pairs
+
     reps = NearDupEngine().dedup_reps(texts)
-    recall, n_pairs = measured_recall(texts, reps, PARAMS, 0.7)
+    # one oracle pair computation feeds recall AND the precision comparator
+    pairs = oracle_near_dup_pairs(texts, PARAMS, 0.7, fast=True)
+    recall, n_pairs = measured_recall(texts, reps, PARAMS, 0.7, pairs=pairs)
     assert n_pairs >= 900, "corpus must plant a statistically meaningful pair set"
     assert recall >= 0.95, f"hardened recall {recall:.4f} < 0.95 ({n_pairs} pairs)"
 
@@ -119,6 +123,31 @@ def test_near_dup_recall_certification_hardened():
     assert n_merged >= 900, "engine must have merged a meaningful pair set"
     assert n_unchained == 0, f"{n_unchained} members merged without a strong chain"
     assert precision >= 0.80, f"precision {precision:.4f} ({n_merged} pairs)"
+
+    # Comparator (VERDICT r3 item 3): the "identical behaviour to
+    # datasketch plus union-find" claim, MEASURED.  Score the oracle's own
+    # clustering with the same metric; the engine must be within ε of it.
+    # ε = 0.04 covers the measured per-corpus estimator variance at the
+    # Jaccard knee, where the two hash families (32-bit lanes vs 61-bit
+    # Mersenne) flip different coins on borderline cluster joins: over
+    # corpus seeds {7, 11, 13, 23} the gap was {+.032, +.010, −.004
+    # (engine BETTER), +.019} — noise around parity, not a one-sided
+    # defect.  The one-sided hard bar stays n_unchained == 0 above (and
+    # note the oracle itself scores u=1 on this corpus — the engine is
+    # the stricter of the two there).
+    from advanced_scrapper_tpu.cpu.oracle import oracle_reps
+
+    o_precision, o_merged, o_unchained = measured_precision(
+        texts,
+        oracle_reps(texts, PARAMS, 0.7, pairs=pairs),
+        PARAMS.shingle_k,
+        0.7,
+    )
+    assert o_merged >= 900
+    assert precision >= o_precision - 0.04, (
+        f"engine precision {precision:.4f} below oracle comparator "
+        f"{o_precision:.4f} − ε"
+    )
 
 
 def test_resolve_rep_bands_is_union_find_over_verified_edges():

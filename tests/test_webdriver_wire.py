@@ -1,0 +1,327 @@
+"""Wire-level WebDriver validation (VERDICT r3 item 4).
+
+The selenium package does not exist in this environment, so instead of
+object stubs in ``sys.modules`` these tests exercise the framework's
+first-party stdlib wire client (``net/webdriver.py``) against a local HTTP
+server speaking the REAL W3C WebDriver JSON protocol — session create with
+capabilities, navigate, execute/sync readyState scripts, page source,
+timeouts, delete session — i.e. the same bytes geckodriver exchanges with
+its clients (ref ``/root/reference/constant_rate_scrapper.py:136-156``).
+The :class:`DriverService` spawn path is exercised end-to-end with a fake
+geckodriver *binary* (a python script serving the protocol), covering
+spawn → /status readiness → session → fetch → quit → process exit.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import stat
+import sys
+import threading
+
+import pytest
+
+from advanced_scrapper_tpu.net.transport import (
+    FetchError,
+    WireFirefoxTransport,
+)
+
+
+# -- a real-protocol WebDriver server ---------------------------------------
+
+PROTOCOL_HANDLER_SRC = r'''
+import json
+import http.server
+
+
+class WebDriverHandler(http.server.BaseHTTPRequestHandler):
+    """Minimal but protocol-faithful W3C WebDriver endpoint."""
+
+    # class-level session state (one server instance per test)
+    sessions = {}
+    requests_seen = []
+    ready_polls_until_complete = 0
+    heights = [100]
+    neterror_urls = ()
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, code, value):
+        body = json.dumps({"value": value}).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n)) if n else {}
+
+    def do_GET(self):
+        cls = type(self)
+        cls.requests_seen.append(("GET", self.path, None))
+        if self.path == "/status":
+            return self._json(200, {"ready": True, "message": "fake ready"})
+        parts = self.path.strip("/").split("/")
+        if len(parts) == 3 and parts[0] == "session" and parts[2] == "source":
+            sess = cls.sessions.get(parts[1])
+            if sess is None:
+                return self._json(
+                    404, {"error": "invalid session id", "message": parts[1]}
+                )
+            return self._json(200, sess["source"])
+        return self._json(404, {"error": "unknown command", "message": self.path})
+
+    def do_POST(self):
+        cls = type(self)
+        payload = self._read()
+        cls.requests_seen.append(("POST", self.path, payload))
+        parts = self.path.strip("/").split("/")
+        if self.path == "/session":
+            sid = f"sess-{len(cls.sessions)}"
+            cls.sessions[sid] = {
+                "caps": payload,
+                "url": None,
+                "ready_polls": 0,
+                "h_ix": 0,
+                "source": "",
+            }
+            return self._json(
+                200,
+                {
+                    "sessionId": sid,
+                    "capabilities": payload.get("capabilities", {}).get(
+                        "alwaysMatch", {}
+                    ),
+                },
+            )
+        sess = cls.sessions.get(parts[1]) if len(parts) >= 2 else None
+        if sess is None:
+            return self._json(
+                404, {"error": "invalid session id", "message": self.path}
+            )
+        cmd = "/".join(parts[2:])
+        if cmd == "url":
+            url = payload["url"]
+            if any(marker in url for marker in cls.neterror_urls):
+                return self._json(
+                    500,
+                    {
+                        "error": "unknown error",
+                        "message": f"net::ERR_CONNECTION_REFUSED at {url}",
+                    },
+                )
+            sess["url"] = url
+            sess["ready_polls"] = 0
+            sess["h_ix"] = 0
+            sess["source"] = f"<html>page0 of {url}</html>"
+            return self._json(200, None)
+        if cmd == "execute/sync":
+            script = payload["script"]
+            if "readyState" in script:
+                sess["ready_polls"] += 1
+                done = sess["ready_polls"] > cls.ready_polls_until_complete
+                return self._json(200, "complete" if done else "loading")
+            if "return document.body.scrollHeight" in script:
+                ix = min(sess["h_ix"], len(cls.heights) - 1)
+                return self._json(200, cls.heights[ix])
+            if "scrollTo" in script:
+                sess["h_ix"] = min(sess["h_ix"] + 1, len(cls.heights) - 1)
+                sess["source"] = f"<html>page{sess['h_ix']}</html>"
+                return self._json(200, None)
+            return self._json(
+                400, {"error": "javascript error", "message": script}
+            )
+        if cmd == "timeouts":
+            sess["timeouts"] = payload
+            return self._json(200, None)
+        return self._json(404, {"error": "unknown command", "message": self.path})
+
+    def do_DELETE(self):
+        cls = type(self)
+        cls.requests_seen.append(("DELETE", self.path, None))
+        parts = self.path.strip("/").split("/")
+        if len(parts) >= 2 and cls.sessions.pop(parts[1], None) is not None:
+            return self._json(200, None)
+        return self._json(404, {"error": "invalid session id", "message": ""})
+'''
+
+# materialise the handler for in-process use (the same source is written
+# out as the fake geckodriver binary below, so binary and in-process
+# server can never drift apart)
+_ns: dict = {}
+exec(PROTOCOL_HANDLER_SRC, _ns)
+WebDriverHandler = _ns["WebDriverHandler"]
+
+
+@pytest.fixture()
+def wire_server():
+    """In-process protocol server; yields (url, handler_cls)."""
+
+    class Handler(WebDriverHandler):
+        sessions = {}
+        requests_seen = []
+        ready_polls_until_complete = 0
+        heights = [100]
+        neterror_urls = ()
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}", Handler
+    finally:
+        srv.shutdown()
+        t.join(timeout=5)
+
+
+def test_fetch_over_the_real_wire_protocol(wire_server):
+    url, handler = wire_server
+    handler.ready_polls_until_complete = 1  # first poll 'loading'
+    t = WireFirefoxTransport(
+        page_load_timeout=30.0, ready_state_timeout=5.0, remote_url=url
+    )
+    html = t.fetch("https://news.example/a.html")
+    assert html == "<html>page0 of https://news.example/a.html</html>"
+
+    # the wire really carried the protocol: session caps with the
+    # reference's Firefox prefs, a timeouts call, navigate, readyState
+    # scripts, source
+    creates = [p for m, p, b in handler.requests_seen if p == "/session" and m == "POST"]
+    assert creates, "New Session was posted"
+    caps = [
+        b
+        for m, p, b in handler.requests_seen
+        if m == "POST" and p == "/session"
+    ][0]["capabilities"]["alwaysMatch"]
+    prefs = caps["moz:firefoxOptions"]["prefs"]
+    assert prefs["permissions.default.image"] == 2  # ref :33-41
+    assert prefs["javascript.enabled"] is False
+    assert "-headless" in caps["moz:firefoxOptions"]["args"]
+    paths = [p for _, p, _ in handler.requests_seen]
+    sid = next(iter([p.split("/")[2] for p in paths if p.count("/") >= 2]))
+    assert f"/session/{sid}/timeouts" in paths
+    assert f"/session/{sid}/url" in paths
+    assert f"/session/{sid}/execute/sync" in paths
+    assert f"/session/{sid}/source" in paths
+
+    t.close()
+    # no trailing slash: the exact path real geckodriver routes
+    assert ("DELETE", f"/session/{sid}", None) in handler.requests_seen
+    assert not handler.sessions, "session deleted on close"
+
+
+def test_fetch_scrolled_until_height_stable(wire_server):
+    url, handler = wire_server
+    handler.heights = [100, 250, 250]
+    t = WireFirefoxTransport(remote_url=url)
+    html = t.fetch_scrolled("https://news.example/feed", settle_s=0.0)
+    # two scrolls: 100→250 (grew), 250→250 (stable, stop)
+    scrolls = [
+        b
+        for m, p, b in handler.requests_seen
+        if m == "POST" and p.endswith("execute/sync") and "scrollTo" in b["script"]
+    ]
+    assert len(scrolls) == 2
+    assert html == "<html>page2</html>"
+    t.close()
+
+
+def test_neterror_fingerprint_reaches_circuit_breaker(wire_server):
+    """A chrome-style net::ERR_* driver error must surface in str(FetchError)
+    so the engine's pause circuit keys on it (``pipeline/scraper.py:58-66``)."""
+    from advanced_scrapper_tpu.pipeline.scraper import _RATE_LIMIT_FINGERPRINTS
+
+    url, handler = wire_server
+    handler.neterror_urls = ("blocked",)
+    t = WireFirefoxTransport(remote_url=url)
+    with pytest.raises(FetchError) as ei:
+        t.fetch("https://news.example/blocked.html")
+    msg = str(ei.value)
+    assert "net::ERR_CONNECTION_REFUSED" in msg
+    assert any(fp in msg for fp in _RATE_LIMIT_FINGERPRINTS)
+    # the session survives an errored navigation: next fetch works
+    assert "page0" in t.fetch("https://news.example/ok.html")
+    t.close()
+
+
+def test_ready_state_timeout_is_fetch_error(wire_server):
+    url, handler = wire_server
+    handler.ready_polls_until_complete = 10**9  # never completes
+    t = WireFirefoxTransport(remote_url=url, ready_state_timeout=0.6)
+    with pytest.raises(FetchError, match="readyState"):
+        t.fetch("https://news.example/slow.html")
+    t.close()
+
+
+# -- DriverService: the spawn path against a fake geckodriver binary --------
+
+FAKE_BINARY_TEMPLATE = """#!{python}
+import argparse
+import http.server
+
+{handler_src}
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, required=True)
+    args = ap.parse_args()
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", args.port), WebDriverHandler)
+    srv.serve_forever()
+"""
+
+
+@pytest.fixture()
+def fake_geckodriver(tmp_path):
+    path = tmp_path / "geckodriver"
+    path.write_text(
+        FAKE_BINARY_TEMPLATE.format(
+            python=sys.executable, handler_src=PROTOCOL_HANDLER_SRC
+        )
+    )
+    path.chmod(path.stat().st_mode | stat.S_IXUSR)
+    return str(path)
+
+
+def test_driver_service_full_lifecycle(fake_geckodriver):
+    """spawn → /status readiness → session over a real socket → navigate →
+    page_source → quit → subprocess actually exits."""
+    t = WireFirefoxTransport(executable_path=fake_geckodriver)
+    service = t._driver._service
+    assert service is not None and service._proc.poll() is None
+    html = t.fetch("https://news.example/spawned.html")
+    assert "spawned.html" in html
+    t.close()
+    assert service._proc.poll() is not None, "driver process terminated"
+
+
+def test_driver_service_binary_that_dies_fails_fast(tmp_path):
+    from advanced_scrapper_tpu.net.webdriver import DriverService, WebDriverError
+
+    bad = tmp_path / "geckodriver"
+    bad.write_text(f"#!{sys.executable}\nraise SystemExit(3)\n")
+    bad.chmod(bad.stat().st_mode | stat.S_IXUSR)
+    with pytest.raises(WebDriverError, match="exited"):
+        DriverService(str(bad), startup_timeout=10.0)
+
+
+def test_make_transport_auto_picks_wire_client(fake_geckodriver, monkeypatch):
+    """Without the selenium package but with a geckodriver on PATH, `auto`
+    must choose the first-party wire transport, not silently fall back to
+    plain HTTP."""
+    from advanced_scrapper_tpu.net import transport as tr
+
+    assert not tr.selenium_available()  # true in this environment
+    monkeypatch.setenv(
+        "PATH", os.path.dirname(fake_geckodriver) + os.pathsep + os.environ["PATH"]
+    )
+    t = tr.make_transport("auto")
+    try:
+        assert isinstance(t, tr.WireFirefoxTransport)
+        assert "page0" in t.fetch("https://news.example/auto.html")
+    finally:
+        t.close()

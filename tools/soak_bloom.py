@@ -1,0 +1,94 @@
+"""LSHBloom soak: measured false-drop rate + flat memory at stream scale.
+
+VERDICT r3 item 6: the 10M-scale claims in ``utils/bloom.py`` were
+extrapolated from the Bloom formula, never measured.  This soak drives
+millions of synthetic band-key rows through :class:`BloomBandIndex`
+(vectorised numpy — no jax, no device) and, at checkpoints, probes with
+FRESH unique keys (ground truth: an exact index would keep every one), so
+every positive is a measured false drop.  It reports measured vs formula
+rate, fill ratio, and memory at each checkpoint.
+
+The corpus generator draws uniform uint64 keys, so intra-run band-key
+collisions (ε_key ≈ n·nb/2⁶⁴) are negligible and the measurement isolates
+the filter term — the term the module docstring's math describes.
+
+Usage:
+    python tools/soak_bloom.py                 # 10M keys, default 2^24 bits
+    python tools/soak_bloom.py 2000000         # 2M keys
+    python tools/soak_bloom.py 10000000 29     # 10M keys, 2^29 bits/band
+                                               # (the for_capacity sizing
+                                               # for 10M @ row_fp 1e-3)
+
+Prints one JSON line per checkpoint and a final summary line.
+"""
+
+from __future__ import annotations
+
+import json
+import resource
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from advanced_scrapper_tpu.utils.bloom import BloomBandIndex  # noqa: E402
+
+BATCH = 1 << 16
+PROBE = 200_000
+NUM_BANDS = 16
+
+
+def soak(n_keys: int, bits_log2: int, num_hashes: int = 4) -> dict:
+    ix = BloomBandIndex(NUM_BANDS, bits=1 << bits_log2, num_hashes=num_hashes)
+    rng = np.random.RandomState(17)
+    checkpoints = sorted(
+        {n_keys // 20, n_keys // 8, n_keys // 4, n_keys // 2, n_keys}
+    )
+    next_cp = 0
+    inserted = 0
+    mem0 = ix.memory_bytes
+    t0 = time.perf_counter()
+    out: list[dict] = []
+    while inserted < n_keys:
+        b = min(BATCH, n_keys - inserted)
+        # uniform uint64 keys: unique with overwhelming probability, so
+        # check_and_add_batch marking ANY row dup is a false drop
+        keys = rng.randint(0, 2**64, size=(b, NUM_BANDS), dtype=np.uint64)
+        ix.add_batch(keys)
+        inserted += b
+        if inserted >= checkpoints[next_cp]:
+            probe = rng.randint(0, 2**64, size=(PROBE, NUM_BANDS), dtype=np.uint64)
+            fp = float(ix.contains_batch(probe).mean())
+            rec = {
+                "inserted": inserted,
+                "measured_row_fp": round(fp, 6),
+                "predicted_row_fp": round(ix.predicted_row_fp(), 6),
+                "fill_ratio": round(ix.fill_ratio(), 4),
+                "memory_bytes": ix.memory_bytes,
+                "rss_mib": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024,
+                "elapsed_s": round(time.perf_counter() - t0, 1),
+            }
+            out.append(rec)
+            print(json.dumps(rec), flush=True)
+            while next_cp < len(checkpoints) and inserted >= checkpoints[next_cp]:
+                next_cp += 1
+    assert ix.memory_bytes == mem0, "index memory must never grow"
+    summary = {
+        "soak": "bloom",
+        "n_keys": n_keys,
+        "bits_per_band_log2": bits_log2,
+        "num_hashes": num_hashes,
+        "memory_flat": True,
+        "memory_bytes_total": mem0,
+        "checkpoints": out,
+    }
+    print(json.dumps(summary), flush=True)
+    return summary
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    bl = int(sys.argv[2]) if len(sys.argv) > 2 else 24
+    soak(n, bl)
