@@ -92,6 +92,16 @@ def _ragged_corpus(rng: np.random.RandomState, n: int) -> list[bytes]:
     return docs
 
 
+def _ragged_engine():
+    """The ragged-regime engine, built from env so the ASTPU_DEDUP_* sweep
+    knobs (notably ASTPU_DEDUP_PUT_WORKERS, the threaded-H2D axis) actually
+    reach it — ``NearDupEngine()`` raw defaults silently ignored them."""
+    from advanced_scrapper_tpu.config import DedupConfig, from_env
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    return NearDupEngine(from_env(DedupConfig, "dedup"))
+
+
 def _bench_ragged(n_articles: int, n_corpora: int = 4) -> float:
     """Steady-state streamed rate over several distinct warm corpora.
 
@@ -100,10 +110,8 @@ def _bench_ragged(n_articles: int, n_corpora: int = 4) -> float:
     corpus i's readback — the production firehose regime (the reference
     analogue never stalls between 20k-row chunks, match_keywords.py:227-230).
     Distinct corpora defeat transport-level (program, input) caching."""
-    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
-
     rng = np.random.RandomState(7)
-    engine = NearDupEngine()
+    engine = _ragged_engine()
     # corpus 0 warms every compiled shape (width buckets, block batches,
     # bucketed article axis); later corpora of the same config hit caches
     engine.dedup_reps(_ragged_corpus(rng, n_articles))

@@ -35,3 +35,43 @@ def test_profile_ragged_smoke(capsys):
     t.main(n_articles=64)
     out = capsys.readouterr().out
     assert "ragged 64 articles" in out and "articles/s one-shot" in out
+
+
+def test_sweep_onchip_snippets_and_dead_tunnel_abort(tmp_path, monkeypatch, capsys):
+    """The on-chip sweep driver: config snippets must stay importable/
+    formattable as the APIs they drive evolve, and a dead-transport probe
+    must abort the sweep with a recorded probe row instead of hanging."""
+    import sweep_onchip as t
+
+    # snippets format cleanly and reference real symbols
+    s = t.STREAM_SNIPPET.format(here=t.HERE, batch=64, block=64, n_batches=1, workers=1)
+    r = t.RAGGED_SNIPPET.format(here=t.HERE, put_workers=1, n_articles=8)
+    compile(s, "<stream>", "exec")
+    compile(r, "<ragged>", "exec")
+    assert "make_sharded_dedup" in s and "dedup_reps_async" in r
+
+    # dead tunnel: probe subprocess fails fast -> sweep aborts, row recorded
+    out = tmp_path / "sweep.jsonl"
+    monkeypatch.setattr(
+        t, "PROBE_SNIPPET", "import sys; sys.exit(3)"
+    )
+    monkeypatch.setattr(sys, "argv", ["sweep_onchip.py", "--out", str(out)])
+    try:
+        t.main()
+        raise AssertionError("expected SystemExit on dead probe")
+    except SystemExit as e:
+        assert e.code == 1
+    import json
+
+    rows = [json.loads(line) for line in out.read_text().splitlines()]
+    assert rows and rows[0]["config"] == "probe" and rows[0]["status"] == "error"
+
+
+def test_bench_ragged_engine_honors_put_workers_knob(monkeypatch):
+    """ASTPU_DEDUP_PUT_WORKERS must reach the ragged engine: bench once
+    built NearDupEngine() from raw defaults, silently ignoring the knob it
+    documents (and the sweep would have measured one config four times)."""
+    import bench
+
+    monkeypatch.setenv("ASTPU_DEDUP_PUT_WORKERS", "3")
+    assert bench._ragged_engine().cfg.put_workers == 3

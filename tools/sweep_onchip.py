@@ -1,0 +1,195 @@
+"""On-chip knob sweep orchestrator — run the moment the tunnel is healthy.
+
+VERDICT r3 item 1 wants BENCH_r04 captured on the chip with the ragged and
+stream regimes swept over their tuning knobs (feed workers, put workers,
+batch size).  The tunnel has repeatedly died mid-session, so this driver is
+built for hostile transport: every configuration runs in its OWN subprocess
+under a hard watchdog, results append to a JSONL file as they land, and a
+dead config (hang or transport error) is recorded and skipped rather than
+taking the sweep down.
+
+Usage:
+    python tools/sweep_onchip.py                # full sweep -> sweep_onchip.jsonl
+    python tools/sweep_onchip.py --quick        # 1/4-size shapes, short list
+    python tools/sweep_onchip.py --out PATH --timeout 900
+
+Interpret: take the best stream/ragged rows, set
+``ASTPU_BENCH_FEED_WORKERS`` / ``ASTPU_DEDUP_PUT_WORKERS`` /
+``ASTPU_BENCH_BATCH`` accordingly, then run ``python bench.py`` for the
+round record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROBE_SNIPPET = (
+    "import jax, json; d = jax.devices(); "
+    "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))"
+)
+
+STREAM_SNIPPET = """
+import json, os, sys, threading, time
+import numpy as np
+sys.path.insert(0, {here!r})
+import jax
+import bench
+from advanced_scrapper_tpu.core.hashing import make_params
+from advanced_scrapper_tpu.core.mesh import build_mesh
+from advanced_scrapper_tpu.cpu.hostbatch import HostBatcher
+from advanced_scrapper_tpu.parallel.sharded import make_sharded_dedup, shard_batch
+from advanced_scrapper_tpu.pipeline.feed import DeviceFeed
+
+batch, block, n_batches, workers = {batch}, {block}, {n_batches}, {workers}
+params = make_params()
+mesh = build_mesh(len(jax.devices()), 1)
+base, docs = bench._stream_corpus(batch, block)
+step = make_sharded_dedup(mesh, params, backend="scan")
+warm = shard_batch(base, np.full((batch,), block, np.int32), mesh)
+jax.block_until_ready(step(*warm))
+batcher = HostBatcher(block)
+feed = DeviceFeed(batcher, batch, depth=4, workers=workers)
+def produce():
+    for b in range(n_batches):
+        batcher.feed(docs, start_tag=b * batch, chunk=4096)
+    batcher.close()
+t0 = time.perf_counter()
+threading.Thread(target=produce, daemon=True).start()
+pending = []
+for n, tok_dev, len_dev, tags in feed:
+    rep, _h = step(tok_dev, len_dev)
+    try:
+        rep.copy_to_host_async()   # same readback overlap as bench._bench_stream
+    except AttributeError:
+        pass
+    pending.append((rep, tags, n))
+outs = [tags[np.asarray(rep)[:n]] for rep, tags, n in pending]
+dt = time.perf_counter() - t0
+feed.join()
+total = batch * n_batches
+assert sum(o.shape[0] for o in outs) == total
+print(json.dumps({{"articles_per_sec": round(total / dt, 1)}}))
+"""
+
+RAGGED_SNIPPET = """
+import json, os, sys, time
+import numpy as np
+sys.path.insert(0, {here!r})
+import jax
+import bench
+from advanced_scrapper_tpu.config import DedupConfig
+from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+n = {n_articles}
+rng = np.random.RandomState(7)
+# explicit config: NearDupEngine() raw defaults ignore env knobs
+engine = NearDupEngine(DedupConfig(put_workers={put_workers}))
+engine.dedup_reps(bench._ragged_corpus(rng, n))      # warm all shapes
+corpus = bench._ragged_corpus(rng, n)
+t0 = time.perf_counter()
+rep = np.asarray(engine.dedup_reps_async(corpus))[:n]
+dt = time.perf_counter() - t0
+print(json.dumps({{"articles_per_sec": round(n / dt, 1)}}))
+"""
+
+
+def run_config(tag: str, snippet: str, env: dict, timeout: float) -> dict:
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", snippet],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=HERE,
+        )
+    except subprocess.TimeoutExpired:
+        return {"config": tag, "status": "timeout", "elapsed_s": round(time.time() - t0, 1)}
+    rec: dict = {
+        "config": tag,
+        "status": "ok" if proc.returncode == 0 else "error",
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    if proc.returncode == 0:
+        try:
+            rec.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+        except (ValueError, IndexError):
+            rec["status"] = "unparseable"
+            rec["stdout_tail"] = proc.stdout[-300:]
+    else:
+        rec["stderr_tail"] = proc.stderr[-300:]
+    return rec
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(HERE, "sweep_onchip.jsonl"))
+    ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    env = dict(os.environ)  # default env: the axon chip when healthy
+
+    def emit(rec: dict) -> None:
+        print(json.dumps(rec), flush=True)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    # 0) transport probe under its own watchdog — if this fails, stop early
+    probe = run_config("probe", PROBE_SNIPPET, env, min(args.timeout, 300.0))
+    emit(probe)
+    if probe["status"] != "ok":
+        print("sweep: device probe failed — tunnel down, aborting", file=sys.stderr)
+        raise SystemExit(1)
+
+    batch = 16384 if args.quick else 65536
+    n_batches = 2 if args.quick else 4
+    ragged_n = 2048 if args.quick else 8192
+
+    for workers in (1, 2, 4, 8):
+        emit(
+            run_config(
+                f"stream:batch={batch},feed_workers={workers}",
+                STREAM_SNIPPET.format(
+                    here=HERE, batch=batch, block=1024,
+                    n_batches=n_batches, workers=workers,
+                ),
+                env,
+                args.timeout,
+            )
+        )
+    # batch-size axis at the best-known worker count
+    for b in ((8192, 32768) if args.quick else (16384, 32768, 131072)):
+        emit(
+            run_config(
+                f"stream:batch={b},feed_workers=4",
+                STREAM_SNIPPET.format(
+                    here=HERE, batch=b, block=1024,
+                    n_batches=n_batches, workers=4,
+                ),
+                env,
+                args.timeout,
+            )
+        )
+    for pw in (1, 2, 4, 8):
+        emit(
+            run_config(
+                f"ragged:n={ragged_n},put_workers={pw}",
+                RAGGED_SNIPPET.format(here=HERE, put_workers=pw, n_articles=ragged_n),
+                env,
+                args.timeout,
+            )
+        )
+    print(f"sweep complete -> {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
