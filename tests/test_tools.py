@@ -75,3 +75,67 @@ def test_bench_ragged_engine_honors_put_workers_knob(monkeypatch):
 
     monkeypatch.setenv("ASTPU_DEDUP_PUT_WORKERS", "3")
     assert bench._ragged_engine().cfg.put_workers == 3
+
+
+def test_watch_tunnel_knob_extraction(tmp_path):
+    """best_knobs must pick the winning stream row's batch/feed_workers and
+    the winning ragged row's put_workers from the sweep JSONL."""
+    import json
+
+    import watch_tunnel as t
+
+    rows = [
+        {"config": "probe", "status": "ok", "platform": "axon", "n": 1},
+        {"config": "stream:batch=65536,feed_workers=1", "status": "ok", "articles_per_sec": 100.0},
+        {"config": "stream:batch=32768,feed_workers=4", "status": "ok", "articles_per_sec": 900.0},
+        {"config": "stream:batch=131072,feed_workers=8", "status": "timeout"},
+        {"config": "ragged:n=8192,put_workers=2", "status": "ok", "articles_per_sec": 50.0},
+        {"config": "ragged:n=8192,put_workers=8", "status": "ok", "articles_per_sec": 70.0},
+    ]
+    p = tmp_path / "sweep.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    knobs = t.best_knobs(str(p))
+    assert knobs == {
+        "ASTPU_BENCH_BATCH": "32768",
+        "ASTPU_BENCH_FEED_WORKERS": "4",
+        "ASTPU_DEDUP_PUT_WORKERS": "8",
+    }
+    assert t.best_knobs(str(tmp_path / "missing.jsonl")) == {}
+
+
+def test_watch_tunnel_skips_malformed_lines_and_stale_file(tmp_path):
+    import json
+
+    import watch_tunnel as t
+
+    p = tmp_path / "sweep.jsonl"
+    p.write_text(
+        json.dumps({"config": "stream:batch=4096,feed_workers=2", "status": "ok", "articles_per_sec": 10.0})
+        + "\n{truncated"
+    )
+    assert t.best_knobs(str(p)) == {
+        "ASTPU_BENCH_BATCH": "4096",
+        "ASTPU_BENCH_FEED_WORKERS": "2",
+    }
+
+
+def test_watch_tunnel_capture_failure_returns_to_watching(tmp_path, monkeypatch):
+    """A sweep that aborts (dead tunnel mid-window) must NOT advance to
+    bench or end the watch — capture() reports failure."""
+    import types
+
+    import watch_tunnel as t
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        return types.SimpleNamespace(returncode=1)
+
+    monkeypatch.setattr(t.subprocess, "run", fake_run)
+    args = types.SimpleNamespace(
+        sweep_out=str(tmp_path / "s.jsonl"), bench_out=str(tmp_path / "b.json")
+    )
+    assert t.capture(args) is False
+    assert len(calls) == 1, "bench must not run after a failed sweep"
+    assert not (tmp_path / "b.json").exists()

@@ -1,0 +1,180 @@
+"""Watch the device tunnel; auto-capture on-chip numbers when it revives.
+
+The tunneled dev chip has died for whole sessions at a time (rounds 3-4),
+and healthy windows are unpredictable.  Rather than poll by hand, run this
+watcher detached: every ``--interval`` seconds it probes device discovery
+in a watchdogged subprocess (discovery HANGS on a dead tunnel — a timeout
+is the failure signal, so the probe must never run in-process), and on a
+healthy probe it fires, in order:
+
+1. ``tools/sweep_onchip.py --quick`` (knob ranking, ~minutes), then
+2. ``python bench.py`` with the winning knobs exported, saving the JSON
+   line to ``--bench-out`` (default ``onchip_bench.json`` next to this
+   repo's bench.py).
+
+Any failure or hang in either step logs and RETURNS TO WATCHING — a
+half-dead tunnel must never burn the remaining window.  The watcher exits
+only after a capture whose sweep and bench both succeeded, or at
+``--max-hours``.
+
+Usage:
+    nohup python tools/watch_tunnel.py > /tmp/tunnel_watch.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(HERE, "tools"))
+
+from sweep_onchip import PROBE_SNIPPET  # noqa: E402  (single probe source)
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe_once(timeout: float) -> dict | None:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_SNIPPET],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=HERE,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return None
+
+
+#: sweep-config prefix → (env var for its swept knob, knob name in config)
+_KNOB_MAP = {
+    "stream": (("ASTPU_BENCH_BATCH", "batch"), ("ASTPU_BENCH_FEED_WORKERS", "feed_workers")),
+    "ragged": (("ASTPU_DEDUP_PUT_WORKERS", "put_workers"),),
+}
+
+
+def best_knobs(sweep_path: str) -> dict[str, str]:
+    """Winning env knobs from the sweep JSONL: for each regime prefix, the
+    highest-rate ok row's knob values.  Malformed lines are skipped — the
+    sweep may have been killed mid-write."""
+    best: dict[str, tuple[float, dict[str, str]]] = {}
+    try:
+        with open(sweep_path) as f:
+            lines = f.readlines()
+    except OSError:
+        return {}
+    for line in lines:
+        try:
+            r = json.loads(line)
+        except ValueError:
+            continue
+        cfg = r.get("config", "")
+        prefix, _, rest = cfg.partition(":")
+        rate = r.get("articles_per_sec")
+        if r.get("status") != "ok" or rate is None or prefix not in _KNOB_MAP:
+            continue
+        if prefix not in best or rate > best[prefix][0]:
+            try:
+                parts = dict(p.split("=", 1) for p in rest.split(","))
+            except ValueError:
+                continue
+            best[prefix] = (rate, parts)
+    knobs: dict[str, str] = {}
+    for prefix, (_, parts) in best.items():
+        for env_var, key in _KNOB_MAP[prefix]:
+            if key in parts:
+                knobs[env_var] = parts[key]
+    return knobs
+
+
+def capture(args) -> bool:
+    """One sweep+bench attempt on a live tunnel.  True only on full success."""
+    # fresh sweep file: sweep_onchip APPENDS, and stale rows from an older
+    # (possibly healthier) window must not win the knob ranking
+    try:
+        os.remove(args.sweep_out)
+    except FileNotFoundError:
+        pass
+    try:
+        sweep = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(HERE, "tools", "sweep_onchip.py"),
+                "--quick",
+                "--timeout", "600",
+                "--out", args.sweep_out,
+            ],
+            cwd=HERE,
+            timeout=3 * 3600,
+        )
+    except subprocess.TimeoutExpired:
+        log("sweep hit its 3h watchdog — back to watching")
+        return False
+    if sweep.returncode != 0:
+        log(f"sweep exited {sweep.returncode} (tunnel died?) — back to watching")
+        return False
+    knobs = best_knobs(args.sweep_out)
+    env = dict(os.environ)
+    env.update(knobs)
+    log(f"sweep done; running bench.py with knobs {knobs}")
+    tmp_out = args.bench_out + ".tmp"
+    try:
+        with open(tmp_out, "w") as f:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(HERE, "bench.py")],
+                cwd=HERE,
+                env=env,
+                stdout=f,
+                timeout=2 * 3600,
+            )
+    except subprocess.TimeoutExpired:
+        log("bench.py hit its 2h watchdog — back to watching")
+        return False
+    if proc.returncode != 0:
+        log(f"bench.py exited {proc.returncode} — back to watching")
+        return False
+    os.replace(tmp_out, args.bench_out)  # only a finished run lands
+    log(f"bench.py ok; JSON in {args.bench_out}")
+    return True
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=300.0)
+    ap.add_argument("--probe-timeout", type=float, default=180.0)
+    ap.add_argument("--sweep-out", default=os.path.join(HERE, "sweep_onchip.jsonl"))
+    ap.add_argument("--bench-out", default=os.path.join(HERE, "onchip_bench.json"))
+    ap.add_argument("--max-hours", type=float, default=12.0)
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    attempt = 0
+    while time.time() < deadline:
+        attempt += 1
+        info = probe_once(args.probe_timeout)
+        if info is None or info.get("platform") in (None, "cpu"):
+            log(f"probe {attempt}: tunnel down ({info})")
+            time.sleep(args.interval)
+            continue
+        log(f"probe {attempt}: TUNNEL UP — {info}; starting quick sweep")
+        if capture(args):
+            return
+        time.sleep(args.interval)
+    log("watcher deadline reached with no healthy tunnel window")
+
+
+if __name__ == "__main__":
+    main()
