@@ -159,6 +159,12 @@ def _cmd_match(args: argparse.Namespace) -> int:
         kw["use_screen"] = False
     if args.refine:
         kw["use_refine"] = True
+    elif args.no_refine:
+        kw["use_refine"] = False
+    # neither flag: run_matcher's "auto" default (dispatch the bound only
+    # on batches whose survivor count clears the measured breakeven);
+    # --refine/--no-refine conflicts are rejected by their argparse
+    # mutually-exclusive group
     if getattr(args, "workers", None) is not None:
         kw["workers"] = args.workers
     try:
@@ -414,9 +420,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-screen", action="store_true",
         help="disable the TPU q-gram screen (pure reference scan)",
     )
-    m.add_argument(
+    refine_group = m.add_mutually_exclusive_group()
+    refine_group.add_argument(
         "--refine", action="store_true",
-        help="enable the device alignment-bound prune (see DESIGN.md §4)",
+        help="force the device alignment-bound prune on every batch "
+        "(default: auto — engages only past the measured breakeven pair "
+        "count; see DESIGN.md §4)",
+    )
+    refine_group.add_argument(
+        "--no-refine", action="store_true",
+        help="never run the alignment bound (use on tunneled/high-latency "
+        "device transports, where per-batch dispatch dominates)",
     )
     m.add_argument(
         "--workers", type=int, default=None,

@@ -237,3 +237,4 @@ def test_overlong_and_empty_patterns_pass_through():
     tlen = np.array([8, 8], dtype=np.int32)
     pruned = prune_mask(names, tok, tlen, np.array([0, 1]), threshold=95.0)
     assert not pruned.any()
+

@@ -385,3 +385,78 @@ def test_match_chunk_async_equals_sync_and_overlaps(tmp_path):
             assert norm(collect()) == norm(sync)
         finally:
             pool.shutdown()
+
+
+def test_refine_auto_mode_semantics(monkeypatch):
+    """Default "auto" (r4): the bound kernel dispatches only when a batch's
+    surviving pair count clears REFINE_AUTO_MIN_PAIRS; output is identical
+    to both forced modes either way, and invalid values fail loudly."""
+    import pandas as pd
+    import pytest
+
+    import advanced_scrapper_tpu.ops.editdist as ED
+    from advanced_scrapper_tpu.pipeline import matcher as M
+
+    entities = [
+        {
+            "id_label": "Apple Inc.",
+            "ticker": "AAPL",
+            "country": ["United States"],
+            "industry": [],
+            "aliases": ["Tim Cook", "Apple Inc."],
+            "products": ["iPhone"],
+            "subsidiaries": [],
+            "owned_entities": [],
+            "ceos": [],
+            "board_members": [],
+        }
+    ]
+    idx = M.EntityIndex(M.process_json_data(entities))
+    rows = [
+        {
+            "article_text": "Tim Cook spoke about the new iPhone lineup.",
+            "title": "daily wrap",
+            "date_time": "2020-06-01T00:00:00Z",
+            "url": f"https://x/{i}.html",
+            "source": "s",
+            "source_url": "su",
+        }
+        for i in range(8)
+    ]
+    df = pd.DataFrame(rows)
+
+    calls = {"n": 0}
+    real = ED.prune_mask_tables
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ED, "prune_mask_tables", counting)
+
+    # 8 rows × a couple of fuzzy names << 256 pairs: auto must not dispatch
+    out_auto = M.match_chunk(df, idx)  # default is "auto"
+    assert calls["n"] == 0, "auto must skip the bound below the breakeven"
+
+    calls["n"] = 0
+    out_forced = M.match_chunk(df, idx, use_refine=True)
+    assert calls["n"] > 0, "forced mode must dispatch regardless of count"
+    out_off = M.match_chunk(df, idx, use_refine=False)
+
+    def key(res):
+        return sorted((t, json_dumps(m)) for t, m, _ in res)
+
+    import json as _json
+
+    def json_dumps(m):
+        return _json.dumps(m, sort_keys=True)
+
+    assert key(out_auto) == key(out_forced) == key(out_off)
+
+    with pytest.raises(ValueError, match="auto"):
+        M.match_chunk(df, idx, use_refine="always")
+    # explicit always-on without the screen is a conflict; auto is not
+    with pytest.raises(ValueError, match="use_screen"):
+        M.match_chunk(df, idx, use_screen=False, use_refine=True)
+    out_noscreen = M.match_chunk(df, idx, use_screen=False)  # auto: fine
+    assert key(out_noscreen) == key(out_auto)

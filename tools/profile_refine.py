@@ -1,0 +1,117 @@
+"""Measure the ``use_refine`` verdict (matcher alignment-bound stage).
+
+``use_refine`` (ops/editdist.py Myers bound) prunes q-gram-screen
+survivors on device before the host scorer runs.  Round 3 measured it
+LOSING through the tunnel-attached chip (63 s vs 2.6 s screen-only on a
+256-row adversarial-decoy corpus) and attributed the loss to per-slice
+dispatch latency — a hypothesis this tool exists to settle on any
+backend (VERDICT r3 item 2):
+
+- on the CPU backend, dispatch is device-local (microseconds): if refine
+  still loses there, the problem is the stage itself, not the tunnel;
+- on the real chip with a healthy tunnel, this re-measures the original
+  verdict.
+
+The corpus is adversarial BY DESIGN: every article carries a q-gram decoy
+("Tim Cooperation booked …" contains every 3-gram of "Tim Cook" without a
+window scoring > 95), so the presence screen passes ~everything and the
+refine stage gets maximum opportunity to pay for itself.  On ordinary
+corpora the screen already prunes ~99% and refine has little left to win.
+
+Usage:
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python tools/profile_refine.py
+    python tools/profile_refine.py          # tunneled chip (default env)
+    python tools/profile_refine.py 512 32   # rows, entities
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+import pandas as pd
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def build_corpus(n_rows: int, n_entities: int, decoys: bool = True):
+    from advanced_scrapper_tpu.pipeline import matcher as M
+
+    entities = []
+    for e in range(n_entities):
+        entities.append(
+            {
+                "id_label": f"Company{e} Holdings",
+                "ticker": f"T{e}",
+                "country": ["United States"],
+                "industry": [],
+                "aliases": [f"Tim Cook{e}", f"Company{e} Inc."],
+                "products": [f"Widget{e}"],
+                "subsidiaries": [],
+                "owned_entities": [],
+                "ceos": [],
+                "board_members": [],
+            }
+        )
+    idx = M.EntityIndex(M.process_json_data(entities))
+    rng = np.random.RandomState(2)
+    rows = []
+    for i in range(n_rows):
+        body = "".join(chr(c) for c in rng.randint(97, 123, size=600))
+        if decoys:
+            # q-gram decoys for several entities: presence screen passes,
+            # only the alignment bound (or the host scorer) can reject
+            for e in range(0, n_entities, 4):
+                body += f" Tim Cooperation{e} booked gains."
+        if i % 6 == 0:
+            body += f" Tim Cook{i % n_entities} spoke about Widget{i % n_entities}."
+        rows.append(
+            {
+                "article_text": body,
+                "title": "daily wrap",
+                "date_time": "2020-06-01T00:00:00Z",
+                "url": f"https://x/{i}.html",
+                "source": "s",
+                "source_url": "su",
+            }
+        )
+    return pd.DataFrame(rows), idx
+
+
+def main(n_rows: int = 256, n_entities: int = 16) -> None:
+    import jax
+
+    from advanced_scrapper_tpu.pipeline.matcher import match_chunk
+
+    platform = jax.devices()[0].platform
+    for decoys in (True, False):
+        corpus = "adversarial" if decoys else "plain"
+        df, idx = build_corpus(n_rows, n_entities, decoys=decoys)
+        results = {}
+        for refine in (False, True, "auto"):
+            label = {False: "screen-only", True: "refine", "auto": "auto"}[refine]
+            match_chunk(df.head(32), idx, use_refine=refine)  # warm compile
+            t0 = time.perf_counter()
+            out = match_chunk(df, idx, use_refine=refine)
+            dt = time.perf_counter() - t0
+            results[label] = (dt, len(out))
+            print(
+                f"{platform} [{corpus:11s}]: {label:11s} {dt:7.2f}s "
+                f"({n_rows / dt:7.0f} rows/s, {len(out)} matches)",
+                flush=True,
+            )
+        (dt_s, n_s), (dt_r, n_r) = results["screen-only"], results["refine"]
+        (dt_a, n_a) = results["auto"]
+        assert n_s == n_r == n_a, "refine must be output-identical"
+        verdict = "refine WINS" if dt_r < dt_s else "refine loses"
+        print(
+            f"{platform} [{corpus}]: {verdict} "
+            f"({dt_r / dt_s:.2f}x screen-only wall time; "
+            f"auto {dt_a / min(dt_r, dt_s):.2f}x the better mode)"
+        )
+
+
+if __name__ == "__main__":
+    args = [int(a) for a in sys.argv[1:3]]
+    main(*args)
