@@ -131,10 +131,11 @@ class DedupConfig:
     #   still require sim_threshold agreement, so precision is unchanged.
     seed: int = 1            # datasketch's default seed for oracle parity
     backend: str = "scan"    # scan (dense, datasketch-parity) | oph | pallas
-    put_workers: int = 1     # H2D put threads for the ragged path: >1
-    #   overlaps per-put round trips on serializing transports (DESIGN §5
-    #   stream-tuning note); order-independent min-combine makes any
-    #   arrival order exact
+    put_workers: int = 0     # H2D put threads for the ragged path.
+    #   0 = auto: the transport default (core.mesh.auto_h2d_workers — 4 on
+    #   the serializing axon tunnel, 1 on local backends); >1 overlaps
+    #   per-put round trips (DESIGN §5 stream-tuning note);
+    #   order-independent min-combine makes any arrival order exact
     stream_index: str = "exact"  # exact (attributed, grows with stream) |
     #                              bloom (LSHBloom: fixed memory, no attribution)
     bloom_bits: int = 1 << 24    # bits per band filter (bloom mode)

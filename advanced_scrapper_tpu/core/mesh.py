@@ -50,3 +50,17 @@ def build_mesh(
         )
     grid = np.array(devs).reshape(data_parallel, seq_parallel)
     return Mesh(grid, (data_axis, seq_axis))
+
+
+def auto_h2d_workers() -> int:
+    """Default H2D-overlap thread count for the attached transport.
+
+    The tunneled dev chip (plugin platform ``axon``) serializes every
+    ``device_put`` into its own round trip (measured r2-r3, DESIGN.md §5);
+    overlapping puts from a few threads is the engineered response.  Local
+    backends (cpu, pcie-attached tpu) measured fastest with a single put
+    thread — extra threads only add handoff overhead when puts don't
+    serialize.  Config fields treat 0/None as "auto", resolved here, so
+    production defaults and bench defaults CANNOT diverge by transport.
+    """
+    return 4 if jax.devices()[0].platform == "axon" else 1

@@ -40,6 +40,18 @@ def _jump_rounds(n: int) -> int:
     return r
 
 
+def resolve_put_workers(cfg: DedupConfig) -> int:
+    """Effective H2D put-thread count: ``cfg.put_workers``, with 0 meaning
+    the transport default (``core.mesh.auto_h2d_workers`` — 4 on the
+    serializing axon tunnel, 1 on local backends).  Lives in the engine so
+    production configs and bench defaults resolve identically."""
+    if cfg.put_workers:
+        return cfg.put_workers
+    from advanced_scrapper_tpu.core.mesh import auto_h2d_workers
+
+    return auto_h2d_workers()
+
+
 class NearDupEngine:
     """Batch near-duplicate detector.
 
@@ -138,14 +150,16 @@ class NearDupEngine:
                         o = np.concatenate([o, np.zeros((pad,), np.int32)])
                     yield (t, l, o)
 
-        # cfg.put_workers > 1 (ASTPU_DEDUP_PUT_WORKERS) issues the H2D puts
-        # from a thread pool: on transports where each put is a serialized
-        # round trip (see DESIGN.md §5 stream-tuning note) concurrent puts
-        # overlap that latency.  The min-combine is order-independent, so
-        # batch order never matters; the default (1) keeps the original
-        # inline put→accumulate interleaving untouched.
+        # put_workers > 1 (ASTPU_DEDUP_PUT_WORKERS; 0 = transport auto —
+        # see resolve_put_workers) issues the H2D puts from a thread pool:
+        # on transports where each put is a serialized round trip (see
+        # DESIGN.md §5 stream-tuning note) concurrent puts overlap that
+        # latency.  The min-combine is order-independent, so batch order
+        # never matters; 1 keeps the original inline put→accumulate
+        # interleaving untouched.
+        put_workers = resolve_put_workers(cfg)
         running = jnp.full((n_bucket, params.num_perm), U32_MAX, jnp.uint32)
-        if cfg.put_workers > 1:
+        if put_workers > 1:
             from collections import deque
             from concurrent.futures import ThreadPoolExecutor
 
@@ -156,12 +170,12 @@ class NearDupEngine:
             # bounded in-flight: at most put_workers+1 batches encoded /
             # resident beyond the accumulate chain — Executor.map would
             # drain the generator (and transfer the whole corpus) up front
-            with ThreadPoolExecutor(cfg.put_workers) as ex:
+            with ThreadPoolExecutor(put_workers) as ex:
                 gen = host_batches()
                 pending: deque = deque()
                 for batch in gen:
                     pending.append(ex.submit(put, batch))
-                    if len(pending) <= cfg.put_workers:
+                    if len(pending) <= put_workers:
                         continue
                     t, l, o = pending.popleft().result()
                     running = accumulate_block_signatures(

@@ -45,14 +45,20 @@ class DeviceFeed:
         depth: int = 2,
         sharding=None,
         poll_timeout_ms: int = 200,
-        workers: int = 1,
+        workers: int | None = None,
     ):
         """``workers > 1`` runs several pop→device_put threads: on a
         transport whose per-put round trip serializes (the tunneled dev
         chip), concurrent puts overlap that latency.  Batches may then
         arrive out of submission order — safe for the dedup path, where
-        every batch is independent and tags ride with their batch."""
+        every batch is independent and tags ride with their batch.
+        ``None``/0 = the transport default (``core.mesh.auto_h2d_workers``)."""
         import jax
+
+        if not workers:
+            from advanced_scrapper_tpu.core.mesh import auto_h2d_workers
+
+            workers = auto_h2d_workers()
 
         self.batcher = batcher
         self.batch_size = batch_size
@@ -140,7 +146,7 @@ def stream_signatures(
     batch_size: int | None = None,
     prefer_native: bool = True,
     sig_bits: int = 32,
-    feed_workers: int = 1,
+    feed_workers: int | None = None,
 ) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Stream ``(tags, signatures, band_keys)`` batches for a document feed.
 
@@ -156,7 +162,8 @@ def stream_signatures(
     ``feed_workers > 1`` overlaps device_put round trips on serializing
     transports (see :class:`DeviceFeed`); batches may then arrive out of
     submission order, which this path tolerates — tags ride with their
-    batch and each batch's kernels are independent.
+    batch and each batch's kernels are independent.  ``None``/0 = the
+    transport default.
     """
     if sig_bits not in (16, 32):
         raise ValueError(f"sig_bits must be 16 or 32, got {sig_bits}")

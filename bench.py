@@ -95,7 +95,9 @@ def _ragged_corpus(rng: np.random.RandomState, n: int) -> list[bytes]:
 def _ragged_engine():
     """The ragged-regime engine, built from env so the ASTPU_DEDUP_* sweep
     knobs (notably ASTPU_DEDUP_PUT_WORKERS, the threaded-H2D axis) actually
-    reach it — ``NearDupEngine()`` raw defaults silently ignored them."""
+    reach it — ``NearDupEngine()`` raw defaults silently ignored them.
+    ``put_workers=0`` (the default) resolves per transport inside the
+    engine (``pipeline.dedup.resolve_put_workers``)."""
     from advanced_scrapper_tpu.config import DedupConfig, from_env
     from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
 
@@ -125,10 +127,15 @@ def _bench_ragged(n_articles: int, n_corpora: int = 4) -> float:
     return n_articles * n_corpora / dt
 
 
-def _feed_workers() -> int:
+def _feed_workers() -> int | None:
     """DeviceFeed worker count for the stream regime (and its profiler —
-    one lookup so the decomposition always matches the benchmark)."""
-    return int(os.environ.get("ASTPU_BENCH_FEED_WORKERS", "1"))
+    one lookup so the decomposition always matches the benchmark).
+    ``None`` (knob unset) defers to the product default:
+    ``DeviceFeed`` resolves it per transport via
+    ``core.mesh.auto_h2d_workers``, so bench measures exactly what
+    production defaults run."""
+    env = os.environ.get("ASTPU_BENCH_FEED_WORKERS")
+    return int(env) if env is not None else None
 
 
 def _stream_corpus(batch: int, block: int, seed: int = 3):

@@ -139,3 +139,37 @@ def test_watch_tunnel_capture_failure_returns_to_watching(tmp_path, monkeypatch)
     assert t.capture(args) is False
     assert len(calls) == 1, "bench must not run after a failed sweep"
     assert not (tmp_path / "b.json").exists()
+
+
+def test_transport_default_worker_resolution(monkeypatch):
+    """H2D-overlap worker counts resolve per transport IN THE PRODUCT LAYER
+    (0/None = auto), so production defaults and bench defaults cannot
+    diverge: 4 on the serializing axon tunnel, 1 on local backends; env
+    knobs and explicit values always win."""
+    import types
+
+    import jax
+
+    import bench
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.core.mesh import auto_h2d_workers
+    from advanced_scrapper_tpu.pipeline.dedup import resolve_put_workers
+
+    monkeypatch.delenv("ASTPU_BENCH_FEED_WORKERS", raising=False)
+    monkeypatch.delenv("ASTPU_DEDUP_PUT_WORKERS", raising=False)
+    assert auto_h2d_workers() == 1             # tests run on the cpu backend
+    assert bench._feed_workers() is None       # defer to the product layer
+    cfg = bench._ragged_engine().cfg
+    assert cfg.put_workers == 0 and resolve_put_workers(cfg) == 1
+
+    monkeypatch.setattr(
+        jax, "devices", lambda *a: [types.SimpleNamespace(platform="axon")]
+    )
+    assert auto_h2d_workers() == 4
+    assert resolve_put_workers(DedupConfig()) == 4
+    assert resolve_put_workers(DedupConfig(put_workers=1)) == 1  # explicit wins
+
+    monkeypatch.setenv("ASTPU_BENCH_FEED_WORKERS", "2")
+    monkeypatch.setenv("ASTPU_DEDUP_PUT_WORKERS", "7")
+    assert bench._feed_workers() == 2
+    assert resolve_put_workers(bench._ragged_engine().cfg) == 7
