@@ -64,7 +64,9 @@ class ScraperConfig:
     page_load_timeout: float = 30.0     # ref :139
     ready_state_timeout: float = 10.0   # ref :151
     result_timeout: float = 60.0        # ref :439
-    transport: str = "auto"  # auto|selenium|stealth-chrome|requests|mock
+    transport: str = "auto"  # auto|selenium|firefox-wire|chrome-wire|
+    #   stealth-chrome|requests|mock ("auto" = selenium → firefox-wire →
+    #   requests; the wire flavours need only a driver binary, no selenium)
     out_dir: str = "."
 
 

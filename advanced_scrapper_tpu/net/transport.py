@@ -331,6 +331,27 @@ class WireFirefoxTransport(_WebDriverTransport):
         self._ready_timeout = ready_state_timeout
 
 
+class WireChromeTransport(_WebDriverTransport):
+    """Headless plain Chrome via chromedriver over the wire client —
+    explicit opt-in (``--transport chrome-wire``), like every Chrome
+    substrate here; for anti-bot crawling use stealth-chrome instead."""
+
+    def __init__(
+        self,
+        page_load_timeout: float = 30.0,
+        ready_state_timeout: float = 10.0,
+        executable_path: str = "chromedriver",
+        remote_url: str | None = None,
+    ):
+        from advanced_scrapper_tpu.net.webdriver import WireChromeDriver
+
+        self._driver = WireChromeDriver(
+            executable_path, remote_url=remote_url
+        )
+        self._driver.set_page_load_timeout(page_load_timeout)
+        self._ready_timeout = ready_state_timeout
+
+
 def stealth_chrome_available() -> bool:
     """True when the undetected-chromedriver package is importable."""
     try:
@@ -401,6 +422,18 @@ def make_transport(
         name = "requests"
     if name == "selenium":
         return SeleniumTransport(
+            page_load_timeout=page_load_timeout,
+            ready_state_timeout=ready_state_timeout,
+            **kw,
+        )
+    if name == "firefox-wire":
+        return WireFirefoxTransport(
+            page_load_timeout=page_load_timeout,
+            ready_state_timeout=ready_state_timeout,
+            **kw,
+        )
+    if name == "chrome-wire":
+        return WireChromeTransport(
             page_load_timeout=page_load_timeout,
             ready_state_timeout=ready_state_timeout,
             **kw,

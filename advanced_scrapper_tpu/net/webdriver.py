@@ -67,7 +67,16 @@ def _http_json(
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return json.loads(resp.read().decode("utf-8"))
+            raw = resp.read().decode("utf-8", "replace")
+            try:
+                return json.loads(raw)
+            except ValueError as e:
+                # a proxy/captive portal or half-dead driver can 200 with
+                # an HTML body; surface it as a wire error, not a raw
+                # JSONDecodeError, so transports wrap it like any failure
+                raise WebDriverError(
+                    "invalid response", f"non-JSON body from {url}: {raw[:200]}"
+                ) from e
     except urllib.error.HTTPError as e:
         try:
             body = json.loads(e.read().decode("utf-8"))
@@ -216,30 +225,30 @@ FIREFOX_PREFS = {
 }
 
 
-class WireFirefoxDriver:
-    """geckodriver + headless Firefox over the wire client — the selenium
-    Firefox driver surface without selenium.  Pass ``remote_url`` to attach
-    to an already-running driver/grid endpoint instead of spawning one."""
+#: chromedriver analogues of the Firefox hardening: images and JS off
+CHROME_PREFS = {
+    "profile.managed_default_content_settings.images": 2,
+    "profile.managed_default_content_settings.javascript": 2,
+}
+
+
+class _WireDriver:
+    """Shared driver shell: owns an optional :class:`DriverService` and a
+    :class:`WireSession`, exposing the selenium driver surface the
+    transports consume.  Subclasses provide the vendor capability dict."""
 
     def __init__(
         self,
-        executable_path: str = "geckodriver",
-        *,
-        headless: bool = True,
-        prefs: dict | None = None,
-        remote_url: str | None = None,
+        executable_path: str,
+        capabilities: dict,
+        remote_url: str | None,
     ):
         self._service = None
         if remote_url is None:
             self._service = DriverService(executable_path)
             remote_url = self._service.url
-        opts: dict = {"prefs": dict(FIREFOX_PREFS, **(prefs or {}))}
-        if headless:
-            opts["args"] = ["-headless"]
         try:
-            self._session = WireSession(
-                remote_url, {"moz:firefoxOptions": opts}
-            )
+            self._session = WireSession(remote_url, capabilities)
         except BaseException:
             if self._service is not None:
                 self._service.stop()
@@ -265,3 +274,46 @@ class WireFirefoxDriver:
         finally:
             if self._service is not None:
                 self._service.stop()
+
+
+class WireFirefoxDriver(_WireDriver):
+    """geckodriver + headless Firefox over the wire client — the selenium
+    Firefox driver surface without selenium.  Pass ``remote_url`` to attach
+    to an already-running driver/grid endpoint instead of spawning one."""
+
+    def __init__(
+        self,
+        executable_path: str = "geckodriver",
+        *,
+        headless: bool = True,
+        prefs: dict | None = None,
+        remote_url: str | None = None,
+    ):
+        opts: dict = {"prefs": dict(FIREFOX_PREFS, **(prefs or {}))}
+        if headless:
+            opts["args"] = ["-headless"]
+        super().__init__(
+            executable_path, {"moz:firefoxOptions": opts}, remote_url
+        )
+
+
+class WireChromeDriver(_WireDriver):
+    """chromedriver + headless Chrome over the same wire protocol (the
+    plain-Chrome counterpart of the reference's experimental substrate —
+    anti-bot patching is :class:`StealthChromeTransport`'s job, not this
+    one's)."""
+
+    def __init__(
+        self,
+        executable_path: str = "chromedriver",
+        *,
+        headless: bool = True,
+        prefs: dict | None = None,
+        remote_url: str | None = None,
+    ):
+        opts: dict = {"prefs": dict(CHROME_PREFS, **(prefs or {}))}
+        if headless:
+            opts["args"] = ["--headless=new"]
+        super().__init__(
+            executable_path, {"goog:chromeOptions": opts}, remote_url
+        )

@@ -226,3 +226,24 @@ def test_mixed_key_widths_rejected():
     other.add_batch(np.array([[3, 4]], dtype=np.uint32))
     with pytest.raises(ValueError, match="bit"):
         idx.merge(other)
+
+
+def test_backend_bloom_fill_warning_fires_once(capsys):
+    """The streaming backend must warn (once) when the bloom index passes
+    predicted 50% fill — the operator's cue to resize via for_capacity.
+    Tiny filters make the threshold reachable in-test; the gauge is O(1)
+    (formula from inserted count), never a filter scan."""
+    cfg = DedupConfig(stream_index="bloom", bloom_bits=1 << 10, batch_size=32)
+    backend = TpuBatchBackend(cfg, exact_stage=False)
+    rng = np.random.RandomState(9)
+    for i in range(12):
+        docs = [
+            "".join(chr(c) for c in rng.randint(97, 123, size=64))
+            for _ in range(32)
+        ]
+        for j, d in enumerate(docs):
+            backend.submit({"article": d, "url": f"L{i}-{j}"})
+    backend.flush()
+    err = capsys.readouterr().err
+    assert err.count("past 50% fill") == 1, err
+    assert "for_capacity" in err

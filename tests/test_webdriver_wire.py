@@ -325,3 +325,84 @@ def test_make_transport_auto_picks_wire_client(fake_geckodriver, monkeypatch):
         assert "page0" in t.fetch("https://news.example/auto.html")
     finally:
         t.close()
+
+
+def test_chrome_wire_transport_over_protocol(wire_server):
+    """The chromedriver flavour rides the same wire: goog:chromeOptions
+    caps with images/JS off and --headless=new, same fetch contract."""
+    from advanced_scrapper_tpu.net.transport import WireChromeTransport
+
+    url, handler = wire_server
+    t = WireChromeTransport(remote_url=url)
+    assert "page0" in t.fetch("https://news.example/chrome.html")
+    caps = [
+        b for m, p, b in handler.requests_seen if m == "POST" and p == "/session"
+    ][0]["capabilities"]["alwaysMatch"]
+    opts = caps["goog:chromeOptions"]
+    assert opts["prefs"]["profile.managed_default_content_settings.images"] == 2
+    assert "--headless=new" in opts["args"]
+    t.close()
+
+
+def test_make_transport_explicit_wire_names(wire_server):
+    from advanced_scrapper_tpu.net import transport as tr
+
+    url, _h = wire_server
+    for name, cls in (
+        ("firefox-wire", tr.WireFirefoxTransport),
+        ("chrome-wire", tr.WireChromeTransport),
+    ):
+        t = tr.make_transport(name, remote_url=url)
+        try:
+            assert isinstance(t, cls)
+        finally:
+            t.close()
+
+
+def test_wire_session_survives_adversarial_server_responses():
+    """Wire-level hostility: non-JSON error bodies, empty bodies, missing
+    sessionId — every flavour must surface as WebDriverError (or FetchError
+    at the transport), never a raw JSONDecodeError/KeyError."""
+    import http.server
+    import threading
+
+    from advanced_scrapper_tpu.net.webdriver import WebDriverError, WireSession
+
+    class Hostile(http.server.BaseHTTPRequestHandler):
+        mode = "html_error"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            if type(self).mode == "html_error":
+                body = b"<html>502 Bad Gateway</html>"
+                self.send_response(502)
+            elif type(self).mode == "empty_ok":
+                body = b"{}"
+                self.send_response(200)
+            else:  # garbage_ok
+                body = b"not json at all"
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Hostile)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        for mode, match in (
+            ("html_error", "http 502"),
+            ("empty_ok", "session not created"),
+        ):
+            Hostile.mode = mode
+            with pytest.raises(WebDriverError, match=match):
+                WireSession(url)
+        Hostile.mode = "garbage_ok"
+        with pytest.raises(WebDriverError, match="invalid response"):
+            WireSession(url)
+    finally:
+        srv.shutdown()
