@@ -129,3 +129,79 @@ def test_malformed_json_drops_client_and_requeues():
         assert client.run(max_seconds=20) == 2
     finally:
         server.stop()
+
+
+def test_lease_central_parse_feeds_tpu_dedup(tmp_path):
+    """The reference's E8 composition, TPU-era: clients fetch raw HTML over
+    their own transports, the server parses centrally AND streams every
+    success into the TPU dedup backend via on_success — annotations are
+    computed centrally, regardless of which client fetched which copy (and
+    in whichever order their results arrived)."""
+    import numpy as np
+
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.extractors import load_extractor
+    from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+
+    rng = np.random.RandomState(11)
+
+    def page(body: str) -> str:
+        return ARTICLE_HTML.replace(
+            "record revenue for the third quarter.", body
+        )
+
+    base = "".join(chr(c) for c in rng.randint(97, 123, size=400))
+    other = "".join(chr(c) for c in rng.randint(97, 123, size=400))
+    third = "".join(chr(c) for c in rng.randint(97, 123, size=400))
+    # one planted duplicate pair (0, 5); everything else pairwise distinct
+    bodies = [base, other[:200] + base[:200], other, base[:50], third, base]
+    urls = [f"https://x/{i}.html" for i in range(len(bodies))]
+    pages = {u: page(b) for u, b in zip(urls, bodies)}
+
+    cfg = _cfg(batch_size=2, min_queue_length=1, client_threads=1)
+    server = LeaseServer(cfg, urls).start()
+    transports = [MockTransport(pages) for _ in range(2)]
+    try:
+        threads = []
+        for transport in transports:
+            c = LeaseClient(cfg, lambda t=transport: t, port=server.port)
+            t = threading.Thread(target=lambda c=c: c.run(max_seconds=20))
+            t.start()
+            threads.append(t)
+        assert server.wait_done(15)
+    finally:
+        server.stop()
+        for t in threads:
+            t.join(timeout=20)
+            assert not t.is_alive(), "lease client failed to finish"
+
+    # every url fetched exactly once across the client fleet
+    fetched = sorted(transports[0].fetched + transports[1].fetched)
+    assert fetched == sorted(urls)
+
+    annotated: list[dict] = []
+    backend = TpuBatchBackend(
+        DedupConfig(batch_size=4, block_len=512), sink=annotated.append
+    )
+    ok, bad = server.process_results(
+        load_extractor("yfin"),
+        str(tmp_path / "ok.csv"),
+        str(tmp_path / "bad.csv"),
+        on_success=backend.submit,
+    )
+    backend.flush()
+    assert ok == len(urls) and bad == 0
+    by_url = {r["url"]: r for r in annotated}
+    assert len(by_url) == len(urls)
+
+    def link_of(rec):
+        return rec["dup_of"] or rec["near_dup_of"]
+
+    # the planted pair is linked in ARRIVAL order, which two concurrent
+    # clients make nondeterministic — assert the link, not its direction
+    a, b = by_url[urls[0]], by_url[urls[5]]
+    assert {link_of(a), link_of(b)} == {None, urls[0]} or {
+        link_of(a), link_of(b)
+    } == {None, urls[5]}, (a, b)
+    for u in (urls[1], urls[2], urls[3], urls[4]):
+        assert link_of(by_url[u]) is None, f"distinct body {u} wrongly linked"

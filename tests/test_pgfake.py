@@ -173,3 +173,33 @@ def test_store_operations_commit_their_transactions(server):
     cur.execute("SELECT COUNT(*) FROM links")
     assert cur.fetchone()[0] == 2
     conn.close()
+
+
+def test_concurrent_store_writers_no_loss(server):
+    """The pollers write from several threads; WAL + busy timeout must
+    serialize store operations without losing inserts or deadlocking."""
+    import threading
+
+    links = LinkStore(DSN, driver=server)
+    n_threads, per_thread = 4, 25
+    errs: list[Exception] = []
+
+    def writer(t: int) -> None:
+        try:
+            for i in range(per_thread):
+                links.add_links([f"t{t}-u{i}"], now=1.0 + i)
+                if i % 5 == 0:
+                    links.mark_scraped(f"t{t}-u{i}")
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "writer thread hung (deadlock?)"
+    assert not errs, errs
+    total, done = links.counts()
+    assert total == n_threads * per_thread
+    assert done == n_threads * (per_thread // 5 + (1 if per_thread % 5 else 0))
