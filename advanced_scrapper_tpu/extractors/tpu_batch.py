@@ -358,25 +358,24 @@ class TpuBatchBackend:
             dup[eligible] = self._bloom.check_and_add_batch(keys[eligible])
             # O(1) saturation gauge from the insert count (an actual
             # fill_ratio() scan is O(filter bytes) — 1 GiB at 10M-doc
-            # sizing — far too hot for a per-batch check); the formula
-            # tracks the measured fill within a point (tools/soak_bloom.py)
-            import math
-
-            predicted_fill = 1.0 - math.exp(
-                -self._bloom.num_hashes * self._bloom.inserted / self._bloom.bits
-            )
-            if not self._bloom_fill_warned and predicted_fill > 0.5:
-                # past half fill the false-drop rate climbs steeply
-                # (measured curve in tools/soak_bloom.py / DESIGN.md);
-                # the fix is BloomBandIndex.for_capacity sizing
+            # sizing — far too hot for a per-batch check).  Keyed on the
+            # row false-drop RATE, not bit fill: at the defaults (k=4,
+            # 16 bands) 50% bit fill already means ~64% false drops —
+            # silent data loss starts orders of magnitude earlier, so the
+            # operator cue fires at a 1% predicted row FP.
+            if (
+                not self._bloom_fill_warned
+                and self._bloom.predicted_row_fp() > 0.01
+            ):
                 self._bloom_fill_warned = True
                 import sys
 
                 print(
-                    f"tpu_batch: bloom stream index past 50% fill "
-                    f"({self._bloom.inserted} docs inserted, predicted "
-                    f"false-drop rate {self._bloom.predicted_row_fp():.2%}); "
-                    f"size bloom_bits for the stream (for_capacity)",
+                    f"tpu_batch: bloom stream index predicted false-drop "
+                    f"rate {self._bloom.predicted_row_fp():.2%} after "
+                    f"{self._bloom.inserted} docs — rows are being "
+                    f"silently dropped as dups; size bloom_bits for the "
+                    f"stream (BloomBandIndex.for_capacity)",
                     file=sys.stderr,
                 )
         for i, rec in enumerate(records):

@@ -361,25 +361,36 @@ def stealth_chrome_available() -> bool:
     return True
 
 
+def _resolve_binary(name: str) -> str | None:
+    """PATH hit, else a cwd-relative file made absolute (the reference
+    ships geckodriver next to the scripts, ``.MISSING_LARGE_BLOBS:1-2``)
+    — ``Popen`` resolves bare names through PATH only, so a cwd hit must
+    be returned as an absolute path for the spawn to agree with us."""
+    import shutil
+
+    hit = shutil.which(name)
+    if hit is not None:
+        return hit
+    if os.path.isfile(name) and os.access(name, os.X_OK):
+        return os.path.abspath(name)
+    return None
+
+
 def selenium_available() -> bool:
     """True only when the whole stack exists: the selenium package AND a
     geckodriver binary (the external WebDriver shim the reference ships,
     ``.MISSING_LARGE_BLOBS:1-2``)."""
-    import shutil
-
     try:
         import selenium  # noqa: F401
     except ImportError:
         return False
-    return shutil.which("geckodriver") is not None or os.path.exists("geckodriver")
+    return _resolve_binary("geckodriver") is not None
 
 
 def geckodriver_available() -> bool:
     """True when a geckodriver binary exists — all the wire transport
     needs (the selenium package is optional with ``net/webdriver.py``)."""
-    import shutil
-
-    return shutil.which("geckodriver") is not None or os.path.exists("geckodriver")
+    return _resolve_binary("geckodriver") is not None
 
 
 def make_transport(
@@ -410,12 +421,13 @@ def make_transport(
         # fall-through, not elif: a selenium install that imports but fails
         # to construct must still try the wire client before degrading to
         # plain HTTP — the geckodriver binary is all the wire path needs
-        if geckodriver_available():
+        gecko = _resolve_binary("geckodriver")
+        if gecko is not None:
             try:
                 return WireFirefoxTransport(
                     page_load_timeout=page_load_timeout,
                     ready_state_timeout=ready_state_timeout,
-                    **kw,
+                    **{"executable_path": gecko, **kw},
                 )
             except Exception:
                 pass

@@ -167,9 +167,12 @@ def _free_port() -> int:
 class DriverService:
     """Own a driver binary subprocess (geckodriver / chromedriver).
 
-    Spawns ``[binary, --port, N]`` on a free port and polls ``GET /status``
+    Spawns ``[binary, --port=N]`` on a free port and polls ``GET /status``
     until the driver reports ready — the same contract selenium's
-    ``Service`` wraps."""
+    ``Service`` wraps.  The ``=`` form matters: geckodriver (clap) accepts
+    both ``--port N`` and ``--port=N``, but chromedriver's Chromium switch
+    parser only honours ``--port=N`` — with the space form it ignores the
+    value and binds its default port while the client polls a free one."""
 
     def __init__(
         self,
@@ -181,7 +184,7 @@ class DriverService:
         self.port = _free_port()
         self.url = f"http://127.0.0.1:{self.port}"
         self._proc = subprocess.Popen(
-            [binary, "--port", str(self.port), *args],
+            [binary, f"--port={self.port}", *args],
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
         )

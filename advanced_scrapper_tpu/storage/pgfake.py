@@ -12,7 +12,10 @@ cursors with ``rowcount`` — over per-database sqlite files in WAL mode
 (temp-dir backed, removed on ``close()``), with the Postgres dialect
 translated per statement:
 
-- ``%s`` placeholders → ``?``;
+- ``%s`` placeholders → ``?`` — textually, EVERY occurrence: a literal
+  ``%s`` inside a quoted string constant or LIKE pattern would be
+  rewritten too (none of the store surface does this; revisit with a
+  quote-aware scanner if store SQL grows string literals);
 - ``SELECT … FROM pg_database WHERE datname = %s`` → the server registry;
 - ``CREATE DATABASE "x"`` → a new shared in-memory database, refused
   inside a transaction exactly like the real server
@@ -205,8 +208,9 @@ class FakeCursor:
         translated = sql.replace("%s", "?")
         if _PG_DATABASE.search(translated):
             name = params[0] if params else None
-            self.rowcount = -1
             self._rows = [(1,)] if name and conn._server.exists(name) else []
+            # psycopg2 reports the SELECT's row count, not -1
+            self.rowcount = len(self._rows)
             self._from_list = True
             return self
         self._from_list = False

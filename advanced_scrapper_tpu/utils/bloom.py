@@ -31,7 +31,8 @@ Trade-offs vs the exact index (both are first-class; pick per workload):
   :meth:`BloomBandIndex.for_capacity`, which inverts the formula
   (e.g. 10M kept docs at ε_row ≤ 1e-3 → 2²⁹ bits/band, 1 GiB total).
   :meth:`fill_ratio` is the runtime saturation gauge; the streaming
-  backend warns once past 50% fill.
+  backend warns once :meth:`predicted_row_fp` crosses 1% (rate-keyed —
+  at the defaults 50% bit fill would already be ~64% false drops).
 - **bounded memory** — fixed at construction (32 MiB at defaults), forever.
 - **mergeable** — Bloom filters combine with bitwise OR, so per-shard /
   per-host indexes union exactly (the collective analogue of the band-key

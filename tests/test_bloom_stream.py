@@ -229,10 +229,12 @@ def test_mixed_key_widths_rejected():
 
 
 def test_backend_bloom_fill_warning_fires_once(capsys):
-    """The streaming backend must warn (once) when the bloom index passes
-    predicted 50% fill — the operator's cue to resize via for_capacity.
-    Tiny filters make the threshold reachable in-test; the gauge is O(1)
-    (formula from inserted count), never a filter scan."""
+    """The streaming backend must warn (once) when the bloom index's
+    predicted row false-drop rate crosses 1% — the operator's cue to
+    resize via for_capacity.  Keyed on the FP rate (not bit fill: 50%
+    fill at the defaults is already ~64% false drops).  Tiny filters make
+    the threshold reachable in-test; the gauge is O(1) (formula from
+    inserted count), never a filter scan."""
     cfg = DedupConfig(stream_index="bloom", bloom_bits=1 << 10, batch_size=32)
     backend = TpuBatchBackend(cfg, exact_stage=False)
     rng = np.random.RandomState(9)
@@ -245,5 +247,5 @@ def test_backend_bloom_fill_warning_fires_once(capsys):
             backend.submit({"article": d, "url": f"L{i}-{j}"})
     backend.flush()
     err = capsys.readouterr().err
-    assert err.count("past 50% fill") == 1, err
+    assert err.count("predicted false-drop rate") == 1, err
     assert "for_capacity" in err

@@ -327,6 +327,52 @@ def test_make_transport_auto_picks_wire_client(fake_geckodriver, monkeypatch):
         t.close()
 
 
+# Chromium switch parsing accepts only `--port=N`; the space form leaves the
+# switch value empty.  The strict fake mimics that so the spawn path cannot
+# regress to `--port N` (which real chromedriver rejects) unnoticed.
+STRICT_CHROME_BINARY_TEMPLATE = """#!{python}
+import sys
+import http.server
+
+{handler_src}
+
+if __name__ == "__main__":
+    port = None
+    for a in sys.argv[1:]:
+        if a.startswith("--port="):
+            port = int(a.split("=", 1)[1])
+    if port is None:  # `--port N` lands here, as with real chromedriver
+        sys.exit(1)
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", port), WebDriverHandler)
+    srv.serve_forever()
+"""
+
+
+@pytest.fixture()
+def fake_chromedriver(tmp_path):
+    path = tmp_path / "chromedriver"
+    path.write_text(
+        STRICT_CHROME_BINARY_TEMPLATE.format(
+            python=sys.executable, handler_src=PROTOCOL_HANDLER_SRC
+        )
+    )
+    path.chmod(path.stat().st_mode | stat.S_IXUSR)
+    return str(path)
+
+
+def test_driver_service_spawns_chromedriver_switch_form(fake_chromedriver):
+    """The chrome flavour's spawn path must pass `--port=N`: this fake exits
+    at startup on the space-separated form, exactly like real chromedriver."""
+    from advanced_scrapper_tpu.net.transport import WireChromeTransport
+
+    t = WireChromeTransport(executable_path=fake_chromedriver)
+    service = t._driver._service
+    assert service is not None and service._proc.poll() is None
+    assert "chrome-spawn.html" in t.fetch("https://news.example/chrome-spawn.html")
+    t.close()
+    assert service._proc.poll() is not None
+
+
 def test_chrome_wire_transport_over_protocol(wire_server):
     """The chromedriver flavour rides the same wire: goog:chromeOptions
     caps with images/JS off and --headless=new, same fetch contract."""
