@@ -90,10 +90,16 @@ def minhash_signatures(
 
     ``chunk=128`` is the measured-best scan granularity on v5e (2026-07
     sweep: ~845k articles/s full-step at [32768, 1024] vs ~715k at 512).
-    The kernel runs at VPU int-multiply saturation — the multiply-add per
-    (shingle, permutation) is irreducible for the dense formulation, and
-    the MXU cannot help (min-reduce is not a matmul); see ``ops/oph.py``
-    for the measured alternative that trades multiplies for a sort.
+    The multiply-add per (shingle, permutation) is irreducible for the
+    dense formulation and the MXU cannot help (min-reduce is not a
+    matmul), but the kernel is NOT at VPU ceiling: the measured 778k
+    articles/s works out to ~5% of the nominal v5e 32-bit VPU rate
+    (~17% counting int32-multiply decomposition into 16-bit passes) and
+    ~1.3% of HBM bandwidth — roofline arithmetic in DESIGN.md
+    "Roofline", MFU field in bench JSON.  Headroom exists in principle;
+    at 15.5× the 50k/s target it is not the binding constraint.  See
+    ``ops/oph.py`` for the measured alternative that trades multiplies
+    for a sort.
 
     ``ASTPU_MINHASH_BACKEND=pallas`` swaps in the fused Pallas kernel
     (``ops/pallas_minhash.py``) — bit-identical output, measured slower on

@@ -233,15 +233,20 @@ def _bench_recall(n_bases: int) -> tuple[float, int, float, float, int]:
     return recall, pairs, precision, precision_oracle, unchained
 
 
-def _bench_exact(n_urls: int) -> tuple[float, float]:
+def _bench_exact(n_urls: int) -> tuple[float, float, float, float]:
     """Exact-dedup throughput on URL-shaped rows, and the speedup vs the
     pandas path it byte-identically replaces (``drop_duplicates`` at
-    ``yahoo_links_selenium.py:174``).  Parity is asserted, not assumed."""
+    ``yahoo_links_selenium.py:174``).  Parity is asserted, not assumed.
+
+    Both sides are best-of-N over the SAME pinned corpus: the r4 record
+    showed a single-shot pandas timing fluctuating ~4× run-to-run
+    (exact_vs_pandas 1.43 → 0.29 while the device side moved <10%), so a
+    one-shot ratio is noise, not a metric.  Returns
+    ``(urls_per_s, ratio, exact_ms, pandas_ms)`` — absolute times travel
+    with the ratio so a swing is attributable from the JSON alone."""
     import pandas as pd
 
     from advanced_scrapper_tpu.pipeline.dedup import ExactDedup
-
-    rng = np.random.RandomState(29)
 
     def make_urls(seed: int) -> list[str]:
         r = np.random.RandomState(seed)
@@ -256,14 +261,25 @@ def _bench_exact(n_urls: int) -> tuple[float, float]:
     dedup = ExactDedup()
     dedup.keep_indices(make_urls(1))  # warm every compiled shape
     urls = make_urls(2)
-    t0 = time.perf_counter()
-    kept = dedup.keep_indices(urls)
-    dt = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    expected = pd.DataFrame({"url": urls}).drop_duplicates(subset=["url"]).index.tolist()
-    dt_pandas = time.perf_counter() - t0
+    best = best_pandas = float("inf")
+    kept = expected = None
+    for _ in range(5):
+        t0 = time.perf_counter()
+        kept = dedup.keep_indices(urls)
+        best = min(best, time.perf_counter() - t0)
+        # frame construction stays inside the timing: the reference path
+        # being replaced starts from the python list too (:174), and r1-r4
+        # measured it that way — changing the boundary would shift the
+        # ratio for a non-performance reason
+        t0 = time.perf_counter()
+        expected = (
+            pd.DataFrame({"url": urls})
+            .drop_duplicates(subset=["url"])
+            .index.tolist()
+        )
+        best_pandas = min(best_pandas, time.perf_counter() - t0)
     assert kept == expected, "exact dedup must stay byte-identical to pandas"
-    return n_urls / dt, dt_pandas / dt
+    return n_urls / best, best_pandas / best, best * 1e3, best_pandas * 1e3
 
 
 def _bench_matcher(n_articles: int) -> float:
@@ -318,16 +334,45 @@ def _bench_matcher(n_articles: int) -> float:
         }
     )
     pool = make_verify_pool(index)  # None on single-core hosts
+    dt = float("inf")
     try:
         match_chunk(df.head(64), index, pool=pool)  # warm compile
-        t0 = time.perf_counter()
-        out = match_chunk(df, index, pool=pool)
-        dt = time.perf_counter() - t0
+        for _ in range(3):  # best-of-N: single-shot swung 38% r3→r4
+            t0 = time.perf_counter()
+            out = match_chunk(df, index, pool=pool)
+            dt = min(dt, time.perf_counter() - t0)
     finally:
         if pool is not None:
             pool.shutdown()
     assert len(out) >= n_articles // 8, "planted mentions must match"
     return n_articles / dt
+
+
+#: v5e TensorCore clock derived from the public bf16 peak (197e12 FLOP/s =
+#: 2·128·128 per MXU · 4 MXUs · clock → 1.5 GHz); VPU nominal 32-bit rate =
+#: 8 sublanes × 128 lanes × 4 ALUs × clock.  Full derivation + HBM side in
+#: DESIGN.md "Roofline".
+V5E_VPU_PEAK_OPS = 8 * 128 * 4 * 1.5e9
+
+
+def _vpu_roofline(articles_per_s: float, block: int, params) -> dict:
+    """MFU-style utilisation of the headline kernel vs the v5e VPU.
+
+    Ops counted per (shingle, permutation): multiply + add + min = 3
+    32-bit lane ops for the ``a·h+b``/min update — the irreducible dense
+    work; the k-byte shingle hash adds ~2k ops per shingle (noise).  This
+    is the NOMINAL utilisation: TPU int32 multiplies decompose into
+    multiple VPU passes (~6-8 16-bit partials), so the hardware-cycle
+    utilisation is several times higher — both readings in DESIGN.md.
+    """
+    shingles = block - params.shingle_k + 1
+    ops_per_article = shingles * params.num_perm * 3 + shingles * 2 * params.shingle_k
+    achieved = articles_per_s * ops_per_article
+    return {
+        "vpu_ops_per_article": ops_per_article,
+        "vpu_achieved_ops_per_sec": round(achieved, 1),
+        "vpu_util_nominal": round(achieved / V5E_VPU_PEAK_OPS, 4),
+    }
 
 
 def _looks_like_transport_death(e: BaseException) -> bool:
@@ -476,8 +521,13 @@ def main() -> None:
             f"(precision {precision:.4f} vs oracle {precision_oracle:.4f}, "
             f"unchained {unchained})"
         )
-        exact, exact_vs_pandas = _bench_exact(16384 if quick else 262144)
-        note(f"exact done: {exact:.0f}/s ({exact_vs_pandas:.2f}x pandas)")
+        exact, exact_vs_pandas, exact_ms, pandas_ms = _bench_exact(
+            16384 if quick else 262144
+        )
+        note(
+            f"exact done: {exact:.0f}/s ({exact_vs_pandas:.2f}x pandas; "
+            f"{exact_ms:.1f}ms vs {pandas_ms:.1f}ms)"
+        )
         matcher = _bench_matcher(256 if quick else 1024)
         note(f"matcher done: {matcher:.0f}/s")
     except Exception as e:
@@ -512,7 +562,16 @@ def main() -> None:
                 "unchained_merges": unchained,
                 "exact_urls_per_sec": round(exact, 1),
                 "exact_vs_pandas": round(exact_vs_pandas, 3),
+                "exact_ms": round(exact_ms, 2),
+                "pandas_ms": round(pandas_ms, 2),
                 "matcher_articles_per_sec": round(matcher, 1),
+                # MFU-style utilisation is only meaningful against the v5e
+                # peak the constant describes — null on cpu-fallback rounds
+                **(
+                    _vpu_roofline(uniform, block, params)
+                    if platform not in ("cpu", "cpu-fallback")
+                    else {"vpu_util_nominal": None}
+                ),
             }
         )
     )

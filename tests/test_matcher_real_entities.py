@@ -1,0 +1,179 @@
+"""Golden runs over the reference's REAL entity corpus.
+
+The reference ships 220 committed Wikidata snapshots
+(``/root/reference/info/ticker/*.json``, written by
+``ticker_symbol_query.py:191-192``, consumed by
+``match_keywords.py:90-120``) and the S&P500 symbol list
+(``sp500list.csv``, read at ``ticker_symbol_query.py:196-201``).  The
+synthetic-entity tests prove parity on clean inputs; these drive the
+encoding-fallback chain, the ``(Start:…)/(End:…)`` parser, the
+name-class gates, and the fuzzy screen against the messy strings they
+were written for (VERDICT r4 item 7).  The data is read READ-ONLY at
+test time and every test skips when the reference tree is absent.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+REF_TICKER_DIR = "/root/reference/info/ticker"
+REF_SP500 = "/root/reference/sp500list.csv"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF_TICKER_DIR), reason="reference entity corpus absent"
+)
+
+
+@pytest.fixture(scope="module")
+def processed():
+    from advanced_scrapper_tpu.pipeline.matcher import read_info_dir
+
+    return read_info_dir(REF_TICKER_DIR)
+
+
+def test_real_corpus_loads_every_file(processed):
+    """All 220 snapshot files load through the encoding-fallback chain and
+    the US-company filter keeps a substantial corpus (one ticker per file
+    at most, some filtered entirely — e.g. files whose only entities are
+    non-US multi-entity lists)."""
+    from advanced_scrapper_tpu.pipeline.matcher import ATTRIBUTES
+
+    files = [f for f in os.listdir(REF_TICKER_DIR) if f.endswith(".json")]
+    assert len(files) == 220
+    assert len(processed) >= 100, f"only {len(processed)} tickers survived"
+    for ticker, attrs in processed.items():
+        assert set(attrs.keys()) == set(ATTRIBUTES), ticker
+
+
+def test_real_period_suffixes_parse(processed):
+    """Every ``(Start:…)``-suffixed string in the raw corpus must land in a
+    parsed period with a real datetime — the parser path the synthetic
+    tests only exercised on clean inputs."""
+    raw_with_start = 0
+    parsed_with_start = 0
+    for attrs in processed.values():
+        for periods in attrs.values():
+            for name, (start, end) in periods.items():
+                if start is not None:
+                    parsed_with_start += 1
+                    assert hasattr(start, "year"), (name, start)
+    for fn in sorted(os.listdir(REF_TICKER_DIR)):
+        if not fn.endswith(".json"):
+            continue
+        for enc in ("utf-8", "gbk", "latin1"):
+            try:
+                with open(os.path.join(REF_TICKER_DIR, fn), encoding=enc) as f:
+                    data = json.load(f)
+                break
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                continue
+        for company in data:
+            for v in company.values():
+                items = [v] if isinstance(v, str) else v
+                for s in items:
+                    if isinstance(s, str) and "(Start:" in s:
+                        raw_with_start += 1
+    assert raw_with_start > 100  # the corpus genuinely exercises the parser
+    # not every raw suffix survives the US-company filter; but the filter
+    # must not erase the parser's entire input class
+    assert parsed_with_start > 50
+
+
+def _plant_name(attrs) -> str | None:
+    """The longest index-storable display name for a ticker: mirrors the
+    EntityIndex gates (pure-lowercase-alpha skipped, 1-char uppers
+    skipped) so the plant is guaranteed screen-reachable."""
+    best = None
+    for attribute in ("id_label", "aliases"):
+        for name in attrs.get(attribute, {}):
+            if not name or len(name) < 6 or "(" in name:
+                continue
+            if name.isupper() and len(name) <= 1:
+                continue
+            if name.islower() and name.replace(" ", "").isalpha():
+                continue
+            if not name.isascii():
+                continue  # keep the filler-vocabulary contrast clean
+            if best is None or len(name) > len(best):
+                best = name
+    return best
+
+
+@pytest.fixture(scope="module")
+def planted(processed):
+    """One article per plantable ticker: neutral filler + the real entity
+    name verbatim (punctuation, suffixes and all)."""
+    rng = np.random.RandomState(11)
+    vocab = [
+        "".join(chr(97 + c) for c in rng.randint(0, 26, size=rng.randint(3, 9)))
+        for _ in range(800)
+    ]
+    rows, expect = [], []
+    for ticker in sorted(processed):
+        name = _plant_name(processed[ticker])
+        if name is None:
+            continue
+        words = [vocab[w] for w in rng.randint(0, len(vocab), size=180)]
+        words[40:40] = [name, "shares", "rose"]
+        rows.append(
+            {
+                "article": " ".join(words),
+                "title": f"markets wrap: {name}",
+                "datetime": "2020-01-02 10:00:00",
+            }
+        )
+        expect.append((ticker, name))
+    assert len(rows) >= 100, f"only {len(rows)} plantable tickers"
+    return pd.DataFrame(rows), expect
+
+
+def test_real_entities_match_planted_articles(processed, planted):
+    """≥100 real tickers round-trip: article text carrying the real name →
+    the matcher attributes it to that ticker.  Near-misses are triaged,
+    not tolerated: any miss rate above 2% fails."""
+    from advanced_scrapper_tpu.pipeline.matcher import EntityIndex, match_chunk
+
+    df, expect = planted
+    index = EntityIndex(processed)
+    out = match_chunk(df, index)
+    got = {}
+    for ticker, matches, record in out:
+        got.setdefault(record["title"], set()).add(ticker)
+    misses = [
+        (ticker, name)
+        for (ticker, name) in expect
+        if ticker not in got.get(f"markets wrap: {name}", set())
+    ]
+    assert len(misses) <= max(2, len(expect) // 50), f"missed: {misses[:10]}"
+
+
+def test_screen_parity_on_real_names(processed, planted):
+    """The TPU q-gram screen must not change results vs the pure reference
+    scan path on REAL name strings (commas, ampersands, dots, digits)."""
+    from advanced_scrapper_tpu.pipeline.matcher import EntityIndex, match_chunk
+
+    df, _expect = planted
+    sub = df.head(40)
+    index = EntityIndex(processed)
+    fast = match_chunk(sub, index, use_screen=True)
+    slow = match_chunk(sub, index, use_screen=False)
+    norm = lambda out: [(t, sorted(m), r["title"]) for t, m, r in out]
+    assert norm(fast) == norm(slow)
+
+
+def test_sp500_symbol_list_loads():
+    """The 504-row symbol CSV parses through the same DictReader surface
+    ``run_enrich`` uses (ref ticker_symbol_query.py:196-201)."""
+    import csv
+
+    if not os.path.exists(REF_SP500):
+        pytest.skip("sp500list.csv absent")
+    with open(REF_SP500, newline="", encoding="utf-8") as f:
+        symbols = [row["Symbol"] for row in csv.DictReader(f) if row.get("Symbol")]
+    assert len(symbols) >= 500
+    assert symbols[0] == "MMM"
+    assert all(s.strip() == s and s for s in symbols)

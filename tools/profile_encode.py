@@ -1,0 +1,90 @@
+"""Measure blockwise encode throughput: native C vs the Python oracle.
+
+The host half of the ragged regime is block assembly (ragged UTF-8 →
+fixed uint8[N, block] rows).  SURVEY §7 named the C++ batcher the hard
+part because the host must sustain the north-star 50k articles/s of
+block assembly or the device never sees enough work.  This driver
+measures exactly that, on the bench's ragged corpus distribution
+(mixed 300 B news briefs / 3 KB articles / 40 KB long reads — see
+bench.py), best-of-N on both paths.
+
+Run: PYTHONPATH=/root/repo python tools/profile_encode.py
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def ragged_corpus(n: int, seed: int = 7) -> list[bytes]:
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        r = rng.rand()
+        if r < 0.70:
+            size = rng.randint(200, 600)      # news brief
+        elif r < 0.95:
+            size = rng.randint(2000, 5000)    # standard article
+        else:
+            size = rng.randint(20000, 60000)  # long read
+        out.append(rng.randint(32, 127, size=size, dtype=np.uint8).tobytes())
+    return out
+
+
+def bestof(fn, n=5):
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main():
+    from advanced_scrapper_tpu.core import tokenizer
+    from advanced_scrapper_tpu.cpu.hostbatch import encode_blocks_native
+
+    block, overlap = 1024, 4
+    docs = ragged_corpus(8192)
+    total_bytes = sum(len(d) for d in docs)
+
+    native = encode_blocks_native(docs, block, overlap)
+    assert native is not None, "native hostbatch lib missing"
+
+    # Python oracle on a subsample (it is the slow path by design);
+    # measured by bypassing the native hook
+    sub = docs[:512]
+    import advanced_scrapper_tpu.cpu.hostbatch as hb
+
+    t_native = bestof(lambda: encode_blocks_native(docs, block, overlap))
+
+    real_native = hb.encode_blocks_native
+    hb.encode_blocks_native = lambda *a, **k: None
+    try:
+        t_py_sub = bestof(
+            lambda: tokenizer.encode_blocks(sub, block, overlap=overlap), n=3
+        )
+    finally:
+        hb.encode_blocks_native = real_native
+
+    arts_native = len(docs) / t_native
+    arts_py = len(sub) / t_py_sub
+    blocks = native[0].shape[0]
+    print(json.dumps({
+        "corpus_docs": len(docs),
+        "corpus_mb": round(total_bytes / 1e6, 1),
+        "blocks": int(blocks),
+        "block_len": block,
+        "native_s": round(t_native, 4),
+        "native_articles_per_s": round(arts_native),
+        "native_mb_per_s": round(total_bytes / t_native / 1e6, 1),
+        "python_articles_per_s": round(arts_py),
+        "speedup": round(arts_native / arts_py, 1),
+        "vs_50k_target": round(arts_native / 50000, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()
