@@ -130,7 +130,23 @@ class DedupConfig:
     sim_threshold: float = 0.70  # signature-agreement verification threshold
     cand_subbands: int = 32  # extra fine candidate bands (128/32 = 4 rows:
     #   near-certain candidacy at the threshold knee; 0 disables.  Merges
-    #   still require sim_threshold agreement, so precision is unchanged.
+    #   still require signature-agreement verification.
+    fine_margin: float = 0.0  # extra estimator bar on FINE-ONLY edges
+    #   (candidate pairs sharing no coarse band — outside datasketch's
+    #   candidacy class; ops.lsh.fine_edge_thresholds) in the paths that
+    #   CANNOT exact-verify (async firehose, streaming backend — old-side
+    #   texts are gone there).  Estimator-only margins cannot meet the
+    #   precision budget (measured frontier: tools/sweep_fine_margin.py);
+    #   the certified one-shot path uses exact_verify_band instead.
+    exact_verify_band: float = 0.72  # one-shot dedup_reps: every fine-only
+    #   edge, and every coarse edge with agreement < this band, is
+    #   confirmed by EXACT shingle-set Jaccard on host before resolution
+    #   (borderline estimator verdicts are noise, σ≈0.04 at 128 perms).
+    #   Measured (DESIGN.md §2e): recall 0.952, precision oracle+0.01 on
+    #   the hardened corpus at ~130 exact checks per 2048 docs.  0 disables.
+    exact_verify_cap: int = 8192  # max exact-Jaccard checks per corpus —
+    #   beyond it remaining borderline edges keep their estimator verdict
+    #   (a pathological all-borderline corpus must not degrade to O(n²))
     seed: int = 1            # datasketch's default seed for oracle parity
     backend: str = "scan"    # scan (dense, datasketch-parity) | oph | pallas
     put_workers: int = 0     # H2D put threads for the ragged path.

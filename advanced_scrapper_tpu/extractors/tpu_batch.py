@@ -122,6 +122,7 @@ class TpuBatchBackend:
         self._buckets: dict[tuple[int, int], int] = {}  # (band, key) -> sig idx
         self._kept_sigs: list[np.ndarray] = []
         self._kept_keys: list[str] = []
+        self._kept_coarse: list[np.ndarray] = []  # uint32[nb] coarse keys
 
     # -- checkpoint/resume -------------------------------------------------
 
@@ -214,14 +215,18 @@ class TpuBatchBackend:
             self._kept_keys = [str(k) for k in data["kept_keys"].tolist()]
             sigs = data["kept_sigs"]
             self._kept_sigs = [sigs[i].copy() for i in range(sigs.shape[0])]
-        # buckets are a pure function of the kept signatures: recompute the
-        # same candidate keys the insertion path used, first-seen wins
+        # buckets (and the coarse-key gate rows) are a pure function of the
+        # kept signatures: recompute the same candidate keys the insertion
+        # path used, first-seen wins
         self._buckets = {}
+        self._kept_coarse = []
         if sigs.shape[0]:
             keys = np.asarray(
                 candidate_keys(sigs, self.params.band_salt, self.cfg.cand_subbands)
             )
+            nb = self.params.num_bands
             for i in range(keys.shape[0]):
+                self._kept_coarse.append(keys[i, :nb].copy())
                 for b in range(keys.shape[1]):
                     self._buckets.setdefault((b, int(keys[i, b])), i)
 
@@ -314,13 +319,24 @@ class TpuBatchBackend:
             if len(texts[i].encode("utf-8", "replace")) < self.params.shingle_k:
                 continue  # no shingles: never bucket
             candidate = None
+            nb = self.params.num_bands
             for b in range(keys.shape[1]):
                 idx = self._buckets.get((b, int(keys[i, b])))
-                if idx is not None:
-                    agree = float(np.mean(self._kept_sigs[idx] == sigs[i]))
-                    if agree >= thresh:
-                        candidate = self._kept_keys[idx]
-                        break
+                if idx is None:
+                    continue
+                # per-edge bar, same rule as the batch engine
+                # (ops.lsh.fine_edge_thresholds): a fine-band hit with no
+                # shared coarse band is outside datasketch's candidacy
+                # class and must clear sim_threshold + fine_margin
+                bar = thresh
+                if b >= nb and not (
+                    keys[i, :nb] == self._kept_coarse[idx]
+                ).any():
+                    bar = thresh + self.cfg.fine_margin
+                agree = float(np.mean(self._kept_sigs[idx] == sigs[i]))
+                if agree >= bar:
+                    candidate = self._kept_keys[idx]
+                    break
             if candidate is not None:
                 rec["near_dup_of"] = candidate
                 self.stats.near_dups += 1
@@ -328,6 +344,7 @@ class TpuBatchBackend:
                 sig_idx = len(self._kept_sigs)
                 # copy: a row view would pin the whole batch array forever
                 self._kept_sigs.append(sigs[i].copy())
+                self._kept_coarse.append(keys[i, :nb].copy())
                 self._kept_keys.append(_key_of(rec, self.key_field))
                 for b in range(keys.shape[1]):
                     self._buckets.setdefault((b, int(keys[i, b])), sig_idx)

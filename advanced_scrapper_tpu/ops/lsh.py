@@ -86,9 +86,20 @@ def band_keys_wide(sig: jnp.ndarray, band_salt: jnp.ndarray) -> jnp.ndarray:
 
 def _run_head_per_band(
     kt: jnp.ndarray, idxb: jnp.ndarray
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """For each band row (axis 1 = batch): sorted keys → run-head and
-    run-predecessor indices, ``(si, head_sorted, pred_sorted)``."""
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """For each band row (axis 1 = batch): sorted keys → run-head,
+    run-predecessor, and run-predecessor² indices,
+    ``(si, head_sorted, pred_sorted, pred2_sorted)``.
+
+    The predecessor² link (two sorted positions back WITHIN the run, self
+    otherwise) exists to jump one failing intermediate: in a bucket run
+    ``[d, x, e, y]`` where the decoys ``d``/``e`` verify against nobody,
+    head and pred links leave ``x``—``y`` unconnected even though both are
+    bucket members (datasketch candidacy) with agreement ≥ threshold —
+    pred² links ``y`` straight to ``x``.  Measured on the hardened knee
+    corpus this closes most of the co-bucketed recall the fine-only
+    bridge edges used to carry (tools/sweep_fine_margin.py, DESIGN.md).
+    """
     nb, B = kt.shape
     sk, si = jax.lax.sort((kt, idxb), dimension=1, num_keys=2)
     seg_start = jnp.concatenate(
@@ -105,7 +116,13 @@ def _run_head_per_band(
     pred_sorted = jnp.where(
         seg_start, si, jnp.concatenate([si[:, :1], si[:, :-1]], axis=1)
     )
-    return si, head_sorted, pred_sorted
+    shift2 = jnp.concatenate([si[:, :2], si[:, :-2]], axis=1)
+    same_run2 = jnp.concatenate(
+        [jnp.zeros((nb, 2), dtype=bool), seg_id[:, 2:] == seg_id[:, :-2]],
+        axis=1,
+    )
+    pred2_sorted = jnp.where(same_run2, shift2, si)
+    return si, head_sorted, pred_sorted, pred2_sorted
 
 
 @jax.jit
@@ -126,7 +143,7 @@ def duplicate_reps(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     keys = jnp.where(valid[:, None], keys, U32_MAX)
     kt = keys.T
     idxb = jnp.broadcast_to(idx, (nb, B))
-    si, rep_sorted, _pred = _run_head_per_band(kt, idxb)
+    si, rep_sorted, _pred, _pred2 = _run_head_per_band(kt, idxb)
     rep_band = jax.vmap(
         lambda s, r: jnp.zeros((B,), dtype=jnp.int32).at[s].set(r)
     )(si, rep_sorted)
@@ -139,8 +156,8 @@ def duplicate_reps(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
 
 @jax.jit
 def duplicate_rep_bands(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
-    """Per-band candidate representatives: ``int32[B, 2*nb]`` (run head +
-    run predecessor per band).
+    """Per-band candidate representatives: ``int32[B, 3*nb]`` (run head +
+    run predecessor + run predecessor² per band).
 
     Unlike :func:`duplicate_reps` (which min-reduces across bands BEFORE
     verification), this keeps every band's candidates independent so the
@@ -159,15 +176,16 @@ def duplicate_rep_bands(keys: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     # Head links alone under-connect a run — i and j may verify against
     # each other but not against the head (datasketch's union-find merges
     # any pairwise path); predecessor links chain consecutive run members
-    # so those pairs survive.
-    si, head_sorted, pred_sorted = _run_head_per_band(kt, idxb)
+    # so those pairs survive, and predecessor² links jump one failing
+    # intermediate (see _run_head_per_band).
+    si, head_sorted, pred_sorted, pred2_sorted = _run_head_per_band(kt, idxb)
     cands = []
-    for cand_sorted in (head_sorted, pred_sorted):
+    for cand_sorted in (head_sorted, pred_sorted, pred2_sorted):
         cand = jax.vmap(
             lambda s, r: jnp.zeros((B,), dtype=jnp.int32).at[s].set(r)
         )(si, cand_sorted)
         cands.append(jnp.where(valid[None, :], cand, idxb).T)
-    return jnp.concatenate(cands, axis=1)  # int32[B, 2*nb]
+    return jnp.concatenate(cands, axis=1)  # int32[B, 3*nb]
 
 
 @partial(jax.jit, static_argnames=("jump_rounds",))
@@ -196,11 +214,19 @@ def resolve_rep_bands(
     Label propagation: pull the min label along edges, push it back with a
     scatter-min, then pointer-double — symmetric, monotone, and fixpoint =
     component min within ``jump_rounds`` ≥ ceil(log2(B)) rounds.
-    Precision is unchanged — a merge still requires agreement ≥
-    ``threshold`` — candidates that fail verification contribute no edge.
+    A merge still requires signature agreement — candidates that fail
+    verification contribute no edge.  ``threshold`` may be a scalar, a
+    per-candidate-COLUMN ``float32[nc]`` vector, or a per-EDGE
+    ``float32[B, nc]`` array (:func:`fine_edge_thresholds`): fine-only
+    edges — pairs sharing no
+    coarse band, which datasketch's banding never proposes — verify
+    against a higher bar, recovering the precision their extra candidacy
+    gives up (measured sweep in DESIGN.md).
     """
     B, nc = rep_bands.shape
     idx = jnp.arange(B, dtype=jnp.int32)
+    thr = jnp.asarray(threshold, jnp.float32)
+    thr = jnp.broadcast_to(thr, (nc,) if thr.ndim < 2 else (B, nc))
     # Verify in candidate-axis chunks: the full [B, nc, P] gather would be
     # ~nc× the signature footprint (51 GB at nc=96 over a 2^20 bucket);
     # chunked, the peak transient stays at [B, 8, P] — the same order as
@@ -209,7 +235,8 @@ def resolve_rep_bands(
     for c0 in range(0, nc, 8):
         cand_sig = jnp.take(sig, rep_bands[:, c0 : c0 + 8], axis=0)
         agree = (sig[:, None, :] == cand_sig).mean(axis=2)
-        ok_parts.append(agree >= threshold)
+        thr_c = thr[..., c0 : c0 + 8]
+        ok_parts.append(agree >= (thr_c if thr_c.ndim == 2 else thr_c[None, :]))
     # an edge needs BOTH endpoints valid: invalid rows (padding, sub-k
     # texts) must neither merge nor be merged into, structurally — not
     # just because their all-U32_MAX signatures happen to disagree
@@ -263,6 +290,114 @@ def candidate_keys(
         )
     fine = band_keys(sig, jnp.asarray(subband_salt(cand_subbands)))
     return jnp.concatenate([keys, fine], axis=1)
+
+
+def _fine_only_chunks(rep_bands, keys, num_coarse):
+    """Yield ``(c0, cand_slice, fine_only_slice)`` in 8-column chunks:
+    ``fine_only[b, c]`` is True when column c's candidate for row b shares
+    NO coarse band with row b (i.e. the pair is outside datasketch's
+    candidacy class).  Chunked so the gathered-coarse transient stays
+    ``[B, 8, nb]``."""
+    B, ncols = rep_bands.shape
+    nbands = keys.shape[1]
+    assert ncols % nbands == 0, (ncols, nbands)
+    coarse = keys[:, :num_coarse]
+    is_fine = _np.tile(_np.arange(nbands) >= num_coarse, ncols // nbands)
+    for c0 in range(0, ncols, 8):
+        cand = rep_bands[:, c0 : c0 + 8]
+        fine_cols = is_fine[c0 : c0 + 8]
+        if not fine_cols.any():
+            yield c0, cand, jnp.zeros(cand.shape, bool)
+            continue
+        cand_coarse = jnp.take(coarse, cand, axis=0)  # [B, <=8, nbc]
+        shared = (coarse[:, None, :] == cand_coarse).any(axis=2)
+        yield c0, cand, ~shared & jnp.asarray(fine_cols)[None, :]
+
+
+@partial(jax.jit, static_argnames=("num_coarse",))
+def borderline_edge_mask(
+    rep_bands: jnp.ndarray,
+    sig: jnp.ndarray,
+    keys: jnp.ndarray,
+    valid: jnp.ndarray,
+    base: float,
+    band: float,
+    *,
+    num_coarse: int,
+) -> jnp.ndarray:
+    """``bool[B, nc]``: edges that pass estimator verification but whose
+    verdict should be confirmed by EXACT Jaccard before resolution.
+
+    An edge needs exact confirmation when its agreement clears ``base``
+    (it would merge) AND it is statistically fragile: either **fine-only**
+    (outside datasketch's candidacy class — proposed by a fine sub-band
+    with no shared coarse band, any agreement), or **coarse-borderline**
+    (agreement < ``band``, where estimator noise σ≈0.04 at 128 perms makes
+    true-J<threshold merges likely).  Non-edges (self-candidates, invalid
+    endpoints) are never flagged.  See ``pipeline.dedup.NearDupEngine``
+    for the host exact-verify stage this feeds (measured budget:
+    DESIGN.md §2e).
+    """
+    B, nc = rep_bands.shape
+    idx = jnp.arange(B, dtype=jnp.int32)
+    parts = []
+    for c0, cand, fine_only in _fine_only_chunks(rep_bands, keys, num_coarse):
+        cand_sig = jnp.take(sig, cand, axis=0)
+        agree = (sig[:, None, :] == cand_sig).mean(axis=2)
+        is_edge = (
+            (cand != idx[:, None])
+            & valid[:, None]
+            & jnp.take(valid, cand)
+            & (agree >= base)
+        )
+        parts.append(is_edge & (fine_only | (agree < band)))
+    return jnp.concatenate(parts, axis=1)
+
+
+@partial(jax.jit, static_argnames=("num_coarse",))
+def fine_edge_thresholds(
+    rep_bands: jnp.ndarray,
+    keys: jnp.ndarray,
+    base: float,
+    fine_margin: float,
+    *,
+    num_coarse: int,
+) -> jnp.ndarray:
+    """Per-edge verification bars: ``float32[B, nc]`` for
+    :func:`resolve_rep_bands`.
+
+    Fine sub-bands serve two distinct edge classes and the precision
+    budget (VERDICT r4 item 4) needs them separated:
+
+    - **coarse-co-bucketed** fine edges — the row and its candidate share
+      ≥1 coarse band, i.e. the pair is in datasketch's own candidacy
+      class; the fine run merely recovered linkage the coarse
+      run-head/predecessor scheme under-connects (≥3 interleaved bucket
+      members).  These verify at ``base``: dropping or raising them costs
+      exactly the knee recall the sub-bands exist to provide.
+    - **fine-only** edges — no shared coarse band: pairs datasketch never
+      proposes.  Some are true transitive bridges (high agreement), many
+      are estimator noise just over the bar (the r4 ~3.2-point precision
+      giveback — σ≈0.04 at 128 perms).  These verify at
+      ``base + fine_margin``: strong bridges survive, noise dies.
+      (Measured: gating them out entirely overshoots — precision −0.003
+      vs oracle but recall 0.9255; ``tools/sweep_fine_margin.py``.)
+
+    ``rep_bands`` is ``int32[B, 3·(nb+cs)]`` over :func:`candidate_keys`
+    output (run heads for all bands, then run predecessors, then run
+    predecessors²); ``keys`` the same ``uint32[B, nb+cs]`` the candidates
+    came from; ``num_coarse`` = nb.  Gathers are chunked like
+    :func:`resolve_rep_bands`'s so the transient stays ``[B, 8, nb]``.
+    """
+    base = jnp.float32(base)
+    strict = jnp.float32(base + fine_margin)
+    out = [
+        jnp.where(fine_only, strict, base)
+        for _c0, _cand, fine_only in _fine_only_chunks(
+            rep_bands, keys, num_coarse
+        )
+    ]
+    return jnp.concatenate(out, axis=1)
 
 
 @partial(jax.jit, static_argnames=("jump_rounds",))

@@ -33,6 +33,7 @@ from advanced_scrapper_tpu.ops.lsh import (
     bucket_histogram,
     candidate_keys,
     duplicate_rep_bands,
+    fine_edge_thresholds,
     resolve_rep_bands,
 )
 from advanced_scrapper_tpu.ops.minhash import (
@@ -60,6 +61,7 @@ def make_sharded_dedup(
     hist_bins: int = 1 << 16,
     backend: str = "scan",
     cand_subbands: int | None = None,
+    fine_margin: float | None = None,
 ):
     """Build the jitted batch-sharded dedup step for ``mesh``.
 
@@ -79,11 +81,14 @@ def make_sharded_dedup(
     salt = jnp.asarray(params.band_salt)
     k = params.shingle_k
     _sig_fn = resolve_signature_fn(backend)
-    if cand_subbands is None:
-        # single source of the default: the certified engine's config
+    if cand_subbands is None or fine_margin is None:
+        # single source of the defaults: the certified engine's config
         from advanced_scrapper_tpu.config import DedupConfig
 
-        cand_subbands = DedupConfig().cand_subbands
+        if cand_subbands is None:
+            cand_subbands = DedupConfig().cand_subbands
+        if fine_margin is None:
+            fine_margin = DedupConfig().fine_margin
 
     def local_step(tokens, lengths):
         # tokens: uint8[B/n, L] local shard
@@ -97,8 +102,15 @@ def make_sharded_dedup(
         g_sig = jax.lax.all_gather(sig, data, axis=0, tiled=True)
         g_valid = jax.lax.all_gather(valid, data, axis=0, tiled=True)
         rep_bands = duplicate_rep_bands(g_keys, g_valid)
+        if cand_subbands and fine_margin:
+            thr = fine_edge_thresholds(
+                rep_bands, g_keys, threshold, fine_margin,
+                num_coarse=params.num_bands,
+            )
+        else:
+            thr = jnp.float32(threshold)
         rep = resolve_rep_bands(
-            rep_bands, g_sig, g_valid, threshold, jump_rounds=jump_rounds
+            rep_bands, g_sig, g_valid, thr, jump_rounds=jump_rounds
         )
         # North-star bucket merge: psum of per-shard histograms over ICI.
         hist = bucket_histogram(keys, valid, nbins=hist_bins)

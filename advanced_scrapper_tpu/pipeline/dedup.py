@@ -25,8 +25,10 @@ from advanced_scrapper_tpu.core.tokenizer import (
 )
 from advanced_scrapper_tpu.ops.exact import ExactHasher
 from advanced_scrapper_tpu.ops.lsh import (
+    borderline_edge_mask,
     candidate_keys,
     duplicate_rep_bands,
+    fine_edge_thresholds,
     keep_mask,
     resolve_rep_bands,
 )
@@ -196,16 +198,9 @@ class NearDupEngine:
             running = densify(running)
         return running
 
-    def dedup_reps_async(self, texts: Sequence[str | bytes]):
-        """Dispatch the full dedup and return the DEVICE ``int32[bucket]``
-        rep array without syncing — everything from encode to resolve is
-        async, so a caller streaming multiple corpora overlaps corpus i+1's
-        H2D/compute with corpus i's readback (the production firehose
-        regime; one-shot callers use :meth:`dedup_reps`).  Rows past
-        ``len(texts)`` are padding (invalid ⇒ self-assigned)."""
-        # Device-resident end to end: combined signatures never round-trip to
-        # the host (the sig D2H + re-H2D bounce cost ~0.3 s per 8k articles
-        # on the tunneled link); the only D2H is the final int32[N] reps.
+    def _prepare(self, texts: Sequence[str | bytes]):
+        """Shared front half of both resolution paths: encode → device
+        signatures → candidate keys → per-band candidates."""
         import jax
 
         n = len(texts)
@@ -218,17 +213,115 @@ class NearDupEngine:
         valid = jax.device_put(valid)
         keys = candidate_keys(sigs, self.params.band_salt, self.cfg.cand_subbands)
         rep_bands = duplicate_rep_bands(keys, valid)
+        return raw, sigs, keys, valid, rep_bands, n_bucket
+
+    def dedup_reps_async(self, texts: Sequence[str | bytes]):
+        """Dispatch the full dedup and return the DEVICE ``int32[bucket]``
+        rep array without syncing — everything from encode to resolve is
+        async, so a caller streaming multiple corpora overlaps corpus i+1's
+        H2D/compute with corpus i's readback (the production firehose
+        regime; one-shot callers use :meth:`dedup_reps`).  Rows past
+        ``len(texts)`` are padding (invalid ⇒ self-assigned).
+
+        This path never syncs, so borderline edges are handled by the
+        estimator-only ``fine_margin`` bar — the exact-Jaccard
+        confirmation stage needs a host round trip and lives in the
+        one-shot :meth:`dedup_reps` (measured trade in DESIGN.md §2e).
+        """
+        # Device-resident end to end: combined signatures never round-trip to
+        # the host (the sig D2H + re-H2D bounce cost ~0.3 s per 8k articles
+        # on the tunneled link); the only D2H is the final int32[N] reps.
+        _raw, sigs, keys, valid, rep_bands, n_bucket = self._prepare(texts)
+        if self.cfg.cand_subbands and self.cfg.fine_margin:
+            thr = fine_edge_thresholds(
+                rep_bands,
+                keys,
+                self.cfg.sim_threshold,
+                self.cfg.fine_margin,
+                num_coarse=self.params.num_bands,
+            )
+        else:
+            thr = self.cfg.sim_threshold
         return resolve_rep_bands(
-            rep_bands, sigs, valid, self.cfg.sim_threshold,
-            jump_rounds=_jump_rounds(n_bucket),
+            rep_bands, sigs, valid, thr, jump_rounds=_jump_rounds(n_bucket)
         )
 
+    def _exact_verified_thresholds(self, raw, sigs, keys, valid, rep_bands):
+        """Per-edge threshold array with statistically fragile edges
+        confirmed (or killed) by EXACT shingle-set Jaccard.
+
+        The estimator cannot meet the precision budget alone: at 128 perms
+        its σ≈0.04, and the borderline band [0.70, 0.72) holds both the
+        false merges (true J < 0.7, the r4 ~3.2-point precision giveback)
+        and the genuine bridges that recover cross-estimator disagreement
+        recall (measured frontier: tools/sweep_fine_margin.py).  Exact
+        Jaccard separates them perfectly, and the flagged set is tiny
+        (~130 pairs per 2048 docs), so the host cost is noise in the
+        one-shot path.  Edges that fail exact confirmation get an
+        impossible bar (2.0); everything else verifies at sim_threshold.
+        """
+        need = np.asarray(
+            borderline_edge_mask(
+                rep_bands,
+                sigs,
+                keys,
+                valid,
+                self.cfg.sim_threshold,
+                self.cfg.exact_verify_band,
+                num_coarse=self.params.num_bands,
+            )
+        )
+        if not need.any():
+            return self.cfg.sim_threshold
+        rb = np.asarray(rep_bands)
+        rows, cols = np.nonzero(need)
+        pairs = {}  # (lo, hi) -> verdict; an edge is undirected
+        shingles: dict[int, set] = {}
+
+        def sset(i: int) -> set:
+            if i not in shingles:
+                k = self.params.shingle_k
+                r = raw[i]
+                shingles[i] = {r[o : o + k] for o in range(len(r) - k + 1)}
+            return shingles[i]
+
+        thr = np.full(rb.shape, self.cfg.sim_threshold, np.float32)
+        checked = 0
+        for r, c in zip(rows, cols):
+            j = int(rb[r, c])
+            key = (min(int(r), j), max(int(r), j))
+            if key not in pairs:
+                if checked >= self.cfg.exact_verify_cap:
+                    continue  # est-only beyond the cap (pathological corpora)
+                checked += 1
+                a, b = sset(key[0]), sset(key[1])
+                union = len(a | b)
+                pairs[key] = (
+                    (len(a & b) / union if union else 1.0)
+                    >= self.cfg.sim_threshold
+                )
+            if not pairs[key]:
+                thr[r, c] = 2.0  # exact Jaccard refuted the merge
+        return thr
+
     def dedup_reps(self, texts: Sequence[str | bytes]) -> np.ndarray:
-        """int32[N] first-seen-wins representative per text (union-find roots)."""
+        """int32[N] first-seen-wins representative per text (union-find
+        roots), with exact-Jaccard confirmation of statistically fragile
+        edges (``exact_verify_band``) — the certified precision path."""
         n = len(texts)
         if n == 0:
             return np.zeros((0,), np.int32)
-        return np.asarray(self.dedup_reps_async(texts))[:n]
+        # exact verification is independent of fine-band candidacy:
+        # coarse-borderline edges need confirmation even at cand_subbands=0
+        # (borderline_edge_mask handles the no-fine-columns case)
+        if not self.cfg.exact_verify_band:
+            return np.asarray(self.dedup_reps_async(texts))[:n]
+        raw, sigs, keys, valid, rep_bands, n_bucket = self._prepare(texts)
+        thr = self._exact_verified_thresholds(raw, sigs, keys, valid, rep_bands)
+        rep = resolve_rep_bands(
+            rep_bands, sigs, valid, thr, jump_rounds=_jump_rounds(n_bucket)
+        )
+        return np.asarray(rep)[:n]
 
     def keep(self, texts: Sequence[str | bytes]) -> np.ndarray:
         reps = self.dedup_reps(texts)

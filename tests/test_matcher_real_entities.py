@@ -64,6 +64,7 @@ def test_real_period_suffixes_parse(processed):
     for fn in sorted(os.listdir(REF_TICKER_DIR)):
         if not fn.endswith(".json"):
             continue
+        data = None
         for enc in ("utf-8", "gbk", "latin1"):
             try:
                 with open(os.path.join(REF_TICKER_DIR, fn), encoding=enc) as f:
@@ -71,6 +72,8 @@ def test_real_period_suffixes_parse(processed):
                 break
             except (UnicodeDecodeError, json.JSONDecodeError):
                 continue
+        if data is None:  # unreadable snapshot: mirror the loader's skip
+            continue
         for company in data:
             for v in company.values():
                 items = [v] if isinstance(v, str) else v
@@ -84,15 +87,14 @@ def test_real_period_suffixes_parse(processed):
 
 
 def _plant_name(attrs) -> str | None:
-    """The longest index-storable display name for a ticker: mirrors the
-    EntityIndex gates (pure-lowercase-alpha skipped, 1-char uppers
-    skipped) so the plant is guaranteed screen-reachable."""
+    """The longest index-storable display name for a ticker.  The length
+    and pure-lowercase-alpha filters keep only names the EntityIndex
+    stores (matcher.py gates); ≥6 chars also keeps the fuzzy scores
+    unambiguous against the random filler vocabulary."""
     best = None
     for attribute in ("id_label", "aliases"):
         for name in attrs.get(attribute, {}):
             if not name or len(name) < 6 or "(" in name:
-                continue
-            if name.isupper() and len(name) <= 1:
                 continue
             if name.islower() and name.replace(" ", "").isalpha():
                 continue

@@ -124,17 +124,15 @@ def test_near_dup_recall_certification_hardened():
     assert n_unchained == 0, f"{n_unchained} members merged without a strong chain"
     assert precision >= 0.80, f"precision {precision:.4f} ({n_merged} pairs)"
 
-    # Comparator (VERDICT r3 item 3): the "identical behaviour to
-    # datasketch plus union-find" claim, MEASURED.  Score the oracle's own
-    # clustering with the same metric; the engine must be within ε of it.
-    # ε = 0.04 covers the measured per-corpus estimator variance at the
-    # Jaccard knee, where the two hash families (32-bit lanes vs 61-bit
-    # Mersenne) flip different coins on borderline cluster joins: over
-    # corpus seeds {7, 11, 13, 23} the gap was {+.032, +.010, −.004
-    # (engine BETTER), +.019} — noise around parity, not a one-sided
-    # defect.  The one-sided hard bar stays n_unchained == 0 above (and
-    # note the oracle itself scores u=1 on this corpus — the engine is
-    # the stricter of the two there).
+    # Comparator + budget (VERDICT r4 item 4): the engine must hold
+    # precision ≥ oracle − 0.01 at recall ≥ 0.95 (asserted above).  The
+    # r5 exact-verify stage (DedupConfig.exact_verify_band) is what makes
+    # this reachable: estimator-only margins cannot — borderline false
+    # merges and genuine cross-estimator bridge edges ride the same
+    # agreement band (measured frontier in tools/sweep_fine_margin.py and
+    # DESIGN.md §2e) — so statistically fragile edges are confirmed by
+    # exact shingle-set Jaccard before resolution (measured here:
+    # oracle +0.0098 at recall 0.9524, ~130 exact checks).
     from advanced_scrapper_tpu.cpu.oracle import oracle_reps
 
     o_precision, o_merged, o_unchained = measured_precision(
@@ -144,9 +142,9 @@ def test_near_dup_recall_certification_hardened():
         0.7,
     )
     assert o_merged >= 900
-    assert precision >= o_precision - 0.04, (
+    assert precision >= o_precision - 0.01, (
         f"engine precision {precision:.4f} below oracle comparator "
-        f"{o_precision:.4f} − ε"
+        f"{o_precision:.4f} − 0.01 budget"
     )
 
 
