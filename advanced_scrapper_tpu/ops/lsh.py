@@ -245,6 +245,14 @@ def resolve_rep_bands(
         & valid[:, None]
         & jnp.take(valid, rep_bands)
     )
+    return _label_components(rep_bands, ok, valid, jump_rounds)
+
+
+def _label_components(rep_bands, ok, valid, jump_rounds: int):
+    """Connected-component min labels over the ``ok`` edge set (the shared
+    back half of :func:`resolve_rep_bands` / :func:`resolve_rep_bands_from_ok`)."""
+    B, nc = rep_bands.shape
+    idx = jnp.arange(B, dtype=jnp.int32)
     cand = jnp.where(ok, rep_bands, idx[:, None])  # self-edges are no-ops
     lab = idx
     for _ in range(jump_rounds):
@@ -255,6 +263,24 @@ def resolve_rep_bands(
         )
         lab = jnp.take(lab, lab)  # pointer doubling
     return jnp.where(valid, lab, idx)
+
+
+@partial(jax.jit, static_argnames=("jump_rounds",))
+def resolve_rep_bands_from_ok(
+    rep_bands: jnp.ndarray,
+    ok: jnp.ndarray,
+    valid: jnp.ndarray,
+    *,
+    jump_rounds: int,
+) -> jnp.ndarray:
+    """:func:`resolve_rep_bands` with the verified-edge matrix supplied.
+
+    For callers that already computed the agreement pass (e.g.
+    :func:`borderline_edge_mask`) and edited it on host (the exact-verify
+    stage kills refuted edges) — re-running the chunked signature gathers
+    would double the heaviest device op on the one-shot path.
+    """
+    return _label_components(rep_bands, ok, valid, jump_rounds)
 
 
 def subband_salt(num: int, seed: int = 0x5B5C9A02) -> _np.ndarray:
@@ -324,23 +350,29 @@ def borderline_edge_mask(
     band: float,
     *,
     num_coarse: int,
-) -> jnp.ndarray:
-    """``bool[B, nc]``: edges that pass estimator verification but whose
-    verdict should be confirmed by EXACT Jaccard before resolution.
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """``(need bool[B, nc], ok bool[B, nc])``: edges that pass estimator
+    verification, and which of them should be confirmed by EXACT Jaccard
+    before resolution.
 
-    An edge needs exact confirmation when its agreement clears ``base``
-    (it would merge) AND it is statistically fragile: either **fine-only**
-    (outside datasketch's candidacy class — proposed by a fine sub-band
-    with no shared coarse band, any agreement), or **coarse-borderline**
-    (agreement < ``band``, where estimator noise σ≈0.04 at 128 perms makes
-    true-J<threshold merges likely).  Non-edges (self-candidates, invalid
-    endpoints) are never flagged.  See ``pipeline.dedup.NearDupEngine``
-    for the host exact-verify stage this feeds (measured budget:
-    DESIGN.md §2e).
+    An edge needs exact confirmation (``need``) when its agreement clears
+    ``base`` (it would merge) AND it is statistically fragile: either
+    **fine-only** (outside datasketch's candidacy class — proposed by a
+    fine sub-band with no shared coarse band, any agreement), or
+    **coarse-borderline** (agreement < ``band``, where estimator noise
+    σ≈0.04 at 128 perms makes true-J<threshold merges likely).
+    Non-edges (self-candidates, invalid endpoints) are never flagged.
+    ``ok`` is the full verified-edge matrix at ``base`` — callers edit it
+    with the exact verdicts and resolve via
+    :func:`resolve_rep_bands_from_ok`, so the chunked agreement gathers
+    (the heaviest op in the resolve path) run ONCE.  See
+    ``pipeline.dedup.NearDupEngine`` for the host exact-verify stage
+    (measured budget: DESIGN.md §2e).
     """
     B, nc = rep_bands.shape
     idx = jnp.arange(B, dtype=jnp.int32)
-    parts = []
+    need_parts = []
+    ok_parts = []
     for c0, cand, fine_only in _fine_only_chunks(rep_bands, keys, num_coarse):
         cand_sig = jnp.take(sig, cand, axis=0)
         agree = (sig[:, None, :] == cand_sig).mean(axis=2)
@@ -350,8 +382,14 @@ def borderline_edge_mask(
             & jnp.take(valid, cand)
             & (agree >= base)
         )
-        parts.append(is_edge & (fine_only | (agree < band)))
-    return jnp.concatenate(parts, axis=1)
+        need_parts.append(is_edge & (fine_only | (agree < band)))
+        ok_parts.append(
+            (agree >= base) & valid[:, None] & jnp.take(valid, cand)
+        )
+    return (
+        jnp.concatenate(need_parts, axis=1),
+        jnp.concatenate(ok_parts, axis=1),
+    )
 
 
 @partial(jax.jit, static_argnames=("num_coarse",))

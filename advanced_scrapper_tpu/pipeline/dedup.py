@@ -31,6 +31,7 @@ from advanced_scrapper_tpu.ops.lsh import (
     fine_edge_thresholds,
     keep_mask,
     resolve_rep_bands,
+    resolve_rep_bands_from_ok,
 )
 from advanced_scrapper_tpu.ops.minhash import resolve_signature_fn
 
@@ -246,63 +247,61 @@ class NearDupEngine:
             rep_bands, sigs, valid, thr, jump_rounds=_jump_rounds(n_bucket)
         )
 
-    def _exact_verified_thresholds(self, raw, sigs, keys, valid, rep_bands):
-        """Per-edge threshold array with statistically fragile edges
-        confirmed (or killed) by EXACT shingle-set Jaccard.
+    def _exact_verified_ok(self, raw, sigs, keys, valid, rep_bands):
+        """Verified-edge matrix with statistically fragile edges confirmed
+        (or killed) by EXACT shingle-set Jaccard.
 
         The estimator cannot meet the precision budget alone: at 128 perms
         its σ≈0.04, and the borderline band [0.70, 0.72) holds both the
         false merges (true J < 0.7, the r4 ~3.2-point precision giveback)
         and the genuine bridges that recover cross-estimator disagreement
         recall (measured frontier: tools/sweep_fine_margin.py).  Exact
-        Jaccard separates them perfectly, and the flagged set is tiny
-        (~130 pairs per 2048 docs), so the host cost is noise in the
-        one-shot path.  Edges that fail exact confirmation get an
-        impossible bar (2.0); everything else verifies at sim_threshold.
+        Jaccard — the oracle's own ``shingle_set``/``jaccard`` definition,
+        imported so the two can never diverge — separates them perfectly,
+        and the flagged set is tiny (~130 pairs per 2048 docs), so the
+        host cost is noise in the one-shot path.  Returns the device
+        ``ok`` matrix (agreement pass runs ONCE) with refuted edges
+        cleared, ready for ``resolve_rep_bands_from_ok``.
         """
-        need = np.asarray(
-            borderline_edge_mask(
-                rep_bands,
-                sigs,
-                keys,
-                valid,
-                self.cfg.sim_threshold,
-                self.cfg.exact_verify_band,
-                num_coarse=self.params.num_bands,
-            )
+        from advanced_scrapper_tpu.cpu.oracle import jaccard, shingle_set
+
+        need_dev, ok_dev = borderline_edge_mask(
+            rep_bands,
+            sigs,
+            keys,
+            valid,
+            self.cfg.sim_threshold,
+            self.cfg.exact_verify_band,
+            num_coarse=self.params.num_bands,
         )
+        need = np.asarray(need_dev)
         if not need.any():
-            return self.cfg.sim_threshold
+            return ok_dev
         rb = np.asarray(rep_bands)
-        rows, cols = np.nonzero(need)
+        ok = np.asarray(ok_dev).copy()
         pairs = {}  # (lo, hi) -> verdict; an edge is undirected
         shingles: dict[int, set] = {}
 
         def sset(i: int) -> set:
             if i not in shingles:
-                k = self.params.shingle_k
-                r = raw[i]
-                shingles[i] = {r[o : o + k] for o in range(len(r) - k + 1)}
+                shingles[i] = shingle_set(raw[i], self.params.shingle_k)
             return shingles[i]
 
-        thr = np.full(rb.shape, self.cfg.sim_threshold, np.float32)
         checked = 0
-        for r, c in zip(rows, cols):
+        for r, c in zip(*np.nonzero(need)):
             j = int(rb[r, c])
             key = (min(int(r), j), max(int(r), j))
             if key not in pairs:
                 if checked >= self.cfg.exact_verify_cap:
                     continue  # est-only beyond the cap (pathological corpora)
                 checked += 1
-                a, b = sset(key[0]), sset(key[1])
-                union = len(a | b)
                 pairs[key] = (
-                    (len(a & b) / union if union else 1.0)
+                    jaccard(sset(key[0]), sset(key[1]))
                     >= self.cfg.sim_threshold
                 )
             if not pairs[key]:
-                thr[r, c] = 2.0  # exact Jaccard refuted the merge
-        return thr
+                ok[r, c] = False  # exact Jaccard refuted the merge
+        return ok
 
     def dedup_reps(self, texts: Sequence[str | bytes]) -> np.ndarray:
         """int32[N] first-seen-wins representative per text (union-find
@@ -317,9 +316,9 @@ class NearDupEngine:
         if not self.cfg.exact_verify_band:
             return np.asarray(self.dedup_reps_async(texts))[:n]
         raw, sigs, keys, valid, rep_bands, n_bucket = self._prepare(texts)
-        thr = self._exact_verified_thresholds(raw, sigs, keys, valid, rep_bands)
-        rep = resolve_rep_bands(
-            rep_bands, sigs, valid, thr, jump_rounds=_jump_rounds(n_bucket)
+        ok = self._exact_verified_ok(raw, sigs, keys, valid, rep_bands)
+        rep = resolve_rep_bands_from_ok(
+            rep_bands, ok, valid, jump_rounds=_jump_rounds(n_bucket)
         )
         return np.asarray(rep)[:n]
 

@@ -388,9 +388,11 @@ def test_match_chunk_async_equals_sync_and_overlaps(tmp_path):
 
 
 def test_refine_auto_mode_semantics(monkeypatch):
-    """Default "auto" (r4): the bound kernel dispatches only when a batch's
-    surviving pair count clears REFINE_AUTO_MIN_PAIRS; output is identical
-    to both forced modes either way, and invalid values fail loudly."""
+    """Default "auto" (r5): without a RefineController measurement the
+    bound kernel never dispatches (measured-safe default; the r4
+    pair-count gate guessed wrong both ways); with a controller verdict
+    it follows the measurement.  Output is identical to both forced
+    modes either way, and invalid values fail loudly."""
     import pandas as pd
     import pytest
 
@@ -434,14 +436,28 @@ def test_refine_auto_mode_semantics(monkeypatch):
 
     monkeypatch.setattr(ED, "prune_mask_tables", counting)
 
-    # 8 rows × a couple of fuzzy names << 256 pairs: auto must not dispatch
+    # uncalibrated auto must not dispatch the bound at all
     out_auto = M.match_chunk(df, idx)  # default is "auto"
-    assert calls["n"] == 0, "auto must skip the bound below the breakeven"
+    assert calls["n"] == 0, "uncalibrated auto must skip the bound"
+
+    # a controller that measured refine winning flips auto on
+    ctrl = M.RefineController()
+    ctrl.record(False, 1.0)
+    ctrl.record(True, 0.5)
+    assert ctrl.verdict() is True
+    idx.refine_controller = ctrl
+    calls["n"] = 0
+    out_auto_on = M.match_chunk(df, idx)
+    assert calls["n"] > 0, "calibrated auto must follow the measurement"
+    del idx.refine_controller
 
     calls["n"] = 0
     out_forced = M.match_chunk(df, idx, use_refine=True)
     assert calls["n"] > 0, "forced mode must dispatch regardless of count"
     out_off = M.match_chunk(df, idx, use_refine=False)
+    assert sorted(t for t, _, _ in out_auto_on) == sorted(
+        t for t, _, _ in out_forced
+    )
 
     def key(res):
         return sorted((t, json_dumps(m)) for t, m, _ in res)
@@ -460,3 +476,33 @@ def test_refine_auto_mode_semantics(monkeypatch):
         M.match_chunk(df, idx, use_screen=False, use_refine=True)
     out_noscreen = M.match_chunk(df, idx, use_screen=False)  # auto: fine
     assert key(out_noscreen) == key(out_auto)
+
+
+def test_refine_controller_race():
+    """The controller probes each mode once, exploits the measured winner
+    with 5% hysteresis, re-probes the loser periodically, and keeps the
+    MIN per-mode cost (queue inflation only ever adds time)."""
+    from advanced_scrapper_tpu.pipeline.matcher import RefineController
+
+    c = RefineController()
+    assert c.next_mode() is False  # probe screen-only first
+    c.record(False, 1.0)
+    assert c.next_mode() is True  # then probe refine
+    c.record(True, 0.99)  # faster, but within the 5% hysteresis band
+    assert c.verdict() is False  # ties go to the simpler mode
+    c.record(True, 0.5)
+    assert c.verdict() is True
+    # exploitation follows the verdict, with a periodic loser re-probe
+    assert c.next_mode() is True
+    modes = []
+    for _ in range(RefineController.PROBE_EVERY + 2):
+        m = c.next_mode()
+        modes.append(m)
+        c.record(m, 0.5 if m else 1.0)  # costs stay mode-true
+    assert False in modes, "the losing mode must be re-probed"
+    assert modes.count(False) <= 2, "re-probes are periodic, not constant"
+    assert c.verdict() is True
+    # a noisy (queue-inflated) later sample must not overwrite the best
+    c.record(False, 50.0)
+    assert c.verdict() is True
+    assert c._best[False] == 1.0
