@@ -429,12 +429,10 @@ def _refine_batch(
     threshold: float,
     *,
     max_pairs: int = 1024,
-    min_pairs: int = 1,
 ) -> list[set | None]:
     """Per-row sets of name indices whose text-side score is device-proven
     ≤ threshold.  Non-ASCII texts pass through (byte/char mismatch).
-    Fewer than ``min_pairs`` surviving pairs → no device dispatch at all
-    (every pair just goes to the host scorer, output-identical)."""
+    Zero surviving pairs → no device dispatch at all."""
     from advanced_scrapper_tpu.core.tokenizer import encode_batch
     from advanced_scrapper_tpu.ops.editdist import prune_mask_tables
 
@@ -450,7 +448,7 @@ def _refine_batch(
         pair_row.extend([i] * len(sel))
         pair_k.extend(sel.tolist())
     out: list[set | None] = [None] * len(batch)
-    if len(pair_row) < max(min_pairs, 1):
+    if not pair_row:
         return out
     row_ids = sorted(set(pair_row))
     pos = {r: k for k, r in enumerate(row_ids)}

@@ -1,0 +1,157 @@
+"""10M-document COMPOSED streaming soak: hostbatch → DeviceFeed → bloom.
+
+VERDICT r4 item 9: the unbounded-corpus claim (COVERAGE §5.7) was
+certified per-component (bloom filter math in ``tools/soak_bloom.py``,
+host queue in ``tools/profile_host_composition.py``) but the composed
+production path had never run at stream scale end-to-end.  This driver
+pushes N synthetic docs through the REAL pipeline:
+
+    producer thread → HostBatcher.feed (C++ MPMC queue)
+      → DeviceFeed prefetch (H2D)
+      → minhash_signatures + band_keys_wide (device)
+      → pack_keys64 → BloomBandIndex.check_and_add_batch (host)
+
+and records sustained docs/s, the RSS ceiling, and the measured
+false-drop count against the ``for_capacity`` sizing math
+(``BloomBandIndex.predicted_row_fp``).  Ground truth is construction:
+docs are unique random bytes (key collisions ≈ n·nb/2⁶⁴, negligible),
+so ANY dup flag on a fresh doc is a false drop; one known repeat doc is
+planted every ``PLANT_EVERY`` batches and must be caught (an exact copy
+has identical signatures, hence identical wide keys).
+
+Usage:
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu PYTHONPATH=/root/repo \\
+      python tools/soak_stream.py               # 10M docs, for_capacity sizing
+    python tools/soak_stream.py 1000000          # 1M docs (smoke)
+
+Prints checkpoint JSON lines to stderr and ONE summary JSON line to
+stdout (committed as SOAK_STREAM_r{N}.json, cited in DESIGN.md §6).
+"""
+from __future__ import annotations
+
+import json
+import resource
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+BATCH = 4096
+DOC_LEN = 128
+PLANT_EVERY = 50
+
+
+def main() -> None:
+    n_docs = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    n_docs = (n_docs // BATCH) * BATCH
+
+    import jax
+
+    from advanced_scrapper_tpu.core.hashing import make_params
+    from advanced_scrapper_tpu.cpu.hostbatch import HostBatcher
+    from advanced_scrapper_tpu.ops.lsh import band_keys_wide
+    from advanced_scrapper_tpu.ops.minhash import minhash_signatures
+    from advanced_scrapper_tpu.pipeline.feed import DeviceFeed
+    from advanced_scrapper_tpu.utils.bloom import BloomBandIndex, pack_keys64
+
+    params = make_params()
+    bloom = BloomBandIndex.for_capacity(n_docs, row_fp=1e-3)
+    platform = jax.devices()[0].platform
+
+    batcher = HostBatcher(DOC_LEN)
+    feed = DeviceFeed(batcher, BATCH, depth=4)
+
+    planted = {"doc": None, "expected": 0, "caught": 0}
+
+    def produce() -> None:
+        rng = np.random.RandomState(23)
+        n_batches = n_docs // BATCH
+        for b in range(n_batches):
+            block = rng.randint(32, 127, size=(BATCH, DOC_LEN), dtype=np.uint8)
+            docs = [block[i].tobytes() for i in range(BATCH)]
+            if b == 0:
+                planted["doc"] = docs[0]
+            elif b % PLANT_EVERY == 0:
+                docs[-1] = planted["doc"]  # known repeat: must be caught
+                planted["expected"] += 1
+            batcher.feed(docs, start_tag=b * BATCH, chunk=BATCH)
+        batcher.close()
+
+    producer = threading.Thread(target=produce, daemon=True)
+    t0 = time.perf_counter()
+    producer.start()
+
+    lengths_full = np.full((BATCH,), DOC_LEN, np.int32)
+    seen = 0
+    false_drops = 0
+    next_cp = n_docs // 10
+    for n, tok_dev, _len_dev, tags in feed:
+        sig = minhash_signatures(tok_dev, jax.device_put(lengths_full), params)
+        keys = pack_keys64(np.asarray(band_keys_wide(sig, params.band_salt))[:n])
+        hit = bloom.check_and_add_batch(keys)
+        batch_id = int(tags[0]) // BATCH
+        plant_rows = (
+            {BATCH - 1}
+            if batch_id % PLANT_EVERY == 0 and batch_id > 0
+            else set()
+        )
+        for i in np.flatnonzero(hit):
+            if int(i) in plant_rows:
+                planted["caught"] += 1
+            else:
+                false_drops += 1
+        seen += n
+        if seen >= next_cp:
+            dt = time.perf_counter() - t0
+            print(
+                json.dumps(
+                    {
+                        "docs": seen,
+                        "docs_per_s": round(seen / dt),
+                        "false_drops": false_drops,
+                        "measured_fp": round(false_drops / seen, 8),
+                        "predicted_fp": round(bloom.predicted_row_fp(), 8),
+                        "rss_mb": resource.getrusage(
+                            resource.RUSAGE_SELF
+                        ).ru_maxrss
+                        // 1024,
+                    }
+                ),
+                file=sys.stderr,
+                flush=True,
+            )
+            next_cp += n_docs // 10
+    dt = time.perf_counter() - t0
+    feed.join()
+    producer.join(timeout=60)
+    assert seen == n_docs, (seen, n_docs)
+
+    print(
+        json.dumps(
+            {
+                "soak": "hostbatch->DeviceFeed->minhash->bloom",
+                "platform": platform,
+                "docs": seen,
+                "doc_len": DOC_LEN,
+                "batch": BATCH,
+                "wall_s": round(dt, 1),
+                "docs_per_s": round(seen / dt),
+                "vs_50k_target": round(seen / dt / 50_000, 2),
+                "bloom_bits_per_band": bloom.bits,
+                "bloom_mb": bloom.memory_bytes // (1 << 20),
+                "false_drops": false_drops,
+                "measured_fp": round(false_drops / seen, 8),
+                "predicted_fp": round(bloom.predicted_row_fp(), 8),
+                "planted_repeats_caught": f"{planted['caught']}/{planted['expected']}",
+                "rss_ceiling_mb": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                // 1024,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
