@@ -115,3 +115,39 @@ def test_sharded_resolution_matches_certified_engine(mesh8):
     np.testing.assert_array_equal(np.asarray(rep_sharded), rep_engine)
     assert rep_engine[10] == 4 and rep_engine[21] == 7  # merges happened
     assert rep_engine[33] == 33  # negative control stayed unmerged
+
+
+def test_sharded_fine_margin_matches_async_engine(mesh8):
+    """The per-edge fine-only threshold path (fine_edge_thresholds) inside
+    shard_map must resolve exactly like the engine's async path with the
+    same margin — and the margin must be live (a huge margin changes at
+    least one borderline resolution on a knee-heavy corpus)."""
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.cpu.oracle import mutate_to_jaccard
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    rng = np.random.RandomState(2)
+    texts = []
+    for i in range(32):
+        base = bytes(rng.randint(32, 127, size=240, dtype=np.uint8))
+        texts.append(base)
+        texts.append(mutate_to_jaccard(rng, base, 0.68))  # knee pairs
+    tok, ln = encode_batch(texts, block_len=256)
+    t, l = shard_batch(tok, ln, mesh8)
+
+    by_margin = {}
+    for margin in (0.0, 0.04):
+        rep_sharded, _ = make_sharded_dedup(
+            mesh8, PARAMS, fine_margin=margin
+        )(t, l)
+        by_margin[margin] = np.asarray(rep_sharded)
+        rep_async = np.asarray(
+            NearDupEngine(DedupConfig(fine_margin=margin)).dedup_reps_async(texts)
+        )[: len(texts)]
+        np.testing.assert_array_equal(by_margin[margin], rep_async)
+
+    strict, _ = make_sharded_dedup(mesh8, PARAMS, fine_margin=0.5)(t, l)
+    assert (by_margin[0.0] != np.asarray(strict)).any(), (
+        "a prohibitive fine margin must change at least one borderline "
+        "resolution on a knee-heavy corpus"
+    )
