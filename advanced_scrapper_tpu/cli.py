@@ -126,8 +126,19 @@ def _with_overrides(cfg, **overrides):
 
 
 def _cmd_harvest(args: argparse.Namespace) -> int:
+    cfg = _with_overrides(default_config().harvest, transport=args.transport)
+    if args.engine == "async":
+        if args.transport is not None:
+            # the async engine rides its own aiohttp session; silently
+            # ignoring a requested browser transport would let the operator
+            # believe it ran
+            print("--engine async is plain-HTTP only; drop --transport "
+                  "or use --engine threads")
+            return 2
+        run_harvest_async = _import_pipeline("harvest_async", "run_harvest_async")
+        return run_harvest_async(cfg)
     run_harvest = _import_pipeline("harvest", "run_harvest")
-    return run_harvest(_with_overrides(default_config().harvest, transport=args.transport))
+    return run_harvest(cfg)
 
 
 def _cmd_scrape(args: argparse.Namespace) -> int:
@@ -533,6 +544,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     h = sub.add_parser("harvest", help="CDX URL harvest -> deduped yfin_urls.csv")
     h.add_argument("--transport", default=None)
+    h.add_argument(
+        "--engine",
+        choices=("threads", "async"),
+        default="threads",
+        help="threads: one transport per worker (browsers need this); "
+        "async: one aiohttp session, semaphore-bounded (the Scrapy-slot "
+        "engine — plain HTTP only)",
+    )
     h.set_defaults(fn=_cmd_harvest)
 
     s = sub.add_parser("scrape", help="constant-rate article scrape")

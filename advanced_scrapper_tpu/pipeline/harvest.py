@@ -81,25 +81,31 @@ def parse_cdx_text(text: str) -> pd.DataFrame:
     )
 
 
+def persist_shard(prefix: str, page: str, cfg: HarvestConfig) -> str | None:
+    """Parse + persist one fetched CDX shard page (ref :38-82) — the
+    engine-independent half shared by the threaded and async harvesters,
+    so their shard files are byte-identical by construction."""
+    text = BeautifulSoup(page, "html.parser").get_text(separator="\n", strip=True)
+    csv_path = None
+    if text.strip():
+        df = normalize_cdx_frame(parse_cdx_text(text))
+        csv_path = os.path.join(cfg.shard_dir, f"yahoo_{prefix}.csv")
+        df.to_csv(csv_path, index=False)
+    # the .txt is the resume checkpoint (shard_prefixes skips on it), so
+    # it must be written only once the shard fully succeeded — the
+    # reference writes it first (:52-54) and silently loses shards whose
+    # parse then fails; checkpoint-last fixes that
+    txt_path = os.path.join(cfg.shard_dir, f"yahoo_{prefix}.txt")
+    with open(txt_path, "w", encoding="utf-8") as f:
+        f.write(text)
+    return csv_path
+
+
 def process_shard(prefix: str, transport, cfg: HarvestConfig) -> str | None:
     """Fetch one CDX shard, persist raw text + normalised CSV (ref :38-82)."""
     url = cdx_query_url(prefix, cfg)
-    txt_path = os.path.join(cfg.shard_dir, f"yahoo_{prefix}.txt")
     try:
-        page = transport.fetch(url)
-        text = BeautifulSoup(page, "html.parser").get_text(separator="\n", strip=True)
-        csv_path = None
-        if text.strip():
-            df = normalize_cdx_frame(parse_cdx_text(text))
-            csv_path = os.path.join(cfg.shard_dir, f"yahoo_{prefix}.csv")
-            df.to_csv(csv_path, index=False)
-        # the .txt is the resume checkpoint (shard_prefixes skips on it), so
-        # it must be written only once the shard fully succeeded — the
-        # reference writes it first (:52-54) and silently loses shards whose
-        # parse then fails; checkpoint-last fixes that
-        with open(txt_path, "w", encoding="utf-8") as f:
-            f.write(text)
-        return csv_path
+        return persist_shard(prefix, transport.fetch(url), cfg)
     except Exception as e:
         print(f"Error scraping {url}: {e}")
         return None
