@@ -29,7 +29,6 @@ from advanced_scrapper_tpu.ops.lsh import (
     candidate_keys,
     duplicate_rep_bands,
     fine_edge_thresholds,
-    keep_mask,
     resolve_rep_bands,
     resolve_rep_bands_from_ok,
 )

@@ -57,8 +57,6 @@ import re
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass
-from datetime import datetime
-from typing import Iterable
 
 import numpy as np
 import pandas as pd
