@@ -246,3 +246,32 @@ def test_dedup_failing_input_does_not_clobber_output(tmp_path):
     with pytest.raises(FileNotFoundError):
         main(["dedup", str(tmp_path / "missing.txt"), "-o", str(keep)])
     assert keep.read_text() == "do not clobber\n"
+
+
+def test_harvest_engine_async_cli(tmp_path, monkeypatch, capsys):
+    """`astpu harvest --engine async` runs the full CLI→async-engine→merge
+    chain offline (fetch stubbed at the engine's default-fetch seam), and
+    the plain-HTTP-only guard rejects --transport loudly."""
+    monkeypatch.chdir(tmp_path)
+    import advanced_scrapper_tpu.pipeline.harvest_async as HA
+
+    CDX = (
+        "com,yahoo,finance)/news/apple 20230101010101 "
+        "http://finance.yahoo.com:80/news/apple-hits.html text/html 200 A 1\n"
+    )
+
+    def stub_default_fetch():
+        async def fetch(url):
+            return CDX if "news/aa*" in url else ""
+        return fetch
+
+    monkeypatch.setattr(HA, "_default_fetch", stub_default_fetch)
+    assert main(["harvest", "--engine", "async"]) == 0
+    out = pd.read_csv("yfin_urls.csv")
+    assert out["url"].tolist() == [
+        "https://finance.yahoo.com/news/apple-hits.html"
+    ]
+
+    # incompatible flag combo is rejected, not silently ignored
+    assert main(["harvest", "--engine", "async", "--transport", "mock"]) == 2
+    assert "plain-HTTP only" in capsys.readouterr().out
