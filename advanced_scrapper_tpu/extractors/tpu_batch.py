@@ -23,6 +23,7 @@ XLA-idiomatic split.  For *static* corpora the all-device path
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Callable, Sequence
 
@@ -37,6 +38,15 @@ from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
 # dup marks in bloom stream-index mode: membership is known, the target is
 # not (no per-document state exists to attribute against)
 BLOOM_SENTINEL = "(bloom)"
+
+
+class IndexFingerprintError(ValueError):
+    """Stream-index checkpoint written under a different dedup config.
+
+    A distinct type (not a bare ValueError) because the resume path must
+    tell it apart from numpy's own ValueErrors on corrupted archives: a
+    mismatched config is an operator error that must stay loud, while a
+    corrupted file is substrate damage to quarantine and survive."""
 
 
 def _key_of(rec: dict, field: str) -> str:
@@ -88,20 +98,33 @@ class TpuBatchBackend:
         self.key_field = key_field
         self.sink = sink
         self.exact_stage = exact_stage
-        self.stats = BatchStats()
-        self._buffer: list[dict] = []
+        self._buffer: list[dict] = []  # stats live in _reset_stream_state
         # cross-batch state — two interchangeable stream indexes:
         #   exact: attributed dup targets, host memory grows with the stream;
         #   bloom: LSHBloom (utils/bloom.py) — fixed memory forever, dup
         #   marks carry the sentinel BLOOM_SENTINEL instead of a target key.
         self._bloom_mode = self.cfg.stream_index == "bloom"
         if self._bloom_mode:
-            from advanced_scrapper_tpu.utils.bloom import (
-                BloomBandIndex, hash_key64, pack_keys64,
-            )
+            from advanced_scrapper_tpu.utils.bloom import hash_key64, pack_keys64
 
             self._hash_key64 = hash_key64
             self._pack_keys64 = pack_keys64
+        elif self.cfg.stream_index != "exact":
+            raise ValueError(
+                f"unknown stream_index {self.cfg.stream_index!r}; use exact|bloom"
+            )
+        self._reset_stream_state()
+
+    def _reset_stream_state(self) -> None:
+        """(Re)initialise every piece of cross-batch stream-index state —
+        shared by construction and by the quarantine path, which must
+        discard a PARTIALLY restored checkpoint (``load_index`` mutates
+        progressively, so a mid-load failure would otherwise leave e.g.
+        ``_seen_keys`` populated with no matching signatures, silently
+        dropping re-scraped rows as exact dups)."""
+        if self._bloom_mode:
+            from advanced_scrapper_tpu.utils.bloom import BloomBandIndex
+
             self._bloom = BloomBandIndex(
                 self.cfg.num_bands,
                 bits=self.cfg.bloom_bits,
@@ -114,10 +137,7 @@ class TpuBatchBackend:
                 seed=self.cfg.seed + 1,
             )
             self._bloom_fill_warned = False
-        elif self.cfg.stream_index != "exact":
-            raise ValueError(
-                f"unknown stream_index {self.cfg.stream_index!r}; use exact|bloom"
-            )
+        self.stats = BatchStats()
         self._seen_keys: set[str] = set()
         self._buckets: dict[tuple[int, int], int] = {}  # (band, key) -> sig idx
         self._kept_sigs: list[np.ndarray] = []
@@ -138,7 +158,7 @@ class TpuBatchBackend:
             dtype=np.int64,
         )
 
-    def save_index(self, path: str) -> None:
+    def save_index(self, path: str, fs=None) -> None:
         """Persist the cross-batch stream-index state (npz).
 
         The reference resumes every long job from its artifacts (SURVEY
@@ -149,6 +169,11 @@ class TpuBatchBackend:
         stores keys + kept signatures (band buckets are a deterministic
         function of the signatures and are rebuilt on load); bloom mode
         stores the filter bit-planes.
+
+        Torn-write safety: the npz is written to a tmp through the
+        ``storage.fsio`` seam, flushed AND fsynced, then renamed over the
+        target — a crash at any byte leaves the previous checkpoint
+        intact (whole-or-previous, never torn).
         """
         if self._buffer:
             raise ValueError(
@@ -173,20 +198,83 @@ class TpuBatchBackend:
                 if self._kept_sigs
                 else np.zeros((0, self.params.num_perm), np.uint32)
             )
-        # atomic replace: a crash mid-write must never leave a truncated
-        # checkpoint where the resume artifact used to be
-        import os
+        # atomic commit through the fsio seam: savez streams straight into
+        # the tmp handle (no second in-memory copy of a checkpoint that
+        # holds every kept signature), then flush+fsync+rename — a crash
+        # at any byte leaves the previous checkpoint intact (and savez
+        # gets no chance to play ".npz" suffix games with a half-named
+        # tmp, since it was handed an open file object)
+        from advanced_scrapper_tpu.storage.fsio import atomic_write
 
-        tmp = f"{path}.tmp-{os.getpid()}"
+        def write_npz(fh):
+            # np.savez_compressed's own internals, written out so the
+            # archive can be DISARMED on a substrate fault: savez holds
+            # its ZipFile privately, and a write failing mid-member
+            # leaves that ZipFile unfinalised — its __del__ then retries
+            # the end record against the closed tmp handle, logging an
+            # "Exception ignored in ZipFile.__del__" traceback on every
+            # injected fault
+            import zipfile
+
+            from numpy.lib import format as npformat
+
+            zf = zipfile.ZipFile(
+                fh, "w", zipfile.ZIP_DEFLATED, allowZip64=True
+            )
+            try:
+                for name, arr in state.items():
+                    with zf.open(name + ".npy", "w", force_zip64=True) as m:
+                        npformat.write_array(m, np.asanyarray(arr))
+                zf.close()
+            except BaseException:
+                zf.fp = None  # the torn tmp is discarded anyway; stop
+                raise         # __del__ from finalising a broken archive
+
+        atomic_write(path, write_npz, fs=fs)
+
+    def load_index_if_valid(self, path: str, fs=None) -> bool:
+        """Resume-safe :meth:`load_index`: a checkpoint that is torn or
+        unreadable (a pre-hardening crash artifact, a corrupted byte range)
+        is quarantined to ``<path>.quarantine-<pid>`` and ``False`` is
+        returned — the caller starts from an empty index, which only
+        weakens dedup, never loses rows.  A config-fingerprint mismatch
+        still raises: that is an operator error, not substrate damage,
+        and resuming past it would corrupt membership silently.
+        """
+        from advanced_scrapper_tpu.storage.fsio import default_fs
+
+        fs = fs or default_fs()
+        if not fs.exists(path):
+            return False
         try:
-            np.savez_compressed(tmp, **state)
-            # savez appends .npz when missing — normalise before replacing
-            written = tmp if os.path.exists(tmp) else f"{tmp}.npz"
-            os.replace(written, path)
-        finally:
-            for leftover in (tmp, f"{tmp}.npz"):
-                if os.path.exists(leftover):
-                    os.unlink(leftover)
+            self.load_index(path)
+            return True
+        except IndexFingerprintError:
+            raise  # config mismatch — loud by design
+        except Exception as e:
+            # substrate damage of every flavour: zipfile.BadZipFile,
+            # EOFError, KeyError, OSError — and numpy's own ValueErrors on
+            # corrupted archives ("Cannot load file containing pickled
+            # data...", "EOF: reading array data"), which is why the
+            # fingerprint branch above needs its own exception type
+            import sys
+
+            # load_index mutates progressively — discard whatever half of
+            # the checkpoint made it in before the corruption was hit
+            self._reset_stream_state()
+
+            quarantine = f"{path}.quarantine-{os.getpid()}"
+            try:
+                fs.replace(path, quarantine)
+            except OSError:
+                quarantine = "<unmovable>"
+            print(
+                f"tpu_batch: stream-index checkpoint {path} is unreadable "
+                f"({e}); quarantined to {quarantine}, resuming with an "
+                "empty index",
+                file=sys.stderr,
+            )
+            return False
 
     def load_index(self, path: str) -> None:
         """Inverse of :meth:`save_index`; the backend must be configured
@@ -194,7 +282,7 @@ class TpuBatchBackend:
         num_perm/banding/seed would corrupt membership silently)."""
         with np.load(path) as data:
             if not np.array_equal(data["fingerprint"], self._config_fingerprint()):
-                raise ValueError(
+                raise IndexFingerprintError(
                     f"stream-index checkpoint {path} was written under a "
                     "different dedup config (num_perm/bands/k/seed/subbands/"
                     "stream_index/bloom geometry); refusing to resume against it"

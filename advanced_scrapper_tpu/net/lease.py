@@ -72,9 +72,16 @@ class LeaseServer:
         self.host = host if host is not None else cfg.host
         self.port = port if port is not None else cfg.port
         self._urls: queue.SimpleQueue[str] = queue.SimpleQueue()
+        # dedup on ingest: a url is one unit of work (the per-client
+        # assigned sets — and the stray-result guard built on them — are
+        # keyed by url, so a duplicated input row would leave a pending
+        # count that can never drain)
+        seen: set[str] = set()
         for u in urls:
-            self._urls.put(u)
-        self._pending = len(urls)
+            if u not in seen:
+                seen.add(u)
+                self._urls.put(u)
+        self._pending = len(seen)
         self._assigned: dict[int, set[str]] = {}
         self._lock = threading.Lock()
         self.results: list[dict] = []
@@ -174,11 +181,20 @@ class LeaseServer:
                     self.stats.record_response()
                     url = msg.get("url")
                     with self._lock:
-                        self._assigned[cid].discard(url)
-                        self._pending -= 1
-                    self.results.append(
-                        {"url": url, "html_content": msg.get("html_content", "")}
-                    )
+                        # accept only urls this client actually holds: a
+                        # duplicate or stray result (a client racing its
+                        # own half-frame death, a reconnect replay) must
+                        # neither double-decrement the pending count (it
+                        # would end the run with urls still queued) nor
+                        # append a second row for a finished url
+                        known = url in self._assigned.get(cid, ())
+                        if known:
+                            self._assigned[cid].discard(url)
+                            self._pending -= 1
+                    if known:
+                        self.results.append(
+                            {"url": url, "html_content": msg.get("html_content", "")}
+                        )
                 elif kind == "tasks_completed":
                     _send_json(conn, wlock, {"type": "acknowledge_completion"})
                     return
@@ -248,12 +264,17 @@ class LeaseClient:
         host: str | None = None,
         port: int | None = None,
         sleep=time.sleep,
+        connect: Callable | None = None,
     ):
         self.cfg = cfg
         self.host = host if host is not None else cfg.host
         self.port = port if port is not None else cfg.port
         self.transport_factory = transport_factory
         self.sleep = sleep
+        # injectable dialer (``(host, port) -> socket``): the seam the
+        # chaos harness uses to put a ChaosSocket under the whole client
+        # without touching protocol code (net/chaos.py)
+        self._connect = connect
         self._tasks: queue.Queue[str] = queue.Queue()
         self._results: queue.Queue[tuple[str, str]] = queue.Queue()
         self._inflight = 0              # urls popped but not yet resulted
@@ -270,7 +291,12 @@ class LeaseClient:
         Stops when the server's queue is drained (an empty ``task_batch``)
         and all local work is done, or after ``max_seconds``.
         """
-        self._sock = socket.create_connection((self.host, self.port), timeout=10)
+        if self._connect is not None:
+            self._sock = self._connect((self.host, self.port))
+        else:
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=10
+            )
         reader = _LineReader(self._sock)
         fetched = 0
 

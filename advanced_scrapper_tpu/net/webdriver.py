@@ -274,6 +274,16 @@ class _WireDriver:
     def quit(self) -> None:
         try:
             self._session.quit()
+        except Exception as e:
+            # a crashed/unreachable driver cannot honour Delete Session —
+            # the teardown path must still terminate the process instead
+            # of exploding inside every engine worker's finally block.
+            # Logged, not silent: against a REMOTE driver there is no
+            # service to reap, so a swallowed failure here is a leaked
+            # session slot (geckodriver serves one session per process)
+            import sys
+
+            print(f"webdriver: Delete Session failed: {e}", file=sys.stderr)
         finally:
             if self._service is not None:
                 self._service.stop()

@@ -287,19 +287,34 @@ class NearDupEngine:
             return shingles[i]
 
         checked = 0
+        sigs_np = None
         for r, c in zip(*np.nonzero(need)):
             j = int(rb[r, c])
             key = (min(int(r), j), max(int(r), j))
             if key not in pairs:
                 if checked >= self.cfg.exact_verify_cap:
-                    continue  # est-only beyond the cap (pathological corpora)
-                checked += 1
-                pairs[key] = (
-                    jaccard(sset(key[0]), sset(key[1]))
-                    >= self.cfg.sim_threshold
-                )
+                    # past the cap (pathological all-borderline corpora)
+                    # the edge keeps an ESTIMATOR verdict — but at the
+                    # strict fine-only bar (base + fine_margin) the
+                    # estimator-only paths apply, not plain base: the
+                    # certified path must never verify a flagged edge
+                    # more laxly than the uncertified ones do
+                    if sigs_np is None:
+                        sigs_np = np.asarray(sigs)
+                    agree = float(
+                        (sigs_np[key[0]] == sigs_np[key[1]]).mean()
+                    )
+                    pairs[key] = agree >= (
+                        self.cfg.sim_threshold + self.cfg.fine_margin
+                    )
+                else:
+                    checked += 1
+                    pairs[key] = (
+                        jaccard(sset(key[0]), sset(key[1]))
+                        >= self.cfg.sim_threshold
+                    )
             if not pairs[key]:
-                ok[r, c] = False  # exact Jaccard refuted the merge
+                ok[r, c] = False  # exact Jaccard (or strict bar) refuted it
         return ok
 
     def dedup_reps(self, texts: Sequence[str | bytes]) -> np.ndarray:

@@ -36,6 +36,26 @@ CHAR_LIST = list("abcdefghijklmnopqrstuvwxyz") + list("1234567890") + ["-", "_",
 # ref yahoo_links_selenium.py:28
 
 
+def _atomic_write_df(path: str, df: pd.DataFrame, fs=None) -> None:
+    """``df.to_csv`` streamed straight into the atomic tmp+fsync+rename
+    commit — no whole-file string/bytes buffer (the merged url CSV can be
+    hundreds of MB)."""
+    from advanced_scrapper_tpu.storage.fsio import atomic_write
+
+    def write(fh):
+        wrapper = io.TextIOWrapper(fh, encoding="utf-8", newline="")
+        try:
+            df.to_csv(wrapper, index=False)
+            wrapper.flush()
+        finally:
+            try:
+                wrapper.detach()  # flush without closing the tmp handle
+            except Exception:
+                pass  # a failed write already owns the propagating error
+
+    atomic_write(path, write, fs=fs)
+
+
 def shard_prefixes(shard_dir: str) -> list[str]:
     """All 2-char prefixes without an existing shard file (resume, ref :29-34)."""
     done = set(os.listdir(shard_dir)) if os.path.isdir(shard_dir) else set()
@@ -81,23 +101,31 @@ def parse_cdx_text(text: str) -> pd.DataFrame:
     )
 
 
-def persist_shard(prefix: str, page: str, cfg: HarvestConfig) -> str | None:
+def persist_shard(prefix: str, page: str, cfg: HarvestConfig, fs=None) -> str | None:
     """Parse + persist one fetched CDX shard page (ref :38-82) — the
     engine-independent half shared by the threaded and async harvesters,
-    so their shard files are byte-identical by construction."""
+    so their shard files are byte-identical by construction.
+
+    Both files commit via ``fsio.atomic_replace`` (tmp+fsync+rename): a
+    crash at any byte leaves each of them whole or absent, never torn.
+    That matters doubly for the ``.txt``: it is the resume checkpoint
+    ``shard_prefixes`` keys on, so a torn one would permanently mark an
+    unfinished shard as done — the one failure the anti-join can't heal.
+    """
+    from advanced_scrapper_tpu.storage.fsio import atomic_replace
+
     text = BeautifulSoup(page, "html.parser").get_text(separator="\n", strip=True)
     csv_path = None
     if text.strip():
         df = normalize_cdx_frame(parse_cdx_text(text))
         csv_path = os.path.join(cfg.shard_dir, f"yahoo_{prefix}.csv")
-        df.to_csv(csv_path, index=False)
+        _atomic_write_df(csv_path, df, fs=fs)
     # the .txt is the resume checkpoint (shard_prefixes skips on it), so
     # it must be written only once the shard fully succeeded — the
     # reference writes it first (:52-54) and silently loses shards whose
     # parse then fails; checkpoint-last fixes that
     txt_path = os.path.join(cfg.shard_dir, f"yahoo_{prefix}.txt")
-    with open(txt_path, "w", encoding="utf-8") as f:
-        f.write(text)
+    atomic_replace(txt_path, text.encode("utf-8"), fs=fs)
     return csv_path
 
 
@@ -138,7 +166,9 @@ def merge_shards(cfg: HarvestConfig, *, use_tpu: bool = True) -> int:
         merged = merged[keep]
     else:
         merged = merged.drop_duplicates(subset=["url"])
-    merged.to_csv(cfg.output_csv, index=False)
+    # atomic commit: a crash mid-merge must leave the previous output CSV
+    # (which the scrape stage may already be consuming) whole, not torn
+    _atomic_write_df(cfg.output_csv, merged)
     print(f"Found {len(merged)} unique URLs → {cfg.output_csv}")
     return len(merged)
 

@@ -1,7 +1,7 @@
 """L1 alternative engine: asyncio CDX harvester (the Scrapy-slot filler).
 
 The reference kept a second harvester built on an async crawler framework
-(``/root/reference/yahoo_links_scrapy.py`` — a Scrapy spider yielding the
+(``/root/reference/experiental/yahoo_links_scrapy.py`` — a Scrapy spider yielding the
 same 1,444 prefix queries with identical shard-skip logic, :20-28) beside
 the threaded Selenium one.  This module fills that slot TPU-era-style:
 the same shard enumeration, resume semantics, normalisation chain and
@@ -120,12 +120,16 @@ async def harvest_shards_async(
     async def one(prefix: str) -> bool:
         url = cdx_query_url(prefix, cfg)
         try:
-            # the semaphore bounds only the NETWORK fetch; parse+persist
-            # happens outside it so a slow pandas parse of a big shard
-            # never starves HTTP concurrency
+            # the semaphore is held across fetch AND persist: a fetched
+            # page only releases its slot once it is on disk, so the
+            # number of pages resident in memory is bounded by the
+            # concurrency (a persist stage falling behind on a slow disk
+            # can no longer balloon RSS with completed fetches; persist
+            # still runs in a worker thread, so the event loop keeps
+            # serving the other slots' I/O)
             async with sem:
                 page = await fetch(url)
-            await asyncio.to_thread(persist_shard, prefix, page, cfg)
+                await asyncio.to_thread(persist_shard, prefix, page, cfg)
             return True
         except Exception as e:
             # same per-shard containment as the threaded engine: a

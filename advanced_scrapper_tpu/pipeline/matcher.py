@@ -856,6 +856,14 @@ def run_matcher(
     finally:
         if pool is not None:
             pool.shutdown()
+        # detach the controller: it holds a verdict measured on THIS
+        # backend+corpus, and a later direct match_chunk(..., 'auto')
+        # against the shared index must fall back to the measured-safe
+        # off default instead of silently reusing a stale measurement
+        if controller is not None and getattr(
+            index, "refine_controller", None
+        ) is controller:
+            del index.refine_controller
     for f in os.listdir(out_dir):
         sort_matched_csv(os.path.join(out_dir, f))
     print(f"Matching complete: {n_matches} ticker-article matches → {out_dir}/")

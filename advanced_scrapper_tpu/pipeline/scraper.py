@@ -312,58 +312,63 @@ class ScraperEngine:
 
             threading.Thread(target=stats_loop, daemon=True).start()
 
-        with AppendCsv(success_csv, SUCCESS_FIELDS) as ok_csv, AppendCsv(
-            failed_csv, FAILED_FIELDS
-        ) as bad_csv:
-            processed = 0
-            while processed < len(urls):
-                try:
-                    kind, data = result_q.get(timeout=self.cfg.result_timeout)
-                except queue.Empty:
-                    summary.errors.append("result timeout")
-                    break
-                if kind == "success":
-                    ok_csv.write_row(data)  # write_row fills missing fields
-                    summary.succeeded += 1
-                    processed += 1
-                    if self.on_success is not None:
-                        try:
-                            self.on_success(dict(data))
-                        except Exception as e:
-                            summary.errors.append(f"on_success: {e}")
-                elif kind == "failed":
-                    bad_csv.write_row(data)
-                    summary.failed += 1
-                    processed += 1
-                elif kind == "rate_limit":
-                    # Sentinel-path events carry the consumed url: count it so
-                    # the loop terminates without stalling on result_timeout.
-                    # Fingerprint-path events (data None) already produced a
-                    # failed row and must not double-count.
-                    if data is not None:
-                        summary.rate_limited_skipped += 1
+        try:
+            with AppendCsv(success_csv, SUCCESS_FIELDS) as ok_csv, AppendCsv(
+                failed_csv, FAILED_FIELDS
+            ) as bad_csv:
+                processed = 0
+                while processed < len(urls):
+                    try:
+                        kind, data = result_q.get(timeout=self.cfg.result_timeout)
+                    except queue.Empty:
+                        summary.errors.append("result timeout")
+                        break
+                    if kind == "success":
+                        ok_csv.write_row(data)  # write_row fills missing fields
+                        summary.succeeded += 1
                         processed += 1
-                    # Wait out the pause here too (ref :463-468) — otherwise
-                    # the result timeout below would fire mid-pause and abort
-                    # the run.  The pause controller is the single authority.
-                    self.console.event(
-                        f"Rate limit: pausing {self.pause.remaining():.0f} s"
-                    )
-                    self.pause.wait(sleep=self.sleep, should_stop=self._stop.is_set)
-                    self.console.event("Resuming scraping.")
-        summary.attempted = summary.succeeded + summary.failed
-        summary.rate_limit_trips = self.pause.trips
-        self._stop.set()
-        stats_stop.set()
-        if feeder is not None:
-            feeder.join(timeout=5)
-        if pool is not None:
-            pool.stop()
-        for w in workers:
-            w.join(timeout=5)
-        if self._owns_console:
-            self.console.stop()
-        self.console.drain()
+                        if self.on_success is not None:
+                            try:
+                                self.on_success(dict(data))
+                            except Exception as e:
+                                summary.errors.append(f"on_success: {e}")
+                    elif kind == "failed":
+                        bad_csv.write_row(data)
+                        summary.failed += 1
+                        processed += 1
+                    elif kind == "rate_limit":
+                        # Sentinel-path events carry the consumed url: count it so
+                        # the loop terminates without stalling on result_timeout.
+                        # Fingerprint-path events (data None) already produced a
+                        # failed row and must not double-count.
+                        if data is not None:
+                            summary.rate_limited_skipped += 1
+                            processed += 1
+                        # Wait out the pause here too (ref :463-468) — otherwise
+                        # the result timeout below would fire mid-pause and abort
+                        # the run.  The pause controller is the single authority.
+                        self.console.event(
+                            f"Rate limit: pausing {self.pause.remaining():.0f} s"
+                        )
+                        self.pause.wait(sleep=self.sleep, should_stop=self._stop.is_set)
+                        self.console.event("Resuming scraping.")
+        finally:
+            # always tear the fleet down — a CSV write failing with EIO
+            # (chaos substrate, disk full) must not strand live worker
+            # threads behind the propagating exception
+            summary.attempted = summary.succeeded + summary.failed
+            summary.rate_limit_trips = self.pause.trips
+            self._stop.set()
+            stats_stop.set()
+            if feeder is not None:
+                feeder.join(timeout=5)
+            if pool is not None:
+                pool.stop()
+            for w in workers:
+                w.join(timeout=5)
+            if self._owns_console:
+                self.console.stop()
+            self.console.drain()
         return summary
 
 
@@ -439,10 +444,10 @@ def run_scraper(
             ),
         )
         # the fifth resume artifact: without the stream index a restarted
-        # run re-admits near-dups of everything already annotated
+        # run re-admits near-dups of everything already annotated; a torn
+        # checkpoint (pre-hardening crash) is quarantined and ignored
         index_ckpt = os.path.join(cfg.out_dir, f"stream_index_{cfg.website}.npz")
-        if os.path.exists(index_ckpt):
-            backend.load_index(index_ckpt)
+        backend.load_index_if_valid(index_ckpt)
         on_success = backend.submit
 
     console = ConsoleMux().start()

@@ -26,9 +26,17 @@ from advanced_scrapper_tpu.storage.csvio import scraped_url_set
 def anti_join_csv(
     input_csv: str, *done_csvs: str, column: str = "url"
 ) -> pd.DataFrame:
-    """Rows of ``input_csv`` whose url is in none of ``done_csvs``."""
+    """Rows of ``input_csv`` whose url is in none of ``done_csvs``.
+
+    ``repair=False``: the done CSVs arrive on the CLI and may be
+    hand-maintained, so they are read leniently and never mutated (the
+    torn-tail quarantine is only correct for framework-owned append
+    artifacts — ``storage/csvio.py``).  A torn done row parses to a
+    partial url here, which errs toward re-queueing that url: duplicate
+    work on resume, never a silently dropped one.
+    """
     df = pd.read_csv(input_csv)
-    done = scraped_url_set(*done_csvs, column=column)
+    done = scraped_url_set(*done_csvs, column=column, repair=False)
     return df[~df[column].astype(str).isin(done)]
 
 

@@ -1,0 +1,271 @@
+"""Socket-plane fault injection: the lease protocol under deliberate chaos.
+
+The third I/O plane (after ``ChaosTransport`` for fetches and ``ChaosFs``
+for storage): seeded mid-frame cuts, slow-loris trickle and fragmented
+reads driven through the REAL lease server/client, asserting the
+half-frame-death contract — a url whose result frame dies mid-wire is
+requeued and completed by another client, never lost and never doubled.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+
+from advanced_scrapper_tpu.config import FeedConfig
+from advanced_scrapper_tpu.net.chaos import ChaosSocket, chaos_connector
+from advanced_scrapper_tpu.net.lease import LeaseClient, LeaseServer, _LineReader
+from advanced_scrapper_tpu.net.transport import MockTransport
+
+
+def _cfg(**kw):
+    base = dict(host="127.0.0.1", port=0, batch_size=4, min_queue_length=2,
+                client_threads=2, client_rate=200.0)
+    base.update(kw)
+    return FeedConfig(**base)
+
+
+PAGE = "<html><body>doc</body></html>"
+
+
+def test_chaos_socket_ledger_reproducible_by_seed():
+    """Same seed ⇒ identical injected-fault ledger (the ChaosTransport
+    reproducibility contract, extended to the socket plane)."""
+
+    def run(seed):
+        a, b = socket.socketpair()
+        drain_stop = threading.Event()
+
+        def drain():
+            a.settimeout(0.2)
+            while not drain_stop.is_set():
+                try:
+                    if not a.recv(65536):
+                        return
+                except socket.timeout:
+                    continue
+                except OSError:
+                    return
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        chaos = ChaosSocket(
+            b, seed=seed, cut_rate=0.25, trickle_rate=0.3,
+            trickle_delay=0.0,
+        )
+        frames = [
+            json.dumps({"type": "result", "url": f"https://x/{i % 4}"}).encode()
+            + b"\n"
+            for i in range(24)
+        ]
+        outcomes = []
+        for f in frames:
+            try:
+                chaos.sendall(f)
+                outcomes.append("ok")
+            except ConnectionResetError:
+                outcomes.append("cut")
+                break  # socket is dead, like a real client
+        drain_stop.set()
+        t.join(timeout=2)
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+        return outcomes, list(chaos.ledger), dict(chaos.injected)
+
+    o1, l1, i1 = run(3)
+    o2, l2, i2 = run(3)
+    o3, l3, _ = run(4)
+    assert o1 == o2 and l1 == l2 and i1 == i2
+    assert (o1, l1) != (o3, l3)
+    assert sum(i1.values()) > 0, "chaos must actually fire"
+
+
+def test_half_frame_death_requeues_lease(tmp_path):
+    """A client that dies mid-result-frame: the partial frame must be
+    discarded, its leases requeued, and a healthy client must finish the
+    job with every url resulted exactly once."""
+    urls = [f"https://x/{i}.html" for i in range(8)]
+    cfg = _cfg()
+    server = LeaseServer(cfg, urls).start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        sock.sendall(b'{"type": "request_tasks", "num_urls": 5}\n')
+        reader = _LineReader(sock)
+        batch = reader.readline()
+        assert len(batch["urls"]) == 5
+        # one whole result, then HALF of a second result frame, then death
+        done_url, torn_url = batch["urls"][0], batch["urls"][1]
+        sock.sendall(
+            (json.dumps({"type": "result", "url": done_url,
+                         "html_content": PAGE}) + "\n").encode()
+        )
+        torn = (json.dumps({"type": "result", "url": torn_url,
+                            "html_content": PAGE}) + "\n").encode()
+        sock.sendall(torn[: len(torn) // 2])
+        time.sleep(0.3)
+        sock.close()  # half-frame death
+        time.sleep(0.5)
+
+        healthy = LeaseClient(
+            cfg, lambda: MockTransport(lambda u: PAGE), port=server.port
+        )
+        assert healthy.run(max_seconds=20) == 7  # 8 minus the whole result
+        assert server.wait_done(10)
+    finally:
+        server.stop()
+    got = [r["url"] for r in server.results]
+    assert sorted(got) == sorted(urls), "urls lost or invented"
+    assert len(got) == len(set(got)), "a url was resulted twice"
+
+
+def test_stray_result_does_not_corrupt_accounting():
+    """A result for a url the client does not hold (replayed frame,
+    byzantine peer) must neither decrement pending nor append a row."""
+    urls = ["https://x/a.html", "https://x/b.html"]
+    cfg = _cfg()
+    server = LeaseServer(cfg, urls).start()
+    try:
+        sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        reader = _LineReader(sock)
+        sock.sendall(b'{"type": "request_tasks", "num_urls": 1}\n')
+        batch = reader.readline()
+        (leased,) = batch["urls"]
+        frame = (json.dumps({"type": "result", "url": leased,
+                             "html_content": PAGE}) + "\n").encode()
+        sock.sendall(frame)
+        sock.sendall(frame)  # duplicate replay of the same frame
+        sock.sendall(  # and a url never leased to anyone
+            (json.dumps({"type": "result", "url": "https://x/forged.html",
+                         "html_content": PAGE}) + "\n").encode()
+        )
+        time.sleep(0.5)
+        assert not server.done(), "stray results must not drain the run"
+        sock.close()
+        healthy = LeaseClient(
+            cfg, lambda: MockTransport(lambda u: PAGE), port=server.port
+        )
+        assert healthy.run(max_seconds=20) == 1
+        assert server.wait_done(10)
+    finally:
+        server.stop()
+    got = [r["url"] for r in server.results]
+    assert sorted(got) == sorted(urls)
+
+
+def test_duplicate_input_urls_still_converge():
+    """A url appearing twice in the input is ONE unit of work: the server
+    must drain (not hang with a phantom pending count) and result it
+    exactly once."""
+    urls = ["https://x/a.html", "https://x/dup.html", "https://x/b.html",
+            "https://x/dup.html"]
+    cfg = _cfg()
+    server = LeaseServer(cfg, urls).start()
+    try:
+        client = LeaseClient(
+            cfg, lambda: MockTransport(lambda u: PAGE), port=server.port
+        )
+        assert client.run(max_seconds=20) == 3
+        assert server.wait_done(10), "duplicate input url wedged the server"
+    finally:
+        server.stop()
+    got = [r["url"] for r in server.results]
+    assert sorted(got) == sorted(set(urls))
+
+
+def test_trickled_and_fragmented_frames_still_parse(tmp_path):
+    """Slow-loris sends + few-byte reads: the NDJSON reassembly must not
+    depend on frame-per-recv delivery."""
+    urls = [f"https://x/{i}.html" for i in range(6)]
+    cfg = _cfg(client_threads=1)
+    server = LeaseServer(cfg, urls).start()
+    try:
+        connect, sockets = chaos_connector(
+            seed=11, trickle_rate=1.0, trickle_chunk=3, trickle_delay=0.001,
+            fragment_rate=0.5, fragment_bytes=7,
+        )
+        client = LeaseClient(
+            cfg,
+            lambda: MockTransport(lambda u: PAGE),
+            port=server.port,
+            connect=connect,
+        )
+        assert client.run(max_seconds=30) == 6
+        assert server.wait_done(10)
+        assert sockets and sum(sockets[0].injected.values()) > 0
+    finally:
+        server.stop()
+    got = [r["url"] for r in server.results]
+    assert sorted(got) == sorted(urls)
+    assert len(got) == len(set(got))
+
+
+def test_slow_loris_client_does_not_starve_others():
+    """One client dribbling a frame byte-by-byte must not stall the
+    server's other clients (one handler thread per connection)."""
+    urls = [f"https://x/{i}.html" for i in range(6)]
+    cfg = _cfg()
+    server = LeaseServer(cfg, urls).start()
+    loris_stop = threading.Event()
+
+    def loris():
+        try:
+            s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+            frame = b'{"type": "request_tasks", "num_urls": 1}\n'
+            for ch in frame[:-1]:  # never completes the frame
+                if loris_stop.is_set():
+                    break
+                s.sendall(bytes([ch]))
+                time.sleep(0.05)
+            loris_stop.wait(10)
+            s.close()
+        except OSError:
+            pass
+
+    t = threading.Thread(target=loris, daemon=True)
+    t.start()
+    try:
+        healthy = LeaseClient(
+            cfg, lambda: MockTransport(lambda u: PAGE), port=server.port
+        )
+        assert healthy.run(max_seconds=20) == 6
+        assert server.wait_done(10), "slow-loris starved the healthy client"
+    finally:
+        loris_stop.set()
+        server.stop()
+        t.join(timeout=5)
+
+
+def test_chaos_client_then_clean_resume_converges(tmp_path):
+    """A chaos client whose frames die mid-wire, then a clean client:
+    every url ends resulted exactly once and the central parse writes no
+    duplicate success rows (the socket-plane no-url-lost invariant)."""
+    urls = [f"https://x/{i}.html" for i in range(12)]
+    cfg = _cfg(client_threads=1)
+    server = LeaseServer(cfg, urls).start()
+    try:
+        connect, sockets = chaos_connector(seed=7, cut_rate=0.35)
+        chaos_client = LeaseClient(
+            cfg,
+            lambda: MockTransport(lambda u: PAGE),
+            port=server.port,
+            connect=connect,
+        )
+        chaos_client.run(max_seconds=10)
+        time.sleep(0.3)  # let the server notice the dead connection
+        assert sockets[0].injected["cut"] >= 1, "chaos must actually fire"
+
+        healthy = LeaseClient(
+            cfg, lambda: MockTransport(lambda u: PAGE), port=server.port
+        )
+        healthy.run(max_seconds=20)
+        assert server.wait_done(10)
+    finally:
+        server.stop()
+    got = [r["url"] for r in server.results]
+    assert sorted(got) == sorted(urls), "urls lost under socket chaos"
+    assert len(got) == len(set(got)), "a url was resulted twice"

@@ -1,0 +1,346 @@
+"""Storage-plane fault injection: persistence sites under deliberate chaos.
+
+The storage twin of ``tests/test_chaos.py``: seeded short writes, EIO on
+flush, fsync failures and crash-after-N-bytes driven through the REAL
+persistence sites (AppendCsv, shard files, the stream-index npz),
+asserting the torn-write-safety contract — checkpoints whole-or-absent,
+torn CSV tails quarantined, resume converging with zero lost and zero
+duplicated rows.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from advanced_scrapper_tpu.config import HarvestConfig, ScraperConfig
+from advanced_scrapper_tpu.net.transport import MockTransport
+from advanced_scrapper_tpu.pipeline.scraper import SUCCESS_FIELDS, ScraperEngine
+from advanced_scrapper_tpu.storage.csvio import (
+    AppendCsv,
+    count_rows,
+    read_url_column,
+    repair_torn_tail,
+    scraped_url_set,
+)
+from advanced_scrapper_tpu.storage.fsio import (
+    ChaosFs,
+    OsFs,
+    SimulatedCrash,
+    atomic_replace,
+    set_default_fs,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+ARTICLE_HTML = open(os.path.join(FIXTURES, "yfin_article.html")).read()
+
+
+@pytest.fixture(autouse=True)
+def _reset_default_fs():
+    yield
+    set_default_fs(None)
+
+
+# -- determinism -------------------------------------------------------------
+
+def test_chaos_fs_ledger_reproducible_by_seed(tmp_path):
+    """Same seed ⇒ identical injected-fault ledger (the ChaosTransport
+    reproducibility contract, extended to the storage plane)."""
+
+    def run(seed):
+        fs = ChaosFs(
+            seed=seed,
+            short_write_rate=0.25,
+            eio_flush_rate=0.2,
+            fsync_error_rate=0.2,
+            crash_rate=0.1,
+        )
+        path = str(tmp_path / f"ledger-{seed}.bin")
+        outcomes = []
+        for i in range(40):
+            try:
+                with fs.open(path, "ab") as fh:
+                    fh.write(b"x" * (10 + i))
+                    fh.flush()
+                    fs.fsync(fh)
+                outcomes.append("ok")
+            except SimulatedCrash:
+                outcomes.append("crash")
+            except OSError as e:
+                outcomes.append(f"eio:{e.errno}")
+        os.unlink(path)
+        return outcomes, list(fs.ledger), dict(fs.injected)
+
+    o1, l1, i1 = run(9)
+    o2, l2, i2 = run(9)
+    o3, l3, _ = run(10)
+    # ledgers key on basenames, so they are comparable across directories
+    assert o1 == o2 and l1 == l2 and i1 == i2
+    assert sum(i1.values()) > 0, "chaos must actually fire"
+    assert (o1, [k for _, _, k in l1]) != (o3, [k for _, _, k in l3])
+
+
+# -- atomic whole-file persistence -------------------------------------------
+
+def test_atomic_replace_whole_or_previous_under_crash(tmp_path):
+    """Crash at any injected point: the target keeps its previous bytes
+    (or stays absent); only tmp garbage — invisible to readers — is torn."""
+    path = str(tmp_path / "ckpt.bin")
+    atomic_replace(path, b"generation-0" * 100, fs=OsFs())
+    crashed = 0
+    for seed in range(12):
+        fs = ChaosFs(seed=seed, crash_rate=0.5, short_write_rate=0.2)
+        try:
+            atomic_replace(path, b"generation-1" * 100, fs=fs)
+        except (SimulatedCrash, OSError):
+            crashed += 1
+            got = open(path, "rb").read()
+            assert got in (b"generation-0" * 100, b"generation-1" * 100), (
+                "target torn mid-crash"
+            )
+    assert crashed > 0, "chaos must actually fire"
+    atomic_replace(path, b"generation-2", fs=OsFs())
+    assert open(path, "rb").read() == b"generation-2"
+    assert not [p for p in os.listdir(tmp_path) if ".tmp-" in p and "gen" in p]
+
+
+def test_atomic_write_sweeps_crashed_writers_tmp_orphans(tmp_path):
+    """tmp files left by SIGKILLed writers (foreign pids) of the same
+    target are swept on the next commit — crash-restart cycles must not
+    grow the directory unboundedly."""
+    path = str(tmp_path / "ck.bin")
+    orphan = f"{path}.tmp-99999991"
+    with open(orphan, "wb") as f:
+        f.write(b"torn garbage from a dead writer")
+    atomic_replace(path, b"fresh", fs=OsFs())
+    assert open(path, "rb").read() == b"fresh"
+    assert not os.path.exists(orphan), "stale tmp orphan not swept"
+
+
+def test_persist_shard_checkpoint_whole_or_absent(tmp_path):
+    """The harvest shard .txt (the resume checkpoint) must never exist
+    torn, no matter where the storage substrate fails."""
+    from advanced_scrapper_tpu.pipeline.harvest import persist_shard
+
+    cfg = HarvestConfig(shard_dir=str(tmp_path), output_csv=str(tmp_path / "o.csv"))
+    page = "<html><body><pre>a 20200101 https://x/a.html t 200 H 1</pre></body></html>"
+    from bs4 import BeautifulSoup
+
+    expected = BeautifulSoup(page, "html.parser").get_text(
+        separator="\n", strip=True
+    )
+    crashed = 0
+    for seed in range(10):
+        fs = ChaosFs(seed=seed, crash_rate=0.4, short_write_rate=0.2)
+        try:
+            persist_shard("aa", page, cfg, fs=fs)
+        except (SimulatedCrash, OSError):
+            crashed += 1
+        txt = tmp_path / "yahoo_aa.txt"
+        if txt.exists():
+            assert txt.read_text(encoding="utf-8") == expected, "torn checkpoint"
+    assert crashed > 0, "chaos must actually fire"
+    persist_shard("aa", page, cfg)  # clean fs heals
+    assert (tmp_path / "yahoo_aa.txt").read_text(encoding="utf-8") == expected
+
+
+# -- torn-tail CSV quarantine ------------------------------------------------
+
+def _build_success_csv(path: str) -> tuple[bytes, int]:
+    """A success CSV whose final row is quote-heavy and newline-embedded
+    (the hardest torn-tail shape); returns (bytes, final-row offset)."""
+    with AppendCsv(path, SUCCESS_FIELDS) as c:
+        c.write_row({"url": "https://x/done1.html", "title": "T1",
+                     "article": 'first "quoted" body\nwith a newline'})
+        c.write_row({"url": "https://x/done2.html", "title": "T2",
+                     "article": "plain body"})
+        c.write_row({"url": "https://x/torn.html", "title": "T3",
+                     "article": 'tail "q1" body\nline2, with, commas\n"q2" end'})
+    full = open(path, "rb").read()
+    # the final row starts where truncating to it leaves exactly rows 1-2
+    marker = b"https://x/torn.html"
+    return full, full.index(marker)
+
+
+def test_torn_tail_quarantined_at_every_byte_offset(tmp_path):
+    """Hand-truncate a success CSV at EVERY byte offset of its final row:
+    the resume anti-join must neither crash nor forget completed URLs,
+    and the torn row's URL must stay eligible for re-scrape (never parse
+    as completed)."""
+    base = str(tmp_path / "base.csv")
+    full, row_start = _build_success_csv(base)
+    completed = {"https://x/done1.html", "https://x/done2.html"}
+    for cut in range(row_start + 1, len(full)):
+        path = str(tmp_path / "t.csv")
+        with open(path, "wb") as f:
+            f.write(full[:cut])
+        got = scraped_url_set(path)  # repairs + reads — must not raise
+        assert completed <= got, f"completed url forgotten at offset {cut}"
+        assert "https://x/torn.html" not in got, (
+            f"torn row silently parsed as completed at offset {cut}"
+        )
+        # the torn bytes are evidence, not garbage: quarantined, and the
+        # file itself is back to whole records
+        assert open(path, "rb").read() == full[:row_start]
+        assert os.path.exists(path + ".quarantine")
+        os.unlink(path)
+        os.unlink(path + ".quarantine")
+    # truncating at the exact end of row 2 is simply a clean shorter file
+    path = str(tmp_path / "clean.csv")
+    with open(path, "wb") as f:
+        f.write(full[:row_start])
+    assert scraped_url_set(path) == completed
+    assert not os.path.exists(path + ".quarantine")
+
+
+def test_append_after_torn_tail_never_merges_rows(tmp_path):
+    """Re-scraping the torn URL appends a fresh row — it must land after
+    the repaired tail, not concatenate onto the partial record."""
+    path = str(tmp_path / "ok.csv")
+    full, row_start = _build_success_csv(path)
+    with open(path, "wb") as f:
+        f.write(full[: row_start + 25])  # torn mid-url-field
+    with AppendCsv(path, SUCCESS_FIELDS) as c:  # repairs, then appends
+        c.write_row({"url": "https://x/torn.html", "title": "T3",
+                     "article": "rescraped body"})
+    urls = read_url_column(path)
+    assert urls == [
+        "https://x/done1.html", "https://x/done2.html", "https://x/torn.html"
+    ]
+    assert len(urls) == len(set(urls))
+    assert count_rows(path) == 3
+
+
+def test_external_unterminated_csv_read_leniently_and_unmutated(tmp_path):
+    """A hand-made work list whose last line lacks a trailing newline is
+    COMPLETE, not torn: the default read must keep its final row and must
+    not rewrite the user's file (only framework-owned anti-join reads
+    repair)."""
+    path = str(tmp_path / "urls.csv")
+    raw = b"url\nhttps://x/a.html\nhttps://x/b.html"  # no trailing newline
+    with open(path, "wb") as f:
+        f.write(raw)
+    assert read_url_column(path) == ["https://x/a.html", "https://x/b.html"]
+    assert open(path, "rb").read() == raw, "external input was mutated"
+    assert not os.path.exists(path + ".quarantine")
+    # the framework-owned flavour of the same bytes IS treated as torn
+    assert read_url_column(path, repair=True) == ["https://x/a.html"]
+    assert os.path.exists(path + ".quarantine")
+
+
+def test_repair_is_idempotent_and_clean_files_untouched(tmp_path):
+    path = str(tmp_path / "ok.csv")
+    full, row_start = _build_success_csv(path)
+    assert repair_torn_tail(path) == 0  # clean file: no mutation
+    assert open(path, "rb").read() == full
+    with open(path, "wb") as f:
+        f.write(full[: row_start + 10])
+    assert repair_torn_tail(path) == 10
+    assert repair_torn_tail(path) == 0  # second pass: nothing left to do
+
+
+# -- the engine under storage chaos ------------------------------------------
+
+def _engine(transport, **cfg_kw):
+    from advanced_scrapper_tpu.extractors import load_extractor
+
+    base = dict(
+        desired_request_rate=500.0, max_threads=4,
+        rate_limit_wait=0.05, result_timeout=5.0,
+    )
+    base.update(cfg_kw)
+    return ScraperEngine(
+        ScraperConfig(**base), load_extractor("yfin"), lambda: transport
+    )
+
+
+def test_engine_storage_fault_then_resume_converges(tmp_path):
+    """EIO out of the success-CSV writer mid-run: the engine run dies (a
+    storage fault IS a crash), worker threads are torn down, and a resume
+    with a healthy substrate converges — no url lost, none duplicated."""
+    urls = [f"https://x/doc{i}.html" for i in range(30)]
+    pages = {u: ARTICLE_HTML for u in urls}
+    ok, bad = str(tmp_path / "ok.csv"), str(tmp_path / "bad.csv")
+
+    chaos = ChaosFs(
+        seed=3, short_write_rate=0.12, eio_flush_rate=0.08, only="ok.csv"
+    )
+    set_default_fs(chaos)
+    try:
+        with pytest.raises(OSError):
+            _engine(MockTransport(pages)).run(urls, ok, bad)
+    finally:
+        set_default_fs(None)
+    assert sum(chaos.injected.values()) > 0, "chaos must actually fire"
+
+    done = scraped_url_set(ok, bad)  # repairs any torn tail
+    todo = [u for u in urls if u not in done]
+    assert todo, "the fault should have interrupted the run early"
+    _engine(MockTransport(pages)).run(todo, ok, bad)
+    final_ok = read_url_column(ok)
+    assert set(final_ok) | set(read_url_column(bad)) == set(urls)
+    assert len(final_ok) == len(set(final_ok)), "duplicate success rows"
+
+
+# -- stream-index checkpoint -------------------------------------------------
+
+def test_save_index_whole_or_previous_and_torn_quarantine(tmp_path):
+    """The npz checkpoint survives substrate faults whole-or-previous; a
+    hand-torn checkpoint is quarantined (ignored), not a crash."""
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+
+    cfg = DedupConfig(batch_size=4, block_len=256)
+    ckpt = str(tmp_path / "stream_index.npz")
+    backend = TpuBatchBackend(cfg, text_field="article", key_field="url")
+    for i in range(4):
+        backend.submit({"article": f"document body number {i} " * 10,
+                        "url": f"https://x/{i}"})
+    backend.flush()
+    backend.save_index(ckpt)
+    gen0 = open(ckpt, "rb").read()
+
+    crashed = 0
+    for seed in range(8):
+        fs = ChaosFs(seed=seed, crash_rate=0.5, short_write_rate=0.2)
+        try:
+            backend.save_index(ckpt, fs=fs)
+        except (SimulatedCrash, OSError):
+            crashed += 1
+            assert open(ckpt, "rb").read() == gen0, "checkpoint torn"
+    assert crashed > 0, "chaos must actually fire"
+
+    fresh = TpuBatchBackend(cfg, text_field="article", key_field="url")
+    assert fresh.load_index_if_valid(ckpt) is True
+    assert fresh.stats.submitted == 4
+
+    # torn checkpoint: quarantined + ignored, never a traceback
+    with open(ckpt, "wb") as f:
+        f.write(gen0[: len(gen0) // 2])
+    fresh2 = TpuBatchBackend(cfg, text_field="article", key_field="url")
+    assert fresh2.load_index_if_valid(ckpt) is False
+    assert not os.path.exists(ckpt), "torn checkpoint left in place"
+    assert any(".quarantine-" in n for n in os.listdir(tmp_path))
+    # absent checkpoint: plain False, no quarantine
+    assert fresh2.load_index_if_valid(ckpt) is False
+
+    # garbage (non-zip) bytes make np.load raise ValueError — that must be
+    # quarantined too, NOT confused with the fingerprint mismatch below
+    with open(ckpt, "wb") as f:
+        f.write(b"this was never an npz archive at all")
+    assert fresh2.load_index_if_valid(ckpt) is False
+    assert not os.path.exists(ckpt)
+
+    # a config-fingerprint mismatch stays loud: operator error, not damage
+    from advanced_scrapper_tpu.config import DedupConfig as _DC
+    from advanced_scrapper_tpu.extractors.tpu_batch import IndexFingerprintError
+
+    backend.save_index(ckpt)
+    other = TpuBatchBackend(
+        _DC(batch_size=4, block_len=256, seed=99),
+        text_field="article", key_field="url",
+    )
+    with pytest.raises(IndexFingerprintError):
+        other.load_index_if_valid(ckpt)
+    assert os.path.exists(ckpt), "mismatch must not quarantine the checkpoint"

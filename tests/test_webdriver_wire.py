@@ -45,6 +45,8 @@ class WebDriverHandler(http.server.BaseHTTPRequestHandler):
     ready_polls_until_complete = 0
     heights = [100]
     neterror_urls = ()
+    status_polls = 0
+    single_session = False  # geckodriver: one session per process
 
     def log_message(self, *a):
         pass
@@ -65,6 +67,12 @@ class WebDriverHandler(http.server.BaseHTTPRequestHandler):
         cls = type(self)
         cls.requests_seen.append(("GET", self.path, None))
         if self.path == "/status":
+            import os as _os
+
+            cls.status_polls += 1
+            unready = int(_os.environ.get("FAKE_DRIVER_STATUS_UNREADY", "0") or 0)
+            if cls.status_polls <= unready:
+                return self._json(200, {"ready": False, "message": "starting"})
             return self._json(200, {"ready": True, "message": "fake ready"})
         parts = self.path.strip("/").split("/")
         if len(parts) == 3 and parts[0] == "session" and parts[2] == "source":
@@ -82,6 +90,15 @@ class WebDriverHandler(http.server.BaseHTTPRequestHandler):
         cls.requests_seen.append(("POST", self.path, payload))
         parts = self.path.strip("/").split("/")
         if self.path == "/session":
+            if cls.single_session and cls.sessions:
+                # geckodriver's single-session behaviour, verbatim error
+                return self._json(
+                    500,
+                    {
+                        "error": "session not created",
+                        "message": "Session is already started",
+                    },
+                )
             sid = f"sess-{len(cls.sessions)}"
             cls.sessions[sid] = {
                 "caps": payload,
@@ -106,6 +123,10 @@ class WebDriverHandler(http.server.BaseHTTPRequestHandler):
             )
         cmd = "/".join(parts[2:])
         if cmd == "url":
+            import os as _os
+
+            if _os.environ.get("FAKE_DRIVER_DIE_ON_NAVIGATE"):
+                _os._exit(9)  # the driver binary crashes mid-navigate
             url = payload["url"]
             if any(marker in url for marker in cls.neterror_urls):
                 return self._json(
@@ -168,6 +189,8 @@ def wire_server():
         ready_polls_until_complete = 0
         heights = [100]
         neterror_urls = ()
+        status_polls = 0
+        single_session = False
 
     srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
@@ -403,6 +426,62 @@ def test_make_transport_explicit_wire_names(wire_server):
             assert isinstance(t, cls)
         finally:
             t.close()
+
+
+# -- fake-driver conformance: crash, conflict, slow startup (VERDICT r5) ----
+
+def test_driver_crash_mid_navigate_surfaces_as_fetch_error(
+    fake_geckodriver, monkeypatch
+):
+    """The driver process dying DURING a navigate (real geckodriver does
+    this on OOM/SIGSEGV) must surface as FetchError at the transport — the
+    engine records a failed row and the url stays resumable — and close()
+    must still reap the dead process instead of raising."""
+    monkeypatch.setenv("FAKE_DRIVER_DIE_ON_NAVIGATE", "1")
+    t = WireFirefoxTransport(executable_path=fake_geckodriver)
+    service = t._driver._service
+    assert service._proc.poll() is None
+    with pytest.raises(FetchError):
+        t.fetch("https://news.example/crash.html")
+    t.close()  # dead driver: Delete Session is impossible, close still works
+    assert service._proc.poll() is not None, "driver process reaped"
+
+
+def test_session_conflict_is_webdriver_error_and_recovers(wire_server):
+    """geckodriver accepts ONE session per process: a second New Session
+    gets the 'session not created' error.  The wire client must surface it
+    as WebDriverError (never a KeyError on the missing sessionId), and a
+    fresh session must succeed once the first is deleted."""
+    from advanced_scrapper_tpu.net.webdriver import WebDriverError
+
+    url, handler = wire_server
+    handler.single_session = True
+    t1 = WireFirefoxTransport(remote_url=url)
+    with pytest.raises(WebDriverError, match="session not created"):
+        WireFirefoxTransport(remote_url=url)
+    assert "page0" in t1.fetch("https://news.example/still-alive.html")
+    t1.close()
+    t2 = WireFirefoxTransport(remote_url=url)  # slot freed by the delete
+    assert "page0" in t2.fetch("https://news.example/recovered.html")
+    t2.close()
+
+
+def test_slow_status_driver_startup(fake_geckodriver, monkeypatch):
+    """A driver whose /status stays unready for a while (cold Firefox
+    profile) must be waited out by DriverService — and a driver that never
+    becomes ready must fail with the startup-timeout error, not hang."""
+    monkeypatch.setenv("FAKE_DRIVER_STATUS_UNREADY", "6")  # ~0.6 s of polls
+    t = WireFirefoxTransport(executable_path=fake_geckodriver)
+    try:
+        assert "slow-start" in t.fetch("https://news.example/slow-start.html")
+    finally:
+        t.close()
+
+    from advanced_scrapper_tpu.net.webdriver import DriverService, WebDriverError
+
+    monkeypatch.setenv("FAKE_DRIVER_STATUS_UNREADY", "1000000")
+    with pytest.raises(WebDriverError, match="driver start timeout"):
+        DriverService(fake_geckodriver, startup_timeout=1.2)
 
 
 def test_wire_session_survives_adversarial_server_responses():

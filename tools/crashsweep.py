@@ -1,0 +1,526 @@
+#!/usr/bin/env python
+"""Kill-restart convergence sweep: SIGKILL the pipeline anywhere, prove resume heals.
+
+The resume model (CSV anti-join, shard-file checkpoints, stream-index npz
+— SURVEY §5.4) has always been an *assumption*: no test ever killed the
+process mid-write and asserted the invariants still hold.  This driver
+turns it into a tested contract:
+
+1. fork a REAL child running one of three workloads — CDX **harvest**,
+   constant-rate **scrape**, **stream-dedup** — against mock transports
+   with deterministic synthetic data;
+2. SIGKILL it at a seeded random instant after it signals work start
+   (or, in chaos mode, let ``ASTPU_CHAOS_FS`` with ``exit=1`` hard-exit
+   it at a seeded byte offset *inside* a write syscall);
+3. assert the kill-point safety property: every shard/npz checkpoint on
+   disk is byte-complete or absent — never torn;
+4. restart the same child clean and assert convergence: **zero URLs/docs
+   lost, zero duplicated**, outputs equal to a never-killed run's.
+
+Usage:
+    python tools/crashsweep.py --kills 21 --seed 0        # full sweep
+    python tools/crashsweep.py --child harvest --dir D --seed 3   # (internal)
+
+The sweep functions are importable — ``tests/test_crash_recovery.py``
+runs them in-process per workload.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+MARKER = "WORK_STARTED"
+
+#: reduced shard alphabet for child harvests: 6² = 36 shards instead of the
+#: production 39² (the sweep needs a work window of ~1 s, not ~1 h)
+SWEEP_CHARS = list("abc123")
+
+SCRAPE_URLS = 80
+STREAM_DOCS = 40
+
+
+# -- deterministic synthetic data -------------------------------------------
+
+def synth_cdx_text(prefix: str) -> str:
+    """A fake CDX dump for one prefix: space-delimited, (date_time, url) in
+    columns 1-2, every url carrying ``.html`` so the normalisation chain
+    keeps it.  One url is shared across ALL prefixes so the merge step's
+    global dedup has real work."""
+    rows = [
+        f"com,yahoo)/news/x 2020010100000{i} "
+        f"https://finance.yahoo.com/news/{prefix}-doc{i}.html text/html 200 H 123"
+        for i in range(6)
+    ]
+    rows.append(
+        "com,yahoo)/news/x 20200101000099 "
+        "https://finance.yahoo.com/news/shared-everywhere.html text/html 200 H 9"
+    )
+    return "\n".join(rows)
+
+
+def harvest_expected_urls() -> set[str]:
+    out = set()
+    for a in SWEEP_CHARS:
+        for b in SWEEP_CHARS:
+            for i in range(6):
+                out.add(f"https://finance.yahoo.com/news/{a}{b}-doc{i}.html")
+    out.add("https://finance.yahoo.com/news/shared-everywhere.html")
+    return out
+
+
+def synth_article_page(url: str) -> str:
+    tag = url.rsplit("/", 1)[-1]
+    return (
+        "<html><body>"
+        f'<div class="cover-title">Article {tag}</div>'
+        '<div class="body-wrap"><div class="body">'
+        f"<p>Deterministic body for {tag}, long enough to be an article.</p>"
+        "</div></div></body></html>"
+    )
+
+
+def synth_docs(n: int, seed: int = 0) -> list[str]:
+    rng = random.Random(seed)
+    alpha = "abcdefghijklmnopqrstuvwxyz "
+    docs = ["".join(rng.choice(alpha) for _ in range(300)) for _ in range(n)]
+    for i in range(0, n - 3, 7):  # planted near-dup pairs
+        docs[i + 3] = docs[i][:250] + "".join(rng.choice(alpha) for _ in range(50))
+    return docs
+
+
+def _touch_marker(case_dir: str) -> None:
+    with open(os.path.join(case_dir, MARKER), "w") as f:
+        f.write(str(os.getpid()))
+
+
+# -- child workloads ---------------------------------------------------------
+
+def child_harvest(case_dir: str, seed: int) -> int:
+    from advanced_scrapper_tpu.config import HarvestConfig
+    from advanced_scrapper_tpu.net.transport import MockTransport
+    from advanced_scrapper_tpu.pipeline import harvest
+
+    harvest.CHAR_LIST = SWEEP_CHARS
+    cfg = HarvestConfig(
+        shard_dir=os.path.join(case_dir, "shards"),
+        output_csv=os.path.join(case_dir, "yfin_urls.csv"),
+        num_workers=4,
+    )
+
+    def serve(url: str) -> str:
+        import re
+
+        m = re.search(r"news/(\w+)\*", url)
+        assert m, url
+        return f"<html><body><pre>{synth_cdx_text(m.group(1))}</pre></body></html>"
+
+    transport = MockTransport(serve, latency=0.02)
+    _touch_marker(case_dir)
+    return harvest.run_harvest(cfg, transport=transport, use_tpu=False)
+
+
+def child_scrape(case_dir: str, seed: int) -> int:
+    from advanced_scrapper_tpu.config import ScraperConfig
+    from advanced_scrapper_tpu.net.transport import MockTransport
+    from advanced_scrapper_tpu.pipeline.scraper import run_scraper
+
+    cfg = ScraperConfig(
+        website="yfin",
+        input_csv=os.path.join(case_dir, "urls.csv"),
+        out_dir=case_dir,
+        desired_request_rate=400.0,
+        max_threads=4,
+        result_timeout=15.0,
+        rate_limit_wait=0.1,
+    )
+    _touch_marker(case_dir)
+    return run_scraper(
+        cfg,
+        transport_factory=lambda: MockTransport(synth_article_page, latency=0.01),
+        with_tpu_backend=False,
+        show_stats=False,
+    )
+
+
+def child_stream(case_dir: str, seed: int) -> int:
+    """Streaming dedup with an npz checkpoint per processed batch and an
+    annotations CSV as the exactly-once resume artifact (annotation-first
+    ordering: a stale checkpoint only weakens dedup, never loses rows)."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+    from advanced_scrapper_tpu.storage.csvio import AppendCsv, read_url_column
+
+    cfg = DedupConfig(batch_size=16, block_len=512)
+    ann_path = os.path.join(case_dir, "stream_annotations.csv")
+    ckpt = os.path.join(case_dir, "stream_index.npz")
+    docs = synth_docs(STREAM_DOCS, seed=seed)
+
+    # repair=True: the annotations CSV is framework-owned, and this read
+    # happens BEFORE AppendCsv reopens it — a torn key parsed leniently
+    # here would be skipped as "done" and its row lost forever
+    done = set(read_url_column(ann_path, column="url", repair=True))
+    ann = AppendCsv(ann_path, ["url", "dup_of", "near_dup_of"])
+    backend = TpuBatchBackend(
+        cfg,
+        sink=lambda rec: ann.write_row(
+            {
+                "url": rec.get("url", ""),
+                "dup_of": rec.get("dup_of") or "",
+                "near_dup_of": rec.get("near_dup_of") or "",
+            }
+        ),
+        exact_stage=False,  # line-number keys are unique by construction
+    )
+    backend.load_index_if_valid(ckpt)
+    # warm the jit cache on the real batch shape BEFORE the marker so the
+    # sweep's kill window covers persistence work, not XLA compiles
+    backend.engine.signatures(["w" * 300] * cfg.batch_size)
+    _touch_marker(case_dir)
+    try:
+        for i, doc in enumerate(docs):
+            key = f"L{i}"
+            if key in done:
+                continue
+            if backend.submit({"article": doc, "url": key}):
+                backend.save_index(ckpt)
+        backend.flush()
+        backend.save_index(ckpt)
+    finally:
+        ann.close()
+    return 0
+
+
+CHILDREN = {
+    "harvest": child_harvest,
+    "scrape": child_scrape,
+    "stream": child_stream,
+}
+
+
+# -- verification ------------------------------------------------------------
+
+def _expected_shard_text(prefix: str) -> str:
+    from bs4 import BeautifulSoup
+
+    page = f"<html><body><pre>{synth_cdx_text(prefix)}</pre></body></html>"
+    return BeautifulSoup(page, "html.parser").get_text(separator="\n", strip=True)
+
+
+def check_harvest_safety(case_dir: str) -> list[str]:
+    """Kill-point invariant: every shard checkpoint on disk is
+    byte-complete (equal to its deterministic expected content) or absent."""
+    problems = []
+    shard_dir = os.path.join(case_dir, "shards")
+    if not os.path.isdir(shard_dir):
+        return problems
+    for name in os.listdir(shard_dir):
+        if not name.endswith(".txt") or ".tmp-" in name:
+            continue
+        prefix = name[len("yahoo_"):-len(".txt")]
+        got = open(os.path.join(shard_dir, name), encoding="utf-8").read()
+        if got != _expected_shard_text(prefix):
+            problems.append(f"torn shard checkpoint {name}")
+    return problems
+
+
+def verify_harvest(case_dir: str) -> list[str]:
+    import pandas as pd
+
+    problems = check_harvest_safety(case_dir)
+    shard_dir = os.path.join(case_dir, "shards")
+    for a in SWEEP_CHARS:
+        for b in SWEEP_CHARS:
+            if not os.path.exists(os.path.join(shard_dir, f"yahoo_{a}{b}.txt")):
+                problems.append(f"shard {a}{b} never completed")
+    out_csv = os.path.join(case_dir, "yfin_urls.csv")
+    if not os.path.exists(out_csv):
+        return problems + ["output csv missing"]
+    urls = pd.read_csv(out_csv)["url"].astype(str).tolist()
+    if set(urls) != harvest_expected_urls():
+        problems.append(
+            f"merged url set wrong: {len(urls)} rows, "
+            f"missing={len(harvest_expected_urls() - set(urls))}, "
+            f"extra={len(set(urls) - harvest_expected_urls())}"
+        )
+    if len(urls) != len(set(urls)):
+        problems.append("duplicate urls in merged output")
+    return problems
+
+
+def check_stream_safety(case_dir: str) -> list[str]:
+    """Kill-point invariant: the npz checkpoint target is loadable or
+    absent (tmps are allowed to be torn — readers never look at them)."""
+    import numpy as np
+
+    ckpt = os.path.join(case_dir, "stream_index.npz")
+    if not os.path.exists(ckpt):
+        return []
+    try:
+        with np.load(ckpt) as data:
+            _ = data["fingerprint"]
+        return []
+    except Exception as e:
+        return [f"torn stream-index checkpoint: {e}"]
+
+
+def verify_scrape(case_dir: str) -> list[str]:
+    from advanced_scrapper_tpu.storage.csvio import read_url_column
+
+    urls = read_url_column(os.path.join(case_dir, "urls.csv"))
+    ok = read_url_column(
+        os.path.join(case_dir, "success_articles_yfin.csv"), repair=True
+    )
+    bad = read_url_column(
+        os.path.join(case_dir, "failed_articles_yfin.csv"), repair=True
+    )
+    problems = []
+    if len(urls) != SCRAPE_URLS:
+        problems.append(f"input csv damaged: {len(urls)} urls")
+    if set(ok) | set(bad) != set(urls):
+        missing = set(urls) - set(ok) - set(bad)
+        problems.append(f"{len(missing)} urls lost: {sorted(missing)[:3]}")
+    if len(ok) != len(set(ok)):
+        problems.append("duplicate rows in success csv")
+    if bad:
+        problems.append(f"{len(bad)} unexpected failures: {bad[:3]}")
+    return problems
+
+
+def verify_stream(case_dir: str) -> list[str]:
+    from advanced_scrapper_tpu.storage.csvio import read_url_column
+
+    problems = check_stream_safety(case_dir)
+    keys = read_url_column(
+        os.path.join(case_dir, "stream_annotations.csv"), column="url",
+        repair=True,
+    )
+    expect = {f"L{i}" for i in range(STREAM_DOCS)}
+    if set(keys) != expect:
+        problems.append(
+            f"docs lost/invented: missing={sorted(expect - set(keys))[:3]} "
+            f"extra={sorted(set(keys) - expect)[:3]}"
+        )
+    if len(keys) != len(set(keys)):
+        problems.append("doc annotated twice")
+    return problems
+
+
+SAFETY_CHECKS = {"harvest": check_harvest_safety, "stream": check_stream_safety}
+VERIFIERS = {
+    "harvest": verify_harvest,
+    "scrape": verify_scrape,
+    "stream": verify_stream,
+}
+
+
+# -- parent driver -----------------------------------------------------------
+
+def _spawn(workload: str, case_dir: str, seed: int, chaos: str | None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("ASTPU_CHAOS_FS", None)
+    if chaos:
+        env["ASTPU_CHAOS_FS"] = chaos
+    log = open(os.path.join(case_dir, "child.log"), "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            os.path.abspath(__file__),
+            "--child",
+            workload,
+            "--dir",
+            case_dir,
+            "--seed",
+            str(seed),
+        ],
+        env=env,
+        cwd=REPO,
+        stdout=log,
+        stderr=log,
+    )
+    log.close()
+    return proc
+
+
+def prepare_case(workload: str, case_dir: str) -> None:
+    os.makedirs(case_dir, exist_ok=True)
+    if workload == "scrape":
+        path = os.path.join(case_dir, "urls.csv")
+        if not os.path.exists(path):
+            with open(path, "w") as f:
+                f.write("url\n")
+                for i in range(SCRAPE_URLS):
+                    f.write(f"https://x/news/doc{i}.html\n")
+
+
+def run_case(
+    workload: str,
+    case_dir: str,
+    seed: int,
+    kill_after: float | None,
+    chaos: str | None = None,
+    timeout: float = 180.0,
+) -> dict:
+    """One sweep case: (optionally killed/chaos) run, kill-point safety
+    check, then a clean run to completion, then full verification."""
+    prepare_case(workload, case_dir)
+    marker = os.path.join(case_dir, MARKER)
+    if os.path.exists(marker):
+        os.unlink(marker)
+    record: dict = {
+        "workload": workload,
+        "seed": seed,
+        "kill_after": kill_after,
+        "chaos": chaos,
+    }
+
+    proc = _spawn(workload, case_dir, seed, chaos)
+    if kill_after is not None:
+        deadline = time.monotonic() + timeout
+        while not os.path.exists(marker) and proc.poll() is None:
+            if time.monotonic() > deadline:
+                proc.kill()
+                proc.wait()
+                record["problems"] = ["child never signalled work start"]
+                return record
+            time.sleep(0.005)
+        time.sleep(kill_after)
+        if proc.poll() is None:
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait()
+            record["killed"] = True
+        else:
+            record["killed"] = False
+            record["early_rc"] = proc.returncode
+    else:
+        proc.wait(timeout=timeout)
+        # chaos mode: exit=1 hard-exits with 73 at a seeded write; an
+        # injected EIO the workload cannot contain also dies mid-run —
+        # both are crash instants the restart must heal
+        record["killed"] = proc.returncode != 0
+        record["early_rc"] = proc.returncode
+
+    record["safety"] = SAFETY_CHECKS.get(workload, lambda d: [])(case_dir)
+
+    # clean restart: resume must converge with no chaos and no kill
+    clean = _spawn(workload, case_dir, seed, None)
+    clean.wait(timeout=timeout)
+    record["resume_rc"] = clean.returncode
+    problems = list(record["safety"])
+    if clean.returncode != 0:
+        problems.append(f"resume run exited {clean.returncode}")
+    problems += VERIFIERS[workload](case_dir)
+    record["problems"] = problems
+    return record
+
+
+def sweep_workload(
+    workload: str,
+    base_dir: str,
+    *,
+    sigkills: int,
+    chaos_kills: int = 0,
+    seed: int = 0,
+    kill_window: tuple[float, float] = (0.03, 0.6),
+) -> dict:
+    """Seeded sweep of one workload: ``sigkills`` wall-clock SIGKILL
+    instants plus ``chaos_kills`` in-write ``os._exit`` crash points."""
+    rng = random.Random(f"crashsweep|{workload}|{seed}")
+    cases = []
+    for i in range(sigkills):
+        delay = rng.uniform(*kill_window)
+        # a draw past the end of the work window kills nothing — retry the
+        # case with a shrunken delay (fresh dir) so the sweep reliably
+        # lands its budgeted number of kill instants
+        for attempt in range(3):
+            suffix = f"-t{attempt}" if attempt else ""
+            rec = run_case(
+                workload,
+                os.path.join(base_dir, f"{workload}-k{i}{suffix}"),
+                seed=seed * 1000 + i,
+                kill_after=delay,
+            )
+            if rec.get("killed") or rec["problems"]:
+                break
+            delay *= 0.4
+        cases.append(rec)
+    for i in range(chaos_kills):
+        spec = f"seed={seed * 100 + i},crash=0.08,short_write=0.03,exit=1"
+        cases.append(
+            run_case(
+                workload,
+                os.path.join(base_dir, f"{workload}-c{i}"),
+                seed=seed * 1000 + 500 + i,
+                kill_after=None,
+                chaos=spec,
+            )
+        )
+    return {
+        "workload": workload,
+        "cases": cases,
+        "kills": sum(1 for c in cases if c.get("killed")),
+        "problems": [p for c in cases for p in c.get("problems", [])],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--child", choices=sorted(CHILDREN), default=None)
+    ap.add_argument("--dir", default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--kills", type=int, default=21, help="total kill instants")
+    ap.add_argument("--out", default=None, help="write the JSON report here")
+    args = ap.parse_args(argv)
+
+    if args.child:
+        return CHILDREN[args.child](args.dir, args.seed)
+
+    import tempfile
+
+    base = args.dir or tempfile.mkdtemp(prefix="crashsweep-")
+    per = max(1, args.kills // 3)
+    report = {
+        "seed": args.seed,
+        "workloads": [
+            sweep_workload(
+                "harvest", base, sigkills=per - 1, chaos_kills=1, seed=args.seed
+            ),
+            sweep_workload(
+                "scrape", base, sigkills=per - 1, chaos_kills=1, seed=args.seed
+            ),
+            sweep_workload(
+                "stream",
+                base,
+                sigkills=args.kills - 2 * per - 1,
+                chaos_kills=1,
+                seed=args.seed,
+                kill_window=(0.05, 1.2),
+            ),
+        ],
+    }
+    report["kills"] = sum(w["kills"] for w in report["workloads"])
+    report["problems"] = [p for w in report["workloads"] for p in w["problems"]]
+    report["ok"] = not report["problems"]
+    out = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out)
+    print(out)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
