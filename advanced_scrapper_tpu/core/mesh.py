@@ -52,6 +52,28 @@ def build_mesh(
     return Mesh(grid, (data_axis, seq_axis))
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across the API move: newer jax exposes it at the
+    top level (``check_vma``), older releases under
+    ``jax.experimental.shard_map`` (``check_rep``).  Both flags guard the
+    same replication check, disabled here for the same reason everywhere
+    this repo shard_maps: the dedup steps return replicated outputs that
+    the checker cannot prove replicated through segment/gather resolution.
+    One shim so every call site works on either jax — without it, the whole
+    sharded path (and its tests) dies with AttributeError on jax ≤ 0.4.x.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
+
 def auto_h2d_workers() -> int:
     """Default H2D-overlap thread count for the attached transport.
 

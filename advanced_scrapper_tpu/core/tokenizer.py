@@ -37,6 +37,23 @@ def bucket_len(n: int, min_bucket: int = MIN_BUCKET, max_bucket: int | None = No
     return b
 
 
+def bucket_widths(
+    lens: np.ndarray, min_bucket: int = MIN_BUCKET, max_bucket: int | None = None
+) -> np.ndarray:
+    """Vectorised :func:`bucket_len` over an int array (one numpy pass
+    instead of a per-document Python loop).  ``frexp`` is exact for every
+    integer below 2⁵³, so power-of-two inputs land in their own bucket —
+    no float-log edge cases."""
+    v = np.maximum(np.asarray(lens, dtype=np.int64), 1)
+    m, e = np.frexp(v.astype(np.float64))
+    # v = m·2^e with m ∈ [0.5, 1): exact power of two ⇔ m == 0.5
+    b = np.ldexp(1.0, e - (m == 0.5)).astype(np.int64)
+    b = np.maximum(b, min_bucket)
+    if max_bucket is not None:
+        b = np.minimum(b, max_bucket)
+    return b
+
+
 def to_bytes(text: str | bytes) -> bytes:
     if isinstance(text, bytes):
         return text

@@ -1,0 +1,96 @@
+"""ctypes loader for the zero-copy exact-dedup kernel
+(``native/exactdedup.cpp``).
+
+Unlike the other native helpers this one includes ``Python.h`` (it reads
+str/bytes buffers in place, so the host never flattens the corpus), which
+means it needs the CPython dev headers to build and the GIL to run — it is
+loaded through :class:`ctypes.PyDLL` and treated as strictly optional: any
+build/load failure just routes ``ExactDedup`` to the blob tier
+(``cpu.hostbatch.exact_keep_first_native``) or the grouping fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(__file__)), "native", "exactdedup.cpp"
+)
+_LIB = os.path.join(os.path.dirname(_SRC), "libexactdedup.so")
+
+_lock = threading.Lock()
+_lib: ctypes.PyDLL | None = None
+_backend = "unloaded"
+
+
+def _build() -> bool:
+    include = sysconfig.get_paths().get("include")
+    if not include or not os.path.exists(os.path.join(include, "Python.h")):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", f"-I{include}", _SRC,
+             "-o", _LIB],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> ctypes.PyDLL | None:
+    global _lib, _backend
+    if _backend != "unloaded":
+        return _lib
+    with _lock:
+        if _backend != "unloaded":
+            return _lib
+        needs_build = (not os.path.exists(_LIB)) or (
+            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        )
+        if needs_build and not _build():
+            _backend = "python"
+            return None
+        try:
+            # PyDLL: calls run WITH the GIL held — the kernel walks live
+            # Python objects, so releasing it (plain CDLL) would race the
+            # interpreter
+            lib = ctypes.PyDLL(_LIB)
+            lib.ed_keep_first_list.restype = ctypes.c_long
+            lib.ed_keep_first_list.argtypes = [
+                ctypes.py_object, ctypes.c_void_p,
+            ]
+        except (OSError, AttributeError):
+            _backend = "python"
+            return None
+        _lib = lib
+        _backend = "native"
+        return lib
+
+
+def exactdedup_backend() -> str:
+    """'native' or 'python' (after first use)."""
+    _load()
+    return _backend
+
+
+def keep_first_list(items) -> np.ndarray | None:
+    """``uint8[n]`` first-seen keep mask straight over a list of str or
+    bytes, or None when this tier can't serve it (no kernel, non-list
+    input, mixed str/bytes, or items UTF-8 can't view losslessly)."""
+    lib = _load()
+    if lib is None or not isinstance(items, list):
+        return None
+    keep = np.zeros((len(items),), dtype=np.uint8)
+    rc = lib.ed_keep_first_list(items, keep.ctypes.data)
+    if rc < 0:
+        return None
+    return keep

@@ -90,6 +90,31 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_long, ctypes.c_long, ctypes.c_long, ctypes.c_long,
             u8p, ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
         ]
+        # Newer kernels (this PR's host-path overhaul): guard each so a
+        # stale .so that predates them degrades to the old behaviour
+        # instead of failing the whole native backend.
+        if hasattr(lib, "hb_pop_batch_min"):
+            lib.hb_pop_batch_min.restype = ctypes.c_long
+            lib.hb_pop_batch_min.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
+                ctypes.c_long, u8p, ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+        if hasattr(lib, "hb_encode_ranges"):
+            lib.hb_encode_ranges.restype = ctypes.c_long
+            lib.hb_encode_ranges.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong),
+                ctypes.POINTER(ctypes.c_longlong), ctypes.c_long,
+                ctypes.c_long, ctypes.c_long, ctypes.c_long,
+                u8p, ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_int32),
+            ]
+        if hasattr(lib, "hb_exact_keep_first"):
+            lib.hb_exact_keep_first.restype = ctypes.c_long
+            lib.hb_exact_keep_first.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_longlong),
+                ctypes.c_long, u8p,
+            ]
         for name in ("hb_size", "hb_arena_used"):
             getattr(lib, name).restype = ctypes.c_long
             getattr(lib, name).argtypes = [ctypes.c_void_p]
@@ -167,6 +192,109 @@ def encode_blocks_native(
     return tokens, out_lens, owners
 
 
+def encode_blocks_ranges(
+    blob: bytes,
+    starts: np.ndarray,
+    lens: np.ndarray,
+    counts: np.ndarray,
+    block_len: int,
+    overlap: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray] | None:
+    """Encode arbitrary (start, len) byte ranges of ``blob`` blockwise
+    (see ``hb_encode_ranges`` for why ranges: tail blocks of long documents
+    route to narrower width buckets).  ``counts`` = per-range block counts (``block_counts`` over
+    the range lens).  Returns ``(tokens, lengths, owners)`` with owners
+    indexing into the range arrays, or None without a native library.
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "hb_encode_ranges"):
+        return None
+    if block_len <= overlap:
+        raise ValueError(f"block_len {block_len} must exceed overlap {overlap}")
+    total = int(counts.sum())
+    tokens = np.zeros((total, block_len), dtype=np.uint8)
+    out_lens = np.zeros((total,), dtype=np.int32)
+    owners = np.zeros((total,), dtype=np.int32)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    lens = np.ascontiguousarray(lens, dtype=np.int64)
+    wrote = lib.hb_encode_ranges(
+        blob,
+        starts.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        len(starts),
+        block_len,
+        overlap,
+        total,
+        tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        out_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        owners.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if wrote != total:
+        raise RuntimeError(
+            f"hb_encode_ranges wrote {wrote} blocks, expected {total}"
+        )
+    return tokens, out_lens, owners
+
+
+def block_counts(lens: np.ndarray, block_len: int, overlap: int) -> np.ndarray:
+    """Vectorised blocks-per-doc for the blockwise split (smallest m with
+    ``(m-1)*stride + block_len >= len``; empty docs still take one block)."""
+    stride = block_len - overlap
+    return np.where(
+        lens > block_len, (lens - block_len + stride - 1) // stride + 1, 1
+    )
+
+
+def exact_keep_first_native(items) -> np.ndarray | None:
+    """``uint8[n]`` first-seen keep mask over ``items`` via the single-pass
+    native hash table (``hb_exact_keep_first``), or None when the native
+    library (or the symbol, on a stale .so) is unavailable / the items
+    cannot be flattened losslessly.
+
+    Strings are flattened with ONE ``"".join`` + one UTF-8 encode
+    (surrogatepass: injective on every str, so byte equality ⟺ string
+    equality — a lossy errors-mode could collapse two distinct items into
+    the same bytes and wrongly drop one).  Byte lengths come from the char
+    lengths when the blob is pure ASCII; otherwise each item re-encodes
+    once (the rare non-ASCII corpus).  Mixed str/bytes inputs return None
+    (the caller's confirm-on-collision fallback handles them).
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "hb_exact_keep_first"):
+        return None
+    n = len(items)
+    if n == 0:
+        return np.zeros((0,), np.uint8)
+    try:
+        blob_s = "".join(items)
+    except TypeError:
+        try:
+            blob = b"".join(items)
+        except TypeError:
+            return None  # mixed str/bytes: no lossless single flattening
+        lens = np.fromiter(map(len, items), np.int64, count=n)
+    else:
+        if blob_s.isascii():  # one scan; char lens == byte lens
+            blob = blob_s.encode("utf-8")
+            lens = np.fromiter(map(len, items), np.int64, count=n)
+        else:  # per-item encode is needed for byte lens anyway — do it once
+            raw = [s.encode("utf-8", "surrogatepass") for s in items]
+            blob = b"".join(raw)
+            lens = np.fromiter(map(len, raw), np.int64, count=n)
+    offsets = np.zeros((n + 1,), dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    keep = np.zeros((n,), dtype=np.uint8)
+    rc = lib.hb_exact_keep_first(
+        blob,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        n,
+        keep.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    if rc < 0:
+        return None  # allocation failure: fall back rather than crash
+    return keep
+
+
 class _NativeBatcher:
     def __init__(self, lib: ctypes.CDLL, max_docs: int, arena_bytes: int):
         self._lib = lib
@@ -195,16 +323,21 @@ class _NativeBatcher:
             )
         )
 
-    def pop_batch(self, batch: int, block: int, timeout_ms: int):
+    def pop_batch(self, batch: int, block: int, timeout_ms: int, min_fill: int = 1):
         tokens = np.zeros((batch, block), dtype=np.uint8)
         lengths = np.zeros((batch,), dtype=np.int32)
         tags = np.zeros((batch,), dtype=np.uint64)
-        n = self._lib.hb_pop_batch(
-            self._h, batch, block, timeout_ms,
+        outs = (
             tokens.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
             tags.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
         )
+        if min_fill > 1 and hasattr(self._lib, "hb_pop_batch_min"):
+            n = self._lib.hb_pop_batch_min(
+                self._h, batch, block, timeout_ms, min_fill, *outs
+            )
+        else:
+            n = self._lib.hb_pop_batch(self._h, batch, block, timeout_ms, *outs)
         return int(n), tokens, lengths, tags
 
     def size(self) -> int:
@@ -253,6 +386,9 @@ class _PyBatcher:
                 or self._arena + len(doc) > self._arena_cap
             ):
                 self._rejected += 1
+                # wake min_fill waiters: a queue that rejects pushes can't
+                # grow to their fill target — they must drain instead
+                self._cv.notify_all()
                 return False
             self._q.append((doc, tag))
             self._arena += len(doc)
@@ -268,17 +404,27 @@ class _PyBatcher:
             n += 1
         return n
 
-    def pop_batch(self, batch: int, block: int, timeout_ms: int):
+    def pop_batch(self, batch: int, block: int, timeout_ms: int, min_fill: int = 1):
         tokens = np.zeros((batch, block), dtype=np.uint8)
         lengths = np.zeros((batch,), dtype=np.int32)
         tags = np.zeros((batch,), dtype=np.uint64)
+        # clamp to capacity too: a fill the queue can never hold must not
+        # turn a timeout_ms=-1 pop into a deadlock-until-close; likewise any
+        # push REJECTED while waiting (doc/arena backpressure) proves the
+        # fill target is unreachable right now — drain instead of starving
+        want = max(1, min(min_fill, batch, self._max_docs))
         with self._cv:
-            if not self._q and not self._closed and timeout_ms != 0:
+            rej0 = self._rejected
+            if len(self._q) < want and not self._closed and timeout_ms != 0:
                 deadline = None if timeout_ms < 0 else time.monotonic() + timeout_ms / 1e3
-                while not self._q and not self._closed:
+                while (
+                    len(self._q) < want
+                    and not self._closed
+                    and self._rejected == rej0
+                ):
                     remaining = None if deadline is None else deadline - time.monotonic()
                     if remaining is not None and remaining <= 0:
-                        return 0, tokens, lengths, tags
+                        break  # timeout: drain whatever is there (may be 0)
                     self._cv.wait(remaining)
             n = 0
             while n < batch and self._q:
@@ -382,15 +528,21 @@ class HostBatcher:
         return True
 
     def pop_batch(
-        self, batch: int, *, timeout_ms: int = -1
+        self, batch: int, *, timeout_ms: int = -1, min_fill: int = 1
     ) -> tuple[int, np.ndarray, np.ndarray, np.ndarray]:
         """Pop ≤``batch`` docs as ``(n, tokens[batch, block], lengths, tags)``.
 
-        Blocks up to ``timeout_ms`` for the first document (−1 = forever,
-        0 = no wait) then drains greedily; rows past ``n`` are zero padding.
-        ``n == 0`` means timeout or closed-and-empty.
+        Blocks up to ``timeout_ms`` until at least ``min_fill`` documents are
+        queued (−1 = forever, 0 = no wait) then drains greedily; rows past
+        ``n`` are zero padding.  ``min_fill=1`` (the default) is the classic
+        pop-on-first-doc behaviour; ``min_fill=batch`` assembles FULL tiles —
+        the staging discipline of :class:`pipeline.feed.DeviceFeed`, where a
+        partial tile still pays a full-shape device kernel.  A timeout or a
+        closed queue always hands over whatever is buffered, so a slow
+        producer degrades to partial tiles instead of starving the device.
+        ``n == 0`` means timeout-while-empty or closed-and-empty.
         """
-        return self._impl.pop_batch(batch, self.block, timeout_ms)
+        return self._impl.pop_batch(batch, self.block, timeout_ms, min_fill)
 
     def feed(
         self,

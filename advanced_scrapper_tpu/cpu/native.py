@@ -76,6 +76,20 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_void_p, ctypes.c_int,           # select rows + count
             ctypes.c_double, ctypes.c_void_p,        # cutoff + out scores
         ]
+        # multi-pattern matcher core (guarded: a stale .so predating it
+        # just disables the automaton fast path, never the whole backend)
+        if hasattr(lib, "fm_ac_build"):
+            lib.fm_ac_build.restype = ctypes.c_void_p
+            lib.fm_ac_build.argtypes = [
+                ctypes.c_char_p, ctypes.c_void_p, ctypes.c_long,
+            ]
+            lib.fm_ac_scan.restype = ctypes.c_long
+            lib.fm_ac_scan.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_long,
+            ]
+            lib.fm_ac_destroy.restype = None
+            lib.fm_ac_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
         BACKEND = "native"
         return lib
@@ -220,3 +234,66 @@ class CutoffArena:
             if r in self._per_pair_rows:
                 out[i] = partial_ratio_cutoff(haystack, self.names[r], cutoff)
         return out
+
+
+class MultiPattern:
+    """Multi-pattern exact matcher (native Aho-Corasick over bytes).
+
+    Built once per fixed pattern set; :meth:`scan` enumerates EVERY
+    occurrence of every pattern in one pass over the text — the successor
+    of the matcher's per-name ``re.finditer`` loops, where each ALL-CAPS
+    entity name re-scanned the whole article.  Byte-level: callers gate on
+    ASCII (byte offsets == char offsets there) and apply word-boundary /
+    non-overlap semantics themselves.
+
+    ``available`` is False without a compiler (or on a stale .so predating
+    ``fm_ac_build``); callers then keep their per-name scan path.
+    """
+
+    def __init__(self, patterns: list[bytes]):
+        import numpy as np
+
+        self.patterns = [bytes(p) for p in patterns]
+        self._handle = None
+        lib = _load()
+        if lib is None or not hasattr(lib, "fm_ac_build"):
+            return
+        lens = np.fromiter(map(len, self.patterns), np.int64, len(self.patterns))
+        offsets = np.zeros((len(self.patterns) + 1,), dtype=np.int64)
+        np.cumsum(lens, out=offsets[1:])
+        blob = b"".join(self.patterns)
+        handle = lib.fm_ac_build(blob, offsets.ctypes.data, len(self.patterns))
+        if handle:
+            self._lib = lib
+            self._handle = ctypes.c_void_p(handle)
+
+    @property
+    def available(self) -> bool:
+        return self._handle is not None
+
+    def scan(self, text: bytes):
+        """``(ids int32[k], starts int64[k])`` — every (pattern, start)
+        occurrence, in end-position order (per-pattern starts ascending)."""
+        import numpy as np
+
+        if self._handle is None:
+            raise RuntimeError("MultiPattern built without a native backend")
+        cap = 256
+        while True:
+            ids = np.zeros((cap,), dtype=np.int32)
+            starts = np.zeros((cap,), dtype=np.int64)
+            n = self._lib.fm_ac_scan(
+                self._handle, text, len(text),
+                ids.ctypes.data, starts.ctypes.data, cap,
+            )
+            if n <= cap:
+                return ids[:n], starts[:n]
+            cap = int(n)  # exact total reported: one retry always suffices
+
+    def __del__(self):
+        h, self._handle = self._handle, None
+        if h is not None:
+            try:
+                self._lib.fm_ac_destroy(h)
+            except Exception:
+                pass  # interpreter teardown: the OS reclaims it anyway

@@ -357,3 +357,125 @@ void fm_partial_ratio_cutoff_select(
 }
 
 }  // extern "C"
+
+// -- multi-pattern matcher core (Aho-Corasick over bytes) --------------------
+//
+// One automaton scan finds EVERY occurrence of EVERY pattern in a single
+// pass over the text — the host-side successor of the matcher's per-name
+// `re.finditer` loops (match_keywords.py:165-173 reroute), where each
+// ALL-CAPS entity name used to re-scan the whole article.  Word-boundary
+// (\b) filtering and per-name non-overlap stay on the Python side, where
+// the regex semantics live; this core only enumerates raw (pattern, start)
+// hits.  Classic goto/fail/output construction over the byte alphabet with
+// sparse per-node edges (entity sets are small; scan cost is a couple of
+// array/loop steps per text byte).
+
+namespace {
+
+struct AcNode {
+  // sorted sparse edges: byte -> node index
+  std::vector<std::pair<uint8_t, int32_t>> next;
+  int32_t fail = 0;
+  int32_t out_link = -1;   // nearest suffix node that ends a pattern
+  int32_t pattern = -1;    // pattern id ending here (-1 = none)
+
+  int32_t find(uint8_t c) const {
+    for (const auto& e : next)
+      if (e.first == c) return e.second;
+    return -1;
+  }
+};
+
+struct AcAutomaton {
+  std::vector<AcNode> nodes;
+  std::vector<int32_t> pat_len;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Build an automaton over n patterns (pattern i = blob[offsets[i],
+// offsets[i+1])).  Empty patterns are skipped (they can never match).
+void* fm_ac_build(const uint8_t* blob, const int64_t* offsets, long n) {
+  auto* ac = new (std::nothrow) AcAutomaton();
+  if (!ac) return nullptr;
+  ac->nodes.emplace_back();  // root
+  ac->pat_len.assign(n, 0);
+  for (long i = 0; i < n; ++i) {
+    const int64_t len = offsets[i + 1] - offsets[i];
+    ac->pat_len[i] = static_cast<int32_t>(len);
+    if (len <= 0) continue;
+    int32_t cur = 0;
+    for (int64_t k = 0; k < len; ++k) {
+      const uint8_t c = blob[offsets[i] + k];
+      int32_t nxt = ac->nodes[cur].find(c);
+      if (nxt < 0) {
+        nxt = static_cast<int32_t>(ac->nodes.size());
+        ac->nodes.emplace_back();
+        ac->nodes[cur].next.emplace_back(c, nxt);
+      }
+      cur = nxt;
+    }
+    if (ac->nodes[cur].pattern < 0) ac->nodes[cur].pattern =
+        static_cast<int32_t>(i);
+    // duplicate pattern strings: first id wins; Python dedups names first
+  }
+  // BFS fail links
+  std::vector<int32_t> queue;
+  for (const auto& e : ac->nodes[0].next) {
+    ac->nodes[e.second].fail = 0;
+    queue.push_back(e.second);
+  }
+  for (size_t qi = 0; qi < queue.size(); ++qi) {
+    const int32_t u = queue[qi];
+    for (const auto& e : ac->nodes[u].next) {
+      const uint8_t c = e.first;
+      const int32_t v = e.second;
+      int32_t f = ac->nodes[u].fail;
+      int32_t t;
+      while ((t = ac->nodes[f].find(c)) < 0 && f != 0) f = ac->nodes[f].fail;
+      ac->nodes[v].fail = t >= 0 && t != v ? t : 0;
+      const int32_t fv = ac->nodes[v].fail;
+      ac->nodes[v].out_link =
+          ac->nodes[fv].pattern >= 0 ? fv : ac->nodes[fv].out_link;
+      queue.push_back(v);
+    }
+  }
+  return ac;
+}
+
+void fm_ac_destroy(void* h) { delete static_cast<AcAutomaton*>(h); }
+
+// Scan text, emitting (pattern id, start offset) for every occurrence of
+// every pattern.  Returns the TOTAL number of occurrences; only the first
+// `cap` are written to out_ids/out_starts (callers grow and re-scan when
+// the return value exceeds cap).  Hits are emitted in end-position order,
+// so per-pattern start offsets arrive ascending — what the finditer
+// non-overlap replay on the Python side needs.
+long fm_ac_scan(void* h, const uint8_t* text, long len, int32_t* out_ids,
+                int64_t* out_starts, long cap) {
+  const auto* ac = static_cast<const AcAutomaton*>(h);
+  long hits = 0;
+  int32_t cur = 0;
+  for (long pos = 0; pos < len; ++pos) {
+    const uint8_t c = text[pos];
+    int32_t t;
+    while ((t = ac->nodes[cur].find(c)) < 0 && cur != 0)
+      cur = ac->nodes[cur].fail;
+    cur = t >= 0 ? t : 0;
+    for (int32_t o = cur; o >= 0; o = ac->nodes[o].out_link) {
+      const int32_t pid = ac->nodes[o].pattern;
+      if (pid >= 0) {
+        if (hits < cap) {
+          out_ids[hits] = pid;
+          out_starts[hits] = pos + 1 - ac->pat_len[pid];
+        }
+        hits++;
+      }
+    }
+  }
+  return hits;
+}
+
+}  // extern "C"

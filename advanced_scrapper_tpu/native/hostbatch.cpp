@@ -21,6 +21,8 @@
 #include <mutex>
 #include <vector>
 
+#include "bytehash.h"
+
 namespace {
 
 struct Doc {
@@ -60,6 +62,9 @@ int hb_push(void* hp, const uint8_t* data, long len, uint64_t tag) {
   if (h->closed || h->q.size() >= h->max_docs ||
       h->arena_used + static_cast<size_t>(len) > h->arena_cap) {
     h->rejected++;
+    // wake min_fill waiters: a queue that REJECTS pushes can't grow to
+    // their fill target, so they must drain what's there instead
+    h->not_empty.notify_all();
     return 0;
   }
   h->q.push_back(Doc{std::vector<uint8_t>(data, data + len), tag});
@@ -85,6 +90,7 @@ long hb_push_many(void* hp, const uint8_t* data, const long long* offsets,
     if (h->closed || h->q.size() >= h->max_docs ||
         h->arena_used + static_cast<size_t>(len) > h->arena_cap) {
       h->rejected++;
+      h->not_empty.notify_all();  // see hb_push: min_fill waiters must drain
       break;
     }
     const uint8_t* p = data + offsets[i];
@@ -97,25 +103,56 @@ long hb_push_many(void* hp, const uint8_t* data, const long long* offsets,
   return accepted;
 }
 
+long hb_pop_batch_min(void* hp, long batch, long block_len, long timeout_ms,
+                      long min_fill, uint8_t* out_tokens,
+                      int32_t* out_lengths, uint64_t* out_tags);
+
 // Fill up to `batch` rows of out_tokens (uint8[batch, block_len], zero-padded),
 // out_lengths (int32[batch], truncated at block_len), out_tags
 // (uint64[batch]).  Blocks up to timeout_ms for the FIRST document (0 = no
 // wait, <0 = wait forever), then drains without waiting.  Returns rows
-// filled; 0 means timeout or closed-and-empty.
+// filled; 0 means timeout or closed-and-empty.  (The min_fill=1 case of
+// hb_pop_batch_min — one drain loop to maintain, not two.)
 long hb_pop_batch(void* hp, long batch, long block_len, long timeout_ms,
                   uint8_t* out_tokens, int32_t* out_lengths,
                   uint64_t* out_tags) {
+  return hb_pop_batch_min(hp, batch, block_len, timeout_ms, 1, out_tokens,
+                          out_lengths, out_tags);
+}
+
+// Like hb_pop_batch, but waits (up to timeout_ms) until at least `min_fill`
+// documents are queued before draining — the staging discipline of the
+// streaming feed: a consumer that pops as soon as ONE producer chunk lands
+// assembles ragged partial tiles, and every partial tile still pays a
+// full-shape device kernel.  Semantics: block until q.size() >= min_fill OR
+// the queue is closed OR the timeout lapses, then drain greedily (so a
+// closed/timed-out queue still hands over whatever is there — progress
+// beats starvation when the producer genuinely can't keep up).  min_fill
+// is clamped to [1, batch]; timeout_ms < 0 waits forever, 0 never waits.
+long hb_pop_batch_min(void* hp, long batch, long block_len, long timeout_ms,
+                      long min_fill, uint8_t* out_tokens,
+                      int32_t* out_lengths, uint64_t* out_tags) {
   auto* h = static_cast<HostBatch*>(hp);
   if (batch <= 0 || block_len <= 0) return 0;
+  if (min_fill < 1) min_fill = 1;
+  if (min_fill > batch) min_fill = batch;
   std::unique_lock<std::mutex> lk(h->mu);
-  if (h->q.empty() && !h->closed) {
-    if (timeout_ms == 0) return 0;
-    auto ready = [h] { return !h->q.empty() || h->closed; };
+  size_t want = static_cast<size_t>(min_fill);
+  // a fill the queue can never hold (min_fill > max_docs) must not turn a
+  // timeout_ms=-1 pop into a deadlock-until-close
+  if (want > h->max_docs) want = h->max_docs;
+  // ... and neither must backpressure: any push REJECTED while we wait
+  // (doc cap or arena byte cap) proves the queue cannot reach the fill
+  // target right now, so drain what's there instead of starving
+  const uint64_t rej0 = h->rejected;
+  if (h->q.size() < want && !h->closed && timeout_ms != 0) {
+    auto ready = [h, want, rej0] {
+      return h->q.size() >= want || h->closed || h->rejected != rej0;
+    };
     if (timeout_ms < 0) {
       h->not_empty.wait(lk, ready);
-    } else if (!h->not_empty.wait_for(
-                   lk, std::chrono::milliseconds(timeout_ms), ready)) {
-      return 0;
+    } else {
+      h->not_empty.wait_for(lk, std::chrono::milliseconds(timeout_ms), ready);
     }
   }
   long n = 0;
@@ -175,6 +212,59 @@ long hb_encode_blocks(const uint8_t* data, const long long* offsets,
     }
   }
   return j;
+}
+
+// Range variant: encode arbitrary (start, len) byte ranges of the corpus
+// blob, blockwise at block_len with `overlap` carried across cuts.  This is
+// what lets the ragged dedup path route each document's TAIL block to a
+// narrower width bucket (the tail of a long doc averages ~50% padding when
+// stored in a full-width row) while its full blocks stay at block_len: a
+// range is just "these bytes", so body and tail ranges of one document can
+// encode at different widths and still reproduce exactly the block set of
+// a whole-document split.  out_owners[j] = range index (callers map back).
+// An empty range yields one zero block of recorded length 1 (empty-doc
+// parity with hb_encode_blocks).
+long hb_encode_ranges(const uint8_t* data, const long long* starts,
+                      const long long* lens, long n_ranges, long block_len,
+                      long overlap, long max_blocks, uint8_t* out_tokens,
+                      int32_t* out_lengths, int32_t* out_owners) {
+  if (block_len <= overlap || n_ranges < 0) return -1;
+  const long long stride = block_len - overlap;
+  long j = 0;
+  for (long s = 0; s < n_ranges; ++s) {
+    const long long len = lens[s];
+    if (len < 0) return -1;
+    const uint8_t* doc = data + starts[s];
+    long long pos = 0;
+    while (true) {
+      if (j >= max_blocks) return -1;
+      const long long rem = len - pos;
+      const long long copy =
+          rem < block_len ? (rem > 0 ? rem : 0) : block_len;
+      if (copy)
+        std::memcpy(out_tokens + static_cast<size_t>(j) * block_len,
+                    doc + pos, static_cast<size_t>(copy));
+      out_lengths[j] = len == 0 ? 1 : static_cast<int32_t>(copy);
+      out_owners[j] = static_cast<int32_t>(s);
+      ++j;
+      if (pos + block_len >= len) break;
+      pos += stride;
+    }
+  }
+  return j;
+}
+
+// Single-pass exact first-seen dedup over concatenated byte items: the
+// portable (blob + offsets) tier of ExactDedup, replacing pandas
+// drop_duplicates' PyObject hash table.  The probe/confirm loop lives in
+// bytehash.h (shared with the zero-copy tier in exactdedup.cpp); returns
+// items kept, or -1 on allocation failure (callers fall back to Python).
+long hb_exact_keep_first(const uint8_t* data, const long long* offsets,
+                         long n, uint8_t* out_keep) {
+  return bytehash::keep_first(
+      n, [&](long i) { return data + offsets[i]; },
+      [&](long i) { return static_cast<int64_t>(offsets[i + 1] - offsets[i]); },
+      out_keep);
 }
 
 long hb_size(void* hp) {

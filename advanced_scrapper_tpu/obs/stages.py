@@ -1,0 +1,54 @@
+"""Per-stage wall-clock counters for the host→device path.
+
+The bench JSON's ``stage_ms`` breakdown (encode / h2d / kernel / resolve /
+matcher_build) comes from here: hot paths wrap their stage work in
+:func:`timed` (or call :func:`add` directly), the bench resets before a
+regime and snapshots after.  Attribution is **by call site**, not by a
+global timeline: the pipelines overlap stages on purpose (that is the whole
+point of the async design), so the per-stage sums can legitimately exceed
+the end-to-end wall clock, and device "kernel" time is the time the host
+spent *waiting* on device results (dispatch is async; a fully-hidden kernel
+contributes ~0).  The numbers answer "where would another millisecond of
+host work hurt", which is what the next PR needs — not a scheduler trace.
+
+Thread-safe (the H2D put pool and DeviceFeed workers time from their own
+threads); overhead is one ``perf_counter`` pair and a dict update per
+*batch*, noise against millisecond-scale stages.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+_lock = threading.Lock()
+_acc: dict[str, float] = {}
+
+#: canonical stage names (call sites may add others; these are the bench's)
+STAGES = ("encode", "h2d", "kernel", "resolve", "matcher_build")
+
+
+def add(stage: str, seconds: float) -> None:
+    with _lock:
+        _acc[stage] = _acc.get(stage, 0.0) + seconds
+
+
+@contextmanager
+def timed(stage: str):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        add(stage, time.perf_counter() - t0)
+
+
+def reset() -> None:
+    with _lock:
+        _acc.clear()
+
+
+def snapshot_ms() -> dict[str, float]:
+    """Cumulative per-stage milliseconds since the last :func:`reset`."""
+    with _lock:
+        return {k: round(v * 1e3, 1) for k, v in sorted(_acc.items())}

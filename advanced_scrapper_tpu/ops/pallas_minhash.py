@@ -132,9 +132,10 @@ def _pallas_signatures(
             pltpu.VMEM((block_b, L), jnp.uint32),
             pltpu.VMEM((block_b, L), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",),
-        ),
+        # renamed across jax releases (TPUCompilerParams → CompilerParams)
+        compiler_params=getattr(
+            pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+        )(dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(lengths.reshape(B, 1).astype(jnp.int32), tokens, a.reshape(1, -1), b.reshape(1, -1))
 

@@ -150,11 +150,12 @@ def make_ring_dedup(
             g_rep = jnp.take(g_rep, g_rep)
         return g_rep
 
-    sharded = jax.shard_map(
+    from advanced_scrapper_tpu.core.mesh import shard_map_compat
+
+    sharded = shard_map_compat(
         local_step,
         mesh=mesh,
         in_specs=(P(data, None), P(data)),
         out_specs=P(None),
-        check_vma=False,
     )
     return jax.jit(sharded)

@@ -20,6 +20,7 @@ from advanced_scrapper_tpu.config import DedupConfig
 from advanced_scrapper_tpu.core.hashing import MinHashParams, make_params
 from advanced_scrapper_tpu.core.tokenizer import (
     bucket_len,
+    bucket_widths,
     encode_blocks,
     to_bytes,
 )
@@ -70,6 +71,9 @@ class NearDupEngine:
             shingle_k=self.cfg.shingle_k,
             seed=self.cfg.seed,
         )
+        # compiled fused-step cache for dedup_reps_sharded, keyed on
+        # (mesh, article bucket, block_len) — meshes are hashable
+        self._sharded_steps: dict = {}
 
     def signatures(self, texts: Sequence[str | bytes]) -> np.ndarray:
         """uint32[N, num_perm] MinHash signatures (blockwise, batched).
@@ -81,7 +85,11 @@ class NearDupEngine:
         """
         if len(texts) == 0:
             return np.zeros((0, self.params.num_perm), np.uint32)
-        return np.asarray(self._signatures_device(texts))[: len(texts)]
+        from advanced_scrapper_tpu.obs import stages
+
+        sigs = self._signatures_device(texts)
+        with stages.timed("kernel"):  # readback sync: the device drains here
+            return np.asarray(sigs)[: len(texts)]
 
     def _signatures_device(self, texts: Sequence[str | bytes]):
         """Device ``uint32[bucket_len(N), num_perm]`` combined signatures.
@@ -116,41 +124,129 @@ class NearDupEngine:
         import jax
         import jax.numpy as jnp
 
+        from advanced_scrapper_tpu.cpu.hostbatch import (
+            block_counts,
+            encode_blocks_ranges,
+        )
+        from advanced_scrapper_tpu.obs import stages
         from advanced_scrapper_tpu.ops.minhash import accumulate_block_signatures
         from advanced_scrapper_tpu.ops.shingle import U32_MAX
 
         raw = [to_bytes(t) for t in texts]
+        n = len(raw)
         # Bucket the article count so combine compiles O(log N) variants, not
         # one per corpus size (same trick as the block-length axis).
-        n_bucket = bucket_len(len(raw), min_bucket=64)
-        by_width: dict[int, list[int]] = {}
-        for i, r in enumerate(raw):
-            w = bucket_len(max(len(r), 1), max_bucket=cfg.block_len)
-            by_width.setdefault(w, []).append(i)
+        n_bucket = bucket_len(n, min_bucket=64)
+        overlap = params.shingle_k - 1
+        stride = cfg.block_len - overlap
+        with stages.timed("encode"):
+            # Vectorised RANGE bucketing, one numpy pass, no per-article
+            # Python loop.  Every document becomes one TAIL range (the
+            # whole doc when it fits a single block) routed to the
+            # power-of-two width bucket of the tail's length, plus — for
+            # documents longer than block_len — one BODY range that encodes
+            # as exactly its m−1 FULL blocks at block_len.  The block SET
+            # (bytes, per-block lengths, owners) is identical to a
+            # whole-document split, but the tail rides a fitted row instead
+            # of a block_len-wide one: tail padding alone was ~30% of the
+            # ragged regime's dispatched bytes (a 12 kB article's last
+            # block averages ~50% zeros at 4096 width).  The corpus
+            # flattens into ONE blob + offset table; width groups are cut
+            # straight out of it by the range-native encoder.
+            lens = np.fromiter(map(len, raw), np.int64, count=n)
+            doc_off = np.zeros((n + 1,), dtype=np.int64)
+            np.cumsum(lens, out=doc_off[1:])
+            blob = b"".join(raw)
+            m = block_counts(lens, cfg.block_len, overlap)
+            tail_start = (m - 1) * stride
+            tail_len = lens - tail_start
+            body_sel = np.flatnonzero(m > 1)
+            range_starts = np.concatenate(
+                [doc_off[:n] + tail_start, doc_off[:n][body_sel]]
+            )
+            range_lens = np.concatenate(
+                [tail_len, tail_start[body_sel] + overlap]
+            )
+            range_owner = np.concatenate(
+                [np.arange(n, dtype=np.int64), body_sel]
+            )
+            range_width = np.concatenate([
+                bucket_widths(tail_len, max_bucket=cfg.block_len),
+                np.full((len(body_sel),), cfg.block_len, np.int64),
+            ])
+            order = np.argsort(range_width, kind="stable")
+            sorted_w = range_width[order]
+            n_ranges = len(order)
+            group_lo = (
+                np.flatnonzero(np.r_[True, sorted_w[1:] != sorted_w[:-1]])
+                if n_ranges
+                else np.zeros((0,), np.int64)
+            )
 
         def host_batches():
             # a generator: encode stays lazy, overlapping device dispatch
             # in both consumption modes below
-            for w, idx in sorted(by_width.items()):
-                tok, lens, owners_local = encode_blocks(
-                    [raw[i] for i in idx], w, overlap=params.shingle_k - 1
-                )
-                owners = np.asarray(idx, np.int32)[owners_local]
+            for g, lo in enumerate(group_lo):
+                hi = group_lo[g + 1] if g + 1 < len(group_lo) else n_ranges
+                idx = order[lo:hi]
+                w = int(sorted_w[lo])
+                with stages.timed("encode"):
+                    r_starts = range_starts[idx]
+                    r_lens = range_lens[idx]
+                    enc = encode_blocks_ranges(
+                        blob, r_starts, r_lens,
+                        block_counts(r_lens, w, overlap), w, overlap,
+                    )
+                    if enc is None:  # no compiler: per-group Python slices
+                        r_doc = range_owner[idx]
+                        rel = r_starts - doc_off[r_doc]
+                        enc = encode_blocks(
+                            [
+                                raw[d][s : s + ln]
+                                for d, s, ln in zip(
+                                    r_doc.tolist(), rel.tolist(),
+                                    r_lens.tolist(),
+                                )
+                            ],
+                            w,
+                            overlap=overlap,
+                        )
+                    tok, blk_lens, owners_local = enc
+                    owners = range_owner[idx].astype(np.int32)[owners_local]
                 n_blocks = tok.shape[0]
                 # cfg.batch_size keeps its pre-bucketing meaning — the peak
                 # device bytes per dispatch stay batch_size × block_len — so
                 # the row count scales up as the width bucket narrows.
                 bs = min(max(cfg.batch_size * cfg.block_len // w, 64), 16384)
-                for start in range(0, n_blocks, bs):
-                    t = tok[start : start + bs]
-                    l = lens[start : start + bs]
-                    o = owners[start : start + bs]
-                    if t.shape[0] < bs:
-                        pad = bs - t.shape[0]
+                # Greedy power-of-two row chunks: full bs tiles, then the
+                # tail decomposes into descending power-of-two dispatches
+                # (≥64; the last one zero-pads).  A width group with 33
+                # leftover blocks must not dispatch (and compute!) a
+                # 16384-row tile — measured 2.5× of the ragged regime's
+                # device bytes were tail padding at 2k articles.  Chunks,
+                # not one bucketed tail tile: every corpus then draws from
+                # the SAME O(log bs) shape set per width, so one warm corpus
+                # compiles (almost) everything — a per-corpus bucketed tail
+                # would trickle fresh shapes (and recompiles) into every
+                # corpus that follows.
+                start = 0
+                while start < n_blocks:
+                    remaining = n_blocks - start
+                    rows = bs
+                    if remaining < bs:
+                        rows = 64
+                        while rows * 2 <= remaining:
+                            rows *= 2
+                    t = tok[start : start + rows]
+                    l = blk_lens[start : start + rows]
+                    o = owners[start : start + rows]
+                    if t.shape[0] < rows:
+                        pad = rows - t.shape[0]
                         t = np.concatenate([t, np.zeros((pad, w), np.uint8)])
                         l = np.concatenate([l, np.zeros((pad,), np.int32)])
                         o = np.concatenate([o, np.zeros((pad,), np.int32)])
                     yield (t, l, o)
+                    start += rows
 
         # put_workers > 1 (ASTPU_DEDUP_PUT_WORKERS; 0 = transport auto —
         # see resolve_put_workers) issues the H2D puts from a thread pool:
@@ -167,7 +263,8 @@ class NearDupEngine:
 
             def put(batch):
                 t, l, o = batch
-                return jax.device_put(t), jax.device_put(l), jax.device_put(o)
+                with stages.timed("h2d"):
+                    return jax.device_put(t), jax.device_put(l), jax.device_put(o)
 
             # bounded in-flight: at most put_workers+1 batches encoded /
             # resident beyond the accumulate chain — Executor.map would
@@ -180,20 +277,28 @@ class NearDupEngine:
                     if len(pending) <= put_workers:
                         continue
                     t, l, o = pending.popleft().result()
-                    running = accumulate_block_signatures(
-                        running, block_fn(t, l, params), o, num_articles=n_bucket
-                    )
+                    with stages.timed("kernel"):
+                        running = accumulate_block_signatures(
+                            running, block_fn(t, l, params), o,
+                            num_articles=n_bucket,
+                        )
                 while pending:
                     t, l, o = pending.popleft().result()
+                    with stages.timed("kernel"):
+                        running = accumulate_block_signatures(
+                            running, block_fn(t, l, params), o,
+                            num_articles=n_bucket,
+                        )
+        else:
+            for t, l, o in host_batches():
+                with stages.timed("h2d"):
+                    t, l, o = (
+                        jax.device_put(t), jax.device_put(l), jax.device_put(o)
+                    )
+                with stages.timed("kernel"):  # async dispatch; waits land here
                     running = accumulate_block_signatures(
                         running, block_fn(t, l, params), o, num_articles=n_bucket
                     )
-        else:
-            for t, l, o in host_batches():
-                t, l, o = jax.device_put(t), jax.device_put(l), jax.device_put(o)
-                running = accumulate_block_signatures(
-                    running, block_fn(t, l, params), o, num_articles=n_bucket
-                )
         if use_oph:
             running = densify(running)
         return running
@@ -203,6 +308,8 @@ class NearDupEngine:
         signatures → candidate keys → per-band candidates."""
         import jax
 
+        from advanced_scrapper_tpu.obs import stages
+
         n = len(texts)
         raw = [to_bytes(t) for t in texts]  # encode once; identity on bytes
         sigs = self._signatures_device(raw)
@@ -211,8 +318,11 @@ class NearDupEngine:
         valid = np.zeros((n_bucket,), bool)
         valid[:n] = lens >= self.params.shingle_k
         valid = jax.device_put(valid)
-        keys = candidate_keys(sigs, self.params.band_salt, self.cfg.cand_subbands)
-        rep_bands = duplicate_rep_bands(keys, valid)
+        with stages.timed("resolve"):
+            keys = candidate_keys(
+                sigs, self.params.band_salt, self.cfg.cand_subbands
+            )
+            rep_bands = duplicate_rep_bands(keys, valid)
         return raw, sigs, keys, valid, rep_bands, n_bucket
 
     def dedup_reps_async(self, texts: Sequence[str | bytes]):
@@ -231,20 +341,81 @@ class NearDupEngine:
         # Device-resident end to end: combined signatures never round-trip to
         # the host (the sig D2H + re-H2D bounce cost ~0.3 s per 8k articles
         # on the tunneled link); the only D2H is the final int32[N] reps.
+        from advanced_scrapper_tpu.obs import stages
+
         _raw, sigs, keys, valid, rep_bands, n_bucket = self._prepare(texts)
-        if self.cfg.cand_subbands and self.cfg.fine_margin:
-            thr = fine_edge_thresholds(
-                rep_bands,
-                keys,
-                self.cfg.sim_threshold,
-                self.cfg.fine_margin,
-                num_coarse=self.params.num_bands,
+        with stages.timed("resolve"):
+            if self.cfg.cand_subbands and self.cfg.fine_margin:
+                thr = fine_edge_thresholds(
+                    rep_bands,
+                    keys,
+                    self.cfg.sim_threshold,
+                    self.cfg.fine_margin,
+                    num_coarse=self.params.num_bands,
+                )
+            else:
+                thr = self.cfg.sim_threshold
+            return resolve_rep_bands(
+                rep_bands, sigs, valid, thr, jump_rounds=_jump_rounds(n_bucket)
             )
-        else:
-            thr = self.cfg.sim_threshold
-        return resolve_rep_bands(
-            rep_bands, sigs, valid, thr, jump_rounds=_jump_rounds(n_bucket)
+
+    def dedup_reps_sharded(self, texts: Sequence[str | bytes], mesh) -> np.ndarray:
+        """int32[N] representatives via the mesh-sharded FUSED step: blockwise
+        encode → ``parallel.sharded.make_sharded_block_dedup`` (per-article
+        segment-min combined with ``lax.pmin`` inside the device step, then
+        LSH resolution) — the multi-device path with NO host-side combine
+        pass between the encoder and resolution.  Same estimator-only
+        resolution semantics as :meth:`dedup_reps_async` (parity-tested);
+        use the one-shot :meth:`dedup_reps` when the exact-verify precision
+        path is required.
+        """
+        from advanced_scrapper_tpu.obs import stages
+        from advanced_scrapper_tpu.parallel.sharded import (
+            make_sharded_block_dedup,
         )
+
+        n = len(texts)
+        if n == 0:
+            return np.zeros((0,), np.int32)
+        cfg = self.cfg
+        raw = [to_bytes(t) for t in texts]
+        with stages.timed("encode"):
+            tok, lens, owners = encode_blocks(
+                raw, cfg.block_len, overlap=self.params.shingle_k - 1
+            )
+            owners = owners.astype(np.int32)
+            n_bucket = bucket_len(n, min_bucket=64)
+            # shard divisibility + bucketed block axis: pad rows to the
+            # scratch article slot (owner n_bucket → sliced off on device)
+            ndev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+            rows = bucket_len(max(tok.shape[0], ndev), min_bucket=64)
+            rows = -(-rows // ndev) * ndev  # exact multiple for odd meshes
+            if tok.shape[0] < rows:
+                pad = rows - tok.shape[0]
+                tok = np.concatenate(
+                    [tok, np.zeros((pad, cfg.block_len), np.uint8)]
+                )
+                lens = np.concatenate([lens, np.zeros((pad,), np.int32)])
+                owners = np.concatenate(
+                    [owners, np.full((pad,), n_bucket, np.int32)]
+                )
+        key = (mesh, n_bucket, cfg.block_len)
+        step = self._sharded_steps.get(key)
+        if step is None:
+            step = make_sharded_block_dedup(
+                mesh,
+                self.params,
+                n_bucket,
+                threshold=cfg.sim_threshold,
+                jump_rounds=_jump_rounds(n_bucket),
+                backend=cfg.backend,
+                cand_subbands=cfg.cand_subbands,
+                fine_margin=cfg.fine_margin,
+            )
+            self._sharded_steps[key] = step
+        rep, _hist = step(tok, lens, owners)
+        with stages.timed("resolve"):
+            return np.asarray(rep)[:n]
 
     def _exact_verified_ok(self, raw, sigs, keys, valid, rep_bands):
         """Verified-edge matrix with statistically fragile edges confirmed
@@ -344,13 +515,26 @@ class NearDupEngine:
 class ExactDedup:
     """First-seen exact dedup with a byte-identical guarantee.
 
-    The device proposes equality groups via 128-bit hashes; the host walks
-    each group in original order comparing *actual* strings, so a 2⁻¹²⁸
-    collision can propose but never cause a wrong drop.  Result: the kept
-    index set equals pandas ``drop_duplicates(keep='first')`` exactly.
+    Default path: ONE native pass (``cpu.hostbatch.exact_keep_first_native``)
+    — the corpus flattens into a single byte blob + offset table and a
+    C-side open-addressing hash table decides first-seen membership,
+    settling every hash-equal probe with a full ``memcmp`` (a collision can
+    lengthen a probe chain but never drop a distinct row).  This is the
+    pandas ``drop_duplicates(keep='first')`` replacement that actually
+    out-runs pandas: no per-row Python objects, no device round trip, one
+    preallocated uint64 offset array and one uint8 keep mask.
+
+    Fallback (no compiler, mixed str/bytes input, or a caller-supplied
+    hasher): the device proposes equality groups via 128-bit hashes and the
+    host walks each group in original order comparing *actual* full strings
+    — including past any hash-side truncation — so the kept index set
+    equals the pandas path exactly on every route.
     """
 
     def __init__(self, hasher: ExactHasher | None = None, max_len: int = 4096):
+        # A caller-supplied hasher pins the grouping path (tests inject
+        # degenerate hashers; the native pass would ignore them).
+        self._custom_hasher = hasher is not None
         self.hasher = hasher or ExactHasher()
         # Historical name: rows are hashed blockwise at this width, so it no
         # longer caps item length — any size hashes exactly (the linear hash
@@ -360,6 +544,21 @@ class ExactDedup:
     def keep_indices(self, items: Sequence[str]) -> list[int]:
         if not items:
             return []
+        if not self._custom_hasher:
+            from advanced_scrapper_tpu.cpu.exactdedup import keep_first_list
+            from advanced_scrapper_tpu.cpu.hostbatch import (
+                exact_keep_first_native,
+            )
+
+            # zero-copy tier first (reads str/bytes buffers in place), then
+            # the blob tier (one join + offsets); both confirm every
+            # hash-equal probe with a full memcmp, so each is byte-identical
+            # to the pandas path on the inputs it accepts
+            keep = keep_first_list(items)
+            if keep is None:
+                keep = exact_keep_first_native(items)
+            if keep is not None:
+                return np.flatnonzero(keep).tolist()
         n = len(items)
         raw = [to_bytes(s) for s in items]
         block = bucket_len(max(1, min(max(len(r) for r in raw), self.max_len)))

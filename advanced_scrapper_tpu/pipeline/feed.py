@@ -15,6 +15,7 @@ very long texts use :class:`pipeline.dedup.NearDupEngine` directly.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Iterable, Iterator
@@ -28,6 +29,18 @@ from advanced_scrapper_tpu.ops.lsh import band_keys
 from advanced_scrapper_tpu.ops.minhash import minhash_signatures
 
 
+def resolve_prefetch_depth(depth: int | None) -> int:
+    """Effective prefetch depth (device-side batches staged ahead of use):
+    explicit ``depth`` wins, else ``ASTPU_FEED_PREFETCH``, else 2 (double
+    buffering: one tile on device computing, one staging behind it)."""
+    # <= 0 (explicit or via env, incl. "0") means "the default" — a
+    # non-positive depth would make the staging queue UNBOUNDED
+    if depth is not None and depth > 0:
+        return depth
+    env = int(os.environ.get("ASTPU_FEED_PREFETCH") or 0)
+    return env if env > 0 else 2
+
+
 class DeviceFeed:
     """Prefetching consumer of a :class:`HostBatcher`.
 
@@ -35,6 +48,16 @@ class DeviceFeed:
     ``depth`` batches in flight.  Iterate to receive
     ``(n, tokens_dev, lengths_dev, tags)`` tuples; iteration ends when the
     batcher is closed and drained.
+
+    Staging discipline: pops wait (up to ``poll_timeout_ms``) until a FULL
+    tile's worth of documents is queued (``min_fill=batch_size``).  Without
+    it, a consumer whose dispatch is async races ahead of the producer and
+    pops whatever partial chunk just landed — and every partial tile still
+    pays a full-shape device kernel (measured: the stream regime was
+    dispatching ~6× the kernels it needed, r05's 0.15× gap vs the uniform
+    ceiling).  A timeout or a closed queue still yields partial tiles, so a
+    genuinely slow producer degrades gracefully instead of starving the
+    device; ``min_fill=1`` restores the legacy pop-on-first-doc behaviour.
     """
 
     def __init__(
@@ -42,17 +65,19 @@ class DeviceFeed:
         batcher: HostBatcher,
         batch_size: int,
         *,
-        depth: int = 2,
+        depth: int | None = None,
         sharding=None,
         poll_timeout_ms: int = 200,
         workers: int | None = None,
+        min_fill: int | None = None,
     ):
         """``workers > 1`` runs several pop→device_put threads: on a
         transport whose per-put round trip serializes (the tunneled dev
         chip), concurrent puts overlap that latency.  Batches may then
         arrive out of submission order — safe for the dedup path, where
         every batch is independent and tags ride with their batch.
-        ``None``/0 = the transport default (``core.mesh.auto_h2d_workers``)."""
+        ``None``/0 = the transport default (``core.mesh.auto_h2d_workers``).
+        ``depth`` ``None``/0 = ``ASTPU_FEED_PREFETCH`` (default 2)."""
         import jax
 
         if not workers:
@@ -64,6 +89,8 @@ class DeviceFeed:
         self.batch_size = batch_size
         self.sharding = sharding
         self.poll_timeout_ms = poll_timeout_ms
+        self.min_fill = batch_size if min_fill is None else min_fill
+        depth = resolve_prefetch_depth(depth)
         self._out: "queue.Queue" = queue.Queue(maxsize=depth)
         self._error: BaseException | None = None
         self._jax = jax
@@ -85,19 +112,28 @@ class DeviceFeed:
         tok_spec = len_spec = None
         if self.sharding is not None:
             tok_spec, len_spec = self.sharding
+        from advanced_scrapper_tpu.obs import stages
+
         try:
             while self._error is None:  # a peer's death stops this worker too
-                n, tok, lens, tags = self.batcher.pop_batch(
-                    self.batch_size, timeout_ms=self.poll_timeout_ms
-                )
+                # host tile assembly (pop+memcpy); a slow producer's waits
+                # land here too — "the host couldn't feed the device" is
+                # exactly what this stage exists to expose
+                with stages.timed("encode"):
+                    n, tok, lens, tags = self.batcher.pop_batch(
+                        self.batch_size,
+                        timeout_ms=self.poll_timeout_ms,
+                        min_fill=self.min_fill,
+                    )
                 if n == 0:
                     # 0 rows = timeout (retry) or closed-and-drained (done);
                     # close() is one-way so this check is race-free.
                     if self.batcher.closed() and self.batcher.size() == 0:
                         break
                     continue
-                t_dev = self._put_device(tok, tok_spec)
-                l_dev = self._put_device(lens, len_spec)
+                with stages.timed("h2d"):
+                    t_dev = self._put_device(tok, tok_spec)
+                    l_dev = self._put_device(lens, len_spec)
                 self._out.put((n, t_dev, l_dev, tags))
         except BaseException as e:  # a dying feed thread must not hang the
             with self._exit_lock:    # consumer: deliver the FIRST error,

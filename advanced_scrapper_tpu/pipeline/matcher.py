@@ -221,6 +221,7 @@ class EntityIndex:
         self._tables: dict | None = None
         self._refine_tables: tuple | None = None
         self._verify_arena = None
+        self._upper_matcher: tuple | None = None
 
     @classmethod
     def from_info_dir(cls, folder: str) -> "EntityIndex":
@@ -228,12 +229,41 @@ class EntityIndex:
 
     def screen_tables(self) -> dict:
         if self._tables is None:
+            from advanced_scrapper_tpu.obs import stages
             from advanced_scrapper_tpu.ops.match import prepare_names
 
-            names = [e.name.encode("utf-8", "replace") for e in self.entries]
-            fuzzy = np.array([not e.is_exact_upper for e in self.entries], bool)
-            self._tables = prepare_names(names, fuzzy=fuzzy)
+            with stages.timed("matcher_build"):
+                names = [e.name.encode("utf-8", "replace") for e in self.entries]
+                fuzzy = np.array(
+                    [not e.is_exact_upper for e in self.entries], bool
+                )
+                self._tables = prepare_names(names, fuzzy=fuzzy)
         return self._tables
+
+    def upper_matcher(self):
+        """``(MultiPattern | None, {name: pattern_id})`` over the unique
+        ASCII ALL-CAPS names — the single-pass multi-pattern core that
+        replaces per-name ``\\b re.escape(name) \\b`` scans.  Built lazily
+        ONCE per EntityIndex (so streaming chunks never rebuild it) and
+        never pickled: verify-pool workers reconstruct the index from
+        ``processed`` at init and build their own on first use.  None when
+        no native backend (or no eligible names) — callers keep the
+        per-name regex path, which stays the behavioural oracle."""
+        if self._upper_matcher is None:
+            from advanced_scrapper_tpu.cpu.native import MultiPattern
+            from advanced_scrapper_tpu.obs import stages
+
+            with stages.timed("matcher_build"):
+                names = sorted({
+                    e.name for e in self.entries
+                    if e.is_exact_upper and e.name.isascii()
+                })
+                mp = None
+                if names:
+                    cand = MultiPattern([n.encode("ascii") for n in names])
+                    mp = cand if cand.available else None
+                self._upper_matcher = (mp, {n: i for i, n in enumerate(names)})
+        return self._upper_matcher
 
     def verify_arena(self):
         """Packed-needle arena over all entry names (rows = entry index),
@@ -253,6 +283,60 @@ class EntityIndex:
 
 def _find_positions(pattern: str, text: str) -> list[int]:
     return [m.start() for m in re.finditer(pattern, text)]
+
+
+# ASCII \w table (letters, digits, underscore): on ASCII text this is
+# exactly Python re's Unicode \w membership, which is what the boundary
+# replay below must reproduce.
+_ASCII_WORD = bytes(
+    1 if (chr(c).isalnum() or c == ord("_")) else 0 for c in range(128)
+) + bytes(128)
+
+
+def _upper_positions(index: "EntityIndex", text: str) -> dict[str, list[int]] | None:
+    """Per-name start positions of every ALL-CAPS name in ``text`` via ONE
+    automaton pass — output-identical to running
+    ``re.finditer(r"\\b" + re.escape(name) + r"\\b", text)`` per name.
+
+    None routes the caller to the per-name regex path (no native automaton,
+    or non-ASCII text, where byte offsets would diverge from char offsets).
+    The \\b replay: a boundary holds where exactly one side is a word char,
+    so each raw automaton hit checks its edge bytes against the name's edge
+    bytes; surviving hits then replay finditer's non-overlap rule per name
+    (a match consumes its span; a boundary-rejected occurrence consumes
+    nothing).  Names absent from the dict simply have no matches.
+    """
+    mp, mid_of = index.upper_matcher()
+    if mp is None or not text.isascii():
+        return None
+    data = text.encode("ascii")
+    ids, starts = mp.scan(data)
+    out: dict[str, list[int]] = {}
+    if not len(ids):
+        return out
+    n = len(data)
+    last_end: dict[int, int] = {}
+    names = mp.patterns
+    for i, s in zip(ids.tolist(), starts.tolist()):
+        nb = names[i]
+        e = s + len(nb)
+        # \b before: boundary between text[s-1] and name[0]
+        if _ASCII_WORD[nb[0]]:
+            if s > 0 and _ASCII_WORD[data[s - 1]]:
+                continue
+        elif s == 0 or not _ASCII_WORD[data[s - 1]]:
+            continue
+        # \b after: boundary between name[-1] and text[e]
+        if _ASCII_WORD[nb[-1]]:
+            if e < n and _ASCII_WORD[data[e]]:
+                continue
+        elif e >= n or not _ASCII_WORD[data[e]]:
+            continue
+        if s < last_end.get(i, 0):
+            continue  # finditer resumes at the previous match's end
+        last_end[i] = e
+        out.setdefault(nb.decode("ascii"), []).append(s)
+    return out
 
 
 def _find_positions_literal_fallback(name: str, text: str) -> list[int]:
@@ -293,9 +377,15 @@ def match_article(
     pending: list[tuple[int, object]] = []
     text_rows: list[int] = []   # entry indices j to score against the text
     title_rows: list[int] = []  # entry indices j to score against the title
-    for j, e in enumerate(index.entries):
-        if candidate_mask is not None and not candidate_mask[j]:
-            continue
+    entries = index.entries
+    if candidate_mask is None:
+        survivors = range(len(entries))
+    else:
+        # iterate screen survivors only (C-level nonzero), not every entry
+        survivors = np.flatnonzero(candidate_mask).tolist()
+    any_upper = False
+    for j in survivors:
+        e = entries[j]
         if not is_within_period(article_date, e.start, e.end):
             continue
         pending.append((j, e))
@@ -304,18 +394,40 @@ def match_article(
             if text_pruned is None or j not in text_pruned:
                 text_rows.append(j)
             title_rows.append(j)
+        else:
+            any_upper = True
 
     arena = index.verify_arena()
     text_score = dict(zip(text_rows, arena.scores(text, text_rows, threshold)))
     title_score = dict(zip(title_rows, arena.scores(title, title_rows, threshold)))
 
+    # ALL-CAPS positions: one automaton pass per article part replaces the
+    # per-name \b regex scans (identical output; _upper_positions).  None
+    # (no native core / non-ASCII part) keeps the regex path per part.
+    auto_names: dict | None = None
+    text_hits = title_hits = None
+    if any_upper:
+        auto_names = index.upper_matcher()[1]
+        text_hits = _upper_positions(index, text)
+        title_hits = _upper_positions(index, title)
+
     # Pass 2: apply the decisions in the original j order.
     for j, e in pending:
         if e.is_exact_upper:
             # positions are the decision (ref :165-173)
-            pattern = r"\b" + re.escape(e.name) + r"\b"
-            text_pos = _find_positions(pattern, text)
-            title_pos = _find_positions(pattern, title)
+            in_auto = auto_names is not None and e.name in auto_names
+            pattern = None
+            if in_auto and text_hits is not None:
+                text_pos = text_hits.get(e.name, [])
+            else:
+                pattern = r"\b" + re.escape(e.name) + r"\b"
+                text_pos = _find_positions(pattern, text)
+            if in_auto and title_hits is not None:
+                title_pos = title_hits.get(e.name, [])
+            else:
+                if pattern is None:
+                    pattern = r"\b" + re.escape(e.name) + r"\b"
+                title_pos = _find_positions(pattern, title)
             if text_pos:
                 slot(e.ticker)["text"][e.name] = text_pos
             if title_pos:
@@ -526,7 +638,7 @@ def match_chunk_async(
     masks: list[np.ndarray | None] = [None] * len(rows)
     text_prunes: list[set | None] = [None] * len(rows)
     if use_screen and index.entries:
-        from advanced_scrapper_tpu.core.tokenizer import encode_batch
+        from advanced_scrapper_tpu.core.tokenizer import bucket_len, encode_batch
         from advanced_scrapper_tpu.ops.match import match_screen
 
         tables = index.screen_tables()
@@ -547,7 +659,16 @@ def match_chunk_async(
                 [len(t.encode("utf-8", "replace")) for _, t, _, _ in batch], np.int32
             )
             overlong = [len(r) > screen_block for r in raw]
-            tok, ln = encode_batch(raw, block_len=screen_block)
+            # ``screen_block`` is a CAP, not the tile width: the batch
+            # encodes at the longest article's power-of-two bucket, so a
+            # 2 kB news corpus screens on 2 kB rows instead of paying the
+            # 64 kB worst case (measured 88% of matcher wall time was
+            # screening zero padding).  O(log) compiled screen shapes.
+            blk = bucket_len(
+                max(len(r) for r in raw), min_bucket=1024,
+                max_bucket=screen_block,
+            )
+            tok, ln = encode_batch(raw, block_len=blk)
             got = match_screen(
                 tok, text_len, title_len, ln, tables, threshold=threshold
             )
@@ -722,7 +843,21 @@ def make_verify_pool(index: EntityIndex, workers: int | None = None):
         # worker now so spawn-mode children also start under the scrub
         # (forkserver children are safe regardless — their forks come
         # from the already-running jax-free server).
-        wait([pool.submit(_warm_noop) for _ in range(workers)])
+        warm = [pool.submit(_warm_noop) for _ in range(workers)]
+        wait(warm)
+        dead = next((f.exception() for f in warm if f.exception()), None)
+        if dead is not None:
+            # container/sandbox hosts that refuse worker processes must
+            # degrade to inline verify, not poison every later submit
+            import sys
+
+            print(
+                "verify pool unavailable "
+                f"({type(dead).__name__}: {dead}); verifying inline",
+                file=sys.stderr,
+            )
+            pool.shutdown(wait=False, cancel_futures=True)
+            return None
     return pool
 
 

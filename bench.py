@@ -112,6 +112,8 @@ def _bench_ragged(n_articles: int, n_corpora: int = 4) -> float:
     corpus i's readback — the production firehose regime (the reference
     analogue never stalls between 20k-row chunks, match_keywords.py:227-230).
     Distinct corpora defeat transport-level (program, input) caching."""
+    from advanced_scrapper_tpu.obs import stages
+
     rng = np.random.RandomState(7)
     engine = _ragged_engine()
     # corpus 0 warms every compiled shape (width buckets, block batches,
@@ -120,7 +122,8 @@ def _bench_ragged(n_articles: int, n_corpora: int = 4) -> float:
     corpora = [_ragged_corpus(rng, n_articles) for _ in range(n_corpora)]
     t0 = time.perf_counter()
     reps_dev = [engine.dedup_reps_async(c) for c in corpora]
-    reps = [np.asarray(r)[:n_articles] for r in reps_dev]
+    with stages.timed("resolve"):  # rep readback: the device queue drains here
+        reps = [np.asarray(r)[:n_articles] for r in reps_dev]
     dt = time.perf_counter() - t0
     for r in reps:
         assert r.shape == (n_articles,)
@@ -505,14 +508,22 @@ def main() -> None:
     try:
         # device enumeration + mesh build dispatch against the tunnel too —
         # they must sit inside the death handler, not ahead of it
+        from advanced_scrapper_tpu.obs import stages
+
         mesh = build_mesh(len(jax.devices()), 1)
         note(f"platform={platform} devices={len(jax.devices())} batch={batch}")
         uniform = _bench_uniform(jax, mesh, params, backend, batch, block)
         note(f"uniform done: {uniform:.0f}/s")
+        # stage_ms: per-stage wall attribution over the two host-path
+        # regimes (ragged + stream; obs/stages.py on what the numbers
+        # mean), so the next PR can see where the remaining time goes
+        stages.reset()
         ragged = _bench_ragged(1024 if quick else 8192)
         note(f"ragged done: {ragged:.0f}/s")
         stream = _bench_stream(jax, mesh, params, backend, batch, block, 2 if quick else 4)
         note(f"stream done: {stream:.0f}/s")
+        stage_ms = {k: 0.0 for k in ("encode", "h2d", "kernel", "resolve")}
+        stage_ms.update(stages.snapshot_ms())
         recall, recall_pairs, precision, precision_oracle, unchained = (
             _bench_recall(64 if quick else 512)
         )
@@ -528,7 +539,9 @@ def main() -> None:
             f"exact done: {exact:.0f}/s ({exact_vs_pandas:.2f}x pandas; "
             f"{exact_ms:.1f}ms vs {pandas_ms:.1f}ms)"
         )
+        stages.reset()
         matcher = _bench_matcher(256 if quick else 1024)
+        stage_ms["matcher_build"] = stages.snapshot_ms().get("matcher_build", 0.0)
         note(f"matcher done: {matcher:.0f}/s")
     except Exception as e:
         # A tunnel that came up can still die between dispatches (it has).
@@ -565,6 +578,7 @@ def main() -> None:
                 "exact_ms": round(exact_ms, 2),
                 "pandas_ms": round(pandas_ms, 2),
                 "matcher_articles_per_sec": round(matcher, 1),
+                "stage_ms": stage_ms,
                 # MFU-style utilisation is only meaningful against the v5e
                 # peak the constant describes — null on cpu-fallback rounds
                 **(

@@ -203,3 +203,46 @@ def test_exact_verify_keeps_true_near_dups():
     reps = NearDupEngine().dedup_reps(docs)
     for i in range(16):
         assert reps[2 * i + 1] == reps[2 * i], f"true near-dup pair {i} split"
+
+
+def test_exact_dedup_truncated_prefix_distinct_tails():
+    """Regression (PR 2 satellite): two distinct items sharing a common
+    prefix LONGER than max_len must both survive, on every tier — the
+    confirm step compares full strings, never a truncated view."""
+    prefix = "p" * 10000  # far past the historical 4096 hash width
+    items = [prefix + "alpha", prefix + "beta", prefix + "alpha", prefix]
+    want = [0, 1, 3]
+    assert ExactDedup(max_len=64).keep_indices(items) == want
+    assert ExactDedup().keep_indices(items) == want
+
+    # blob tier explicitly (the zero-copy tier may have served the default)
+    from advanced_scrapper_tpu.cpu.hostbatch import exact_keep_first_native
+
+    keep = exact_keep_first_native(items)
+    if keep is not None:
+        assert np.flatnonzero(keep).tolist() == want
+
+    from advanced_scrapper_tpu.cpu.exactdedup import keep_first_list
+
+    keep = keep_first_list(items)
+    if keep is not None:
+        assert np.flatnonzero(keep).tolist() == want
+
+
+def test_exact_dedup_unicode_surrogates_and_mixed_types():
+    """The native tiers must keep byte-equality ⟺ string-equality: distinct
+    lone surrogates stay distinct (no lossy encode collapse), non-ASCII
+    routes losslessly, and mixed str/bytes lists fall back to a tier that
+    keeps "a" and b"a" distinct — first-seen semantics throughout."""
+    cases = [
+        ["a\ud800", "a\ud801", "a\ud800"],
+        ["é", "e", "é", "é"],
+        ["ü" * 3000, "ü" * 3000 + "x", "ü" * 3000],
+        [b"a", b"b", b"a"],
+        ["a", b"a", "a", b"a"],
+    ]
+    for items in cases:
+        seen: set = set()
+        want = [i for i, x in enumerate(items)
+                if x not in seen and not seen.add(x)]
+        assert ExactDedup().keep_indices(items) == want, items

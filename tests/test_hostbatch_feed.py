@@ -367,3 +367,101 @@ def test_device_feed_sharded_placement_on_mesh(devices8):
     rep, tags = got[0]
     assert rep[batch // 2] == 3, "cross-shard duplicate must resolve"
     assert tags.tolist() == list(range(batch))
+
+
+def test_pop_batch_min_fill_waits_for_full_tile(batcher_factory):
+    """min_fill pops must wait for a full tile's worth of docs (the staging
+    discipline that stops partial tiles from paying full-shape kernels),
+    while timeouts and close still hand over whatever is buffered."""
+    b = batcher_factory(block=8)
+    for i in range(3):
+        assert b.push(b"x" * i, i)
+    # timeout with too few docs: returns the partial fill, not 0
+    n, _, _, tags = b.pop_batch(8, timeout_ms=50, min_fill=8)
+    assert n == 3 and list(tags[:3]) == [0, 1, 2]
+
+    # a producer completing the tile within the timeout yields a FULL pop
+    for i in range(4):
+        assert b.push(b"y", 100 + i)
+
+    def finish():
+        for i in range(4):
+            b.push(b"z", 200 + i)
+
+    t = threading.Thread(target=finish)
+    t.start()
+    n, _, _, tags = b.pop_batch(8, timeout_ms=5000, min_fill=8)
+    t.join()
+    assert n == 8 and list(tags) == [100, 101, 102, 103, 200, 201, 202, 203]
+
+    # closed queue: immediate drain of the remainder, then 0
+    b.push(b"w", 300)
+    b.close()
+    n, _, _, tags = b.pop_batch(8, timeout_ms=-1, min_fill=8)
+    assert n == 1 and tags[0] == 300
+    n, *_ = b.pop_batch(8, timeout_ms=-1, min_fill=8)
+    assert n == 0
+
+
+def test_device_feed_assembles_full_tiles():
+    """A producer pushing in chunks smaller than the batch must still see
+    full tiles at the feed (r05's stream regime popped whatever partial
+    chunk had landed and paid a full-shape kernel per partial tile)."""
+    from advanced_scrapper_tpu.pipeline.feed import DeviceFeed
+
+    batch, chunk, total = 64, 16, 256
+    b = HostBatcher(8)
+    feed = DeviceFeed(b, batch, workers=1, poll_timeout_ms=2000)
+
+    def produce():
+        for start in range(0, total, chunk):
+            b.push_many(
+                [b"d%d" % i for i in range(start, start + chunk)],
+                list(range(start, start + chunk)),
+            )
+        b.close()
+
+    t = threading.Thread(target=produce)
+    t.start()
+    fills = [n for n, _, _, _ in feed]
+    t.join()
+    feed.join()
+    assert sum(fills) == total
+    assert fills == [batch] * (total // batch), fills
+
+
+def test_feed_prefetch_depth_env_knob(monkeypatch):
+    from advanced_scrapper_tpu.pipeline.feed import resolve_prefetch_depth
+
+    monkeypatch.delenv("ASTPU_FEED_PREFETCH", raising=False)
+    assert resolve_prefetch_depth(None) == 2  # double buffering default
+    assert resolve_prefetch_depth(5) == 5     # explicit wins
+    monkeypatch.setenv("ASTPU_FEED_PREFETCH", "7")
+    assert resolve_prefetch_depth(None) == 7
+    assert resolve_prefetch_depth(3) == 3
+
+
+def test_pop_batch_min_fill_wakes_on_backpressure(batcher_factory):
+    """An arena/doc-cap queue that REJECTS pushes can never reach a waiting
+    pop's fill target — the rejection must wake the waiter to drain what is
+    buffered instead of starving until close (regression: the min_fill wait
+    only watched queue size)."""
+    import time as _time
+
+    b = batcher_factory(block=8, max_docs=64, arena_bytes=32)
+    for i in range(4):
+        assert b.push(b"12345678", i)  # arena now full (32 bytes)
+
+    got = {}
+
+    def consumer():
+        got["res"] = b.pop_batch(16, timeout_ms=10000, min_fill=16)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    _time.sleep(0.05)
+    assert not b.push(b"x", 99)  # rejected: arena cap → must wake the pop
+    t.join(timeout=5)
+    assert not t.is_alive(), "min_fill pop starved behind backpressure"
+    n, _, _, tags = got["res"]
+    assert n == 4 and list(tags[:4]) == [0, 1, 2, 3]

@@ -506,3 +506,96 @@ def test_refine_controller_race():
     c.record(False, 50.0)
     assert c.verdict() is True
     assert c._best[False] == 1.0
+
+
+def test_upper_automaton_positions_match_regex_fuzz():
+    """The multi-pattern automaton path must be output-identical to the
+    per-name ``\\b re.escape(name) \\b`` finditer loop it replaces —
+    fuzzed over names with regex-special characters, word/non-word edge
+    characters, overlapping and nested names, and repeated occurrences
+    (the finditer non-overlap rule)."""
+    import re as _re
+
+    import numpy as np
+
+    from advanced_scrapper_tpu.pipeline.matcher import (
+        EntityIndex,
+        _upper_positions,
+        match_article,
+    )
+
+    names = [
+        "AB", "ABC", "BC", "A+", "C.D", "X Y", "-AB-", "A A", "Q_Q",
+        "HE", "SHE", "HERS", "IBM", "AT&T", "(A)", "ZZZZ",
+    ]
+    processed = {
+        f"T{i}": {"aliases": {nm: (None, None)}} for i, nm in enumerate(names)
+    }
+    index = EntityIndex(processed)
+    assert all(e.is_exact_upper for e in index.entries)
+    mp, mid_of = index.upper_matcher()
+    if mp is None:
+        import pytest
+
+        pytest.skip("no native multi-pattern core")
+
+    from dateutil import parser as dateparser
+
+    non_trivial = [0]
+    rng = np.random.RandomState(17)
+    frags = names + ["ab", "x", " ", ".", "+", "_", "&", "he", "AAB", "BCD",
+                     "A A A", "ABAB", "SHERS", "usher", "(", ")", "-"]
+    for trial in range(200):
+        text = "".join(
+            frags[rng.randint(len(frags))] for _ in range(rng.randint(0, 30))
+        )
+        got = _upper_positions(index, text)
+        assert got is not None
+        for nm in names:
+            want = [
+                m.start()
+                for m in _re.finditer(r"\b" + _re.escape(nm) + r"\b", text)
+            ]
+            assert got.get(nm, []) == want, (trial, nm, text)
+
+    # end-to-end: match_article with the automaton vs with it disabled
+    for trial in range(40):
+        text = "".join(
+            frags[rng.randint(len(frags))] for _ in range(rng.randint(0, 40))
+        )
+        title = "".join(
+            frags[rng.randint(len(frags))] for _ in range(rng.randint(0, 8))
+        )
+        adate = dateparser.parse("2020-01-02 10:00:00")
+        with_auto = match_article(text, title, adate, index, None)
+        saved = index._upper_matcher
+        index._upper_matcher = (None, {})  # force the regex route
+        try:
+            without = match_article(text, title, adate, index, None)
+        finally:
+            index._upper_matcher = saved
+        assert with_auto == without, (trial, text, title)
+        if any(nm in text or nm in title for nm in names):
+            non_trivial[0] += 1
+    assert non_trivial[0] > 10  # the fuzz must exercise real matches
+
+
+def test_upper_automaton_non_ascii_text_falls_back():
+    """Non-ASCII articles must route to the regex path (byte offsets would
+    diverge from char offsets) and still produce identical decisions."""
+    from advanced_scrapper_tpu.pipeline.matcher import (
+        EntityIndex,
+        _upper_positions,
+        match_article,
+    )
+
+    index = EntityIndex({"T0": {"aliases": {"IBM": (None, None)}}})
+    from dateutil import parser as dateparser
+
+    text = "résumé — IBM gains; naïve IBM"
+    assert _upper_positions(index, text) is None  # fallback signalled
+    out = match_article(
+        text, "IBM", dateparser.parse("2020-01-02"), index, None
+    )
+    assert out["T0"]["text"]["IBM"] == [9, 26]
+    assert out["T0"]["title"]["IBM"] == [0]

@@ -173,3 +173,12 @@ def test_transport_default_worker_resolution(monkeypatch):
     monkeypatch.setenv("ASTPU_DEDUP_PUT_WORKERS", "7")
     assert bench._feed_workers() == 2
     assert resolve_put_workers(bench._ragged_engine().cfg) == 7
+
+
+def test_profile_hostpath_smoke(capsys):
+    import profile_hostpath as t
+
+    t.main(n_articles=64)
+    out = capsys.readouterr().out
+    assert "hostpath ragged 64 articles" in out
+    assert "encode=" in out and "kernel=" in out and "articles/s warm" in out

@@ -1,0 +1,69 @@
+"""One-command per-stage profile of the ragged host path.
+
+Runs bench.py's ragged corpus through the NearDupEngine twice (cold shapes,
+then warm) and prints the ``obs/stages`` attribution — encode (host
+blockwise split), h2d (device_put), kernel (signature dispatch + sync
+waits), resolve (LSH resolution + rep readback) — plus the articles/s the
+warm pass achieves.  CPU-safe (runs on whatever backend jax resolves; use
+``env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu`` to force the CPU mesh)
+and small enough for CI smoke (tests/test_tools.py), so the stage
+decomposition can't rot as the path evolves.
+
+Usage:
+    python tools/profile_hostpath.py            # 2048 articles
+    python tools/profile_hostpath.py 512        # smaller corpus
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main(n_articles: int = 2048) -> None:
+    import jax
+
+    import bench
+    from advanced_scrapper_tpu.obs import stages
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    rng = np.random.RandomState(7)
+    engine = NearDupEngine()
+    corpus = bench._ragged_corpus(rng, n_articles)
+    n_bytes = sum(len(c) for c in corpus)
+
+    stages.reset()
+    t0 = time.perf_counter()
+    engine.dedup_reps(corpus)
+    t_cold = time.perf_counter() - t0
+    cold = stages.snapshot_ms()
+
+    corpus2 = bench._ragged_corpus(rng, n_articles)
+    stages.reset()
+    t0 = time.perf_counter()
+    rep = engine.dedup_reps_async(corpus2)
+    with stages.timed("resolve"):
+        rep = np.asarray(rep)[:n_articles]
+    t_warm = time.perf_counter() - t0
+    warm = stages.snapshot_ms()
+    assert rep.shape == (n_articles,)
+
+    def fmt(d: dict) -> str:
+        keys = ("encode", "h2d", "kernel", "resolve")
+        return " ".join(f"{k}={d.get(k, 0.0):.1f}ms" for k in keys)
+
+    print(
+        f"hostpath ragged {n_articles} articles ({n_bytes / 1e6:.1f} MB): "
+        f"cold={t_cold:.2f}s [{fmt(cold)}] "
+        f"warm={t_warm:.2f}s [{fmt(warm)}] "
+        f"→ {n_articles / t_warm:.0f} articles/s warm "
+        f"(stage sums overlap by design; see obs/stages.py)"
+    )
+
+
+if __name__ == "__main__":
+    main(*[int(a) for a in sys.argv[1:2]])
