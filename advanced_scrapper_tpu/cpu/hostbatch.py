@@ -299,6 +299,15 @@ class _NativeBatcher:
     def __init__(self, lib: ctypes.CDLL, max_docs: int, arena_bytes: int):
         self._lib = lib
         self._h = ctypes.c_void_p(lib.hb_create(max_docs, arena_bytes))
+        # serializes destroy() against the introspection surface (size /
+        # arena_used / stats / closed), which telemetry callback gauges
+        # read from exporter threads: a bare `if not self._h` check would
+        # be check-then-use — destroy() could free the handle between the
+        # check and the C call, and the C side locks a member mutex with
+        # no NULL check.  Push/pop are NOT covered: they belong to the
+        # producer/consumer threads whose lifecycle already ends before
+        # destroy (the pre-existing contract).
+        self._destroy_mu = threading.Lock()
 
     def push(self, doc: bytes, tag: int) -> bool:
         return bool(self._lib.hb_push(self._h, doc, len(doc), tag))
@@ -341,28 +350,45 @@ class _NativeBatcher:
         return int(n), tokens, lengths, tags
 
     def size(self) -> int:
-        return int(self._lib.hb_size(self._h))
+        # scrape-time surface: a destroyed handle reads as empty (guarded
+        # by _destroy_mu so the handle cannot be freed mid-call)
+        with self._destroy_mu:
+            if not self._h:
+                return 0
+            return int(self._lib.hb_size(self._h))
 
     def arena_used(self) -> int:
-        return int(self._lib.hb_arena_used(self._h))
+        with self._destroy_mu:
+            if not self._h:
+                return 0
+            return int(self._lib.hb_arena_used(self._h))
 
     def stats(self) -> dict:
-        return {
-            "pushed": int(self._lib.hb_stat_pushed(self._h)),
-            "popped": int(self._lib.hb_stat_popped(self._h)),
-            "rejected": int(self._lib.hb_stat_rejected(self._h)),
-        }
+        with self._destroy_mu:
+            if not self._h:
+                return {"pushed": 0, "popped": 0, "rejected": 0}
+            return {
+                "pushed": int(self._lib.hb_stat_pushed(self._h)),
+                "popped": int(self._lib.hb_stat_popped(self._h)),
+                "rejected": int(self._lib.hb_stat_rejected(self._h)),
+            }
 
     def closed(self) -> bool:
-        return bool(self._lib.hb_closed(self._h))
+        with self._destroy_mu:
+            if not self._h:
+                return True
+            return bool(self._lib.hb_closed(self._h))
 
     def close(self) -> None:
-        self._lib.hb_close(self._h)
+        with self._destroy_mu:
+            if self._h:
+                self._lib.hb_close(self._h)
 
     def destroy(self) -> None:
-        if self._h:
-            self._lib.hb_destroy(self._h)
-            self._h = None
+        with self._destroy_mu:
+            if self._h:
+                self._lib.hb_destroy(self._h)
+                self._h = None
 
 
 class _PyBatcher:

@@ -24,6 +24,7 @@ XLA-idiomatic split.  For *static* corpora the all-device path
 from __future__ import annotations
 
 import os
+import threading
 from dataclasses import dataclass, field
 from typing import Callable, Sequence
 
@@ -114,6 +115,47 @@ class TpuBatchBackend:
                 f"unknown stream_index {self.cfg.stream_index!r}; use exact|bloom"
             )
         self._reset_stream_state()
+        self._bridge_stats()
+
+    _seq_lock = threading.Lock()
+    _seq = 0
+
+    def _bridge_stats(self) -> None:
+        """Expose :class:`BatchStats` as scrape-time callback gauges (the
+        streaming twin of the scraper's StatsTracker bridge): the stream's
+        exact-dup / near-dup / kept accounting reads live on ``/status``
+        without the submit path ever touching the registry.  Per-instance
+        ``stream=`` label: two live backends (an exact + a bloom stream in
+        one process) must not replace each other's series."""
+        from advanced_scrapper_tpu.obs import telemetry
+
+        with TpuBatchBackend._seq_lock:
+            sid = str(TpuBatchBackend._seq)
+            TpuBatchBackend._seq += 1
+        for name in ("submitted", "batches", "exact_dups", "near_dups", "kept"):
+            telemetry.gauge_fn(
+                f"astpu_stream_{name}",
+                lambda b, _n=name: getattr(b.stats, _n),
+                owner=self,
+                help=f"streaming dedup backend cumulative {name}",
+                stream=sid,
+            )
+        telemetry.gauge_fn(
+            "astpu_stream_buffered",
+            lambda b: len(b._buffer),
+            owner=self,
+            help="records buffered toward the next device batch",
+            stream=sid,
+        )
+        telemetry.gauge_fn(
+            "astpu_stream_index_keys",
+            lambda b: (
+                b._bloom.inserted if b._bloom_mode else len(b._kept_keys)
+            ),
+            owner=self,
+            help="cross-batch stream-index population",
+            stream=sid,
+        )
 
     def _reset_stream_state(self) -> None:
         """(Re)initialise every piece of cross-batch stream-index state —
@@ -268,6 +310,19 @@ class TpuBatchBackend:
                 fs.replace(path, quarantine)
             except OSError:
                 quarantine = "<unmovable>"
+            from advanced_scrapper_tpu.obs import telemetry, trace
+
+            telemetry.event_counter(
+                "astpu_quarantine_total",
+                "crash artifacts quarantined, by kind",
+                kind="stream_index",
+            ).inc()
+            trace.record(
+                "event",
+                "quarantine.stream_index",
+                path=os.path.basename(path),
+                error=str(e),
+            )
             print(
                 f"tpu_batch: stream-index checkpoint {path} is unreadable "
                 f"({e}); quarantined to {quarantine}, resuming with an "

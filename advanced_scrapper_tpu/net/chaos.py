@@ -83,6 +83,15 @@ class ChaosSocket:
         with self._lock:
             self.injected[kind] += 1
             self.ledger.append((op, tag, kind))
+        from advanced_scrapper_tpu.obs import telemetry, trace
+
+        telemetry.event_counter(
+            "astpu_fault_injected_total",
+            "chaos faults fired, by plane and kind",
+            plane="socket",
+            kind=kind,
+        ).inc()
+        trace.record("fault", f"socket.{kind}", op=op)
 
     # -- faulted surface ---------------------------------------------------
 

@@ -136,6 +136,18 @@ class ControlPlane:
             return 202, {"status": "pending"}
         return 200, result
 
+    def status(self) -> dict:
+        """Plane-local view for ``GET /status``: registered templates and
+        the async result cache's fill."""
+        with self._lock:
+            pending = sum(1 for v in self._results.values() if v is None)
+            done = len(self._results) - pending
+        return {
+            "templates": self.store.names(),
+            "results_pending": pending,
+            "results_done": done,
+        }
+
     def shutdown(self) -> None:
         self._pool.shutdown(wait=True)
         with self._lock:
@@ -196,6 +208,19 @@ def make_handler(plane: ControlPlane):
                 self._reply(code, obj)
             elif self.path == "/templates":
                 self._reply(200, {"templates": plane.store.names()})
+            elif self.path == "/metrics":
+                # process-wide telemetry — the control plane doubles as the
+                # pipeline's metrics endpoint (shared exporter: the response
+                # logic lives once, in obs.telemetry)
+                from advanced_scrapper_tpu.obs import telemetry
+
+                telemetry.serve_metrics(self)
+            elif self.path == "/status":
+                from advanced_scrapper_tpu.obs import telemetry
+
+                telemetry.serve_status(
+                    self, extra_status=lambda: {"control": plane.status()}
+                )
             else:
                 self._reply(404, {"error": f"no such endpoint {self.path}"})
 
@@ -206,6 +231,9 @@ class ControlServer:
     """Threaded HTTP server wrapper around :class:`ControlPlane`."""
 
     def __init__(self, plane: ControlPlane, host: str = "127.0.0.1", port: int = 0):
+        from advanced_scrapper_tpu.obs import telemetry
+
+        telemetry.register_process_metrics()  # /metrics is never empty
         self.plane = plane
         self._httpd = ThreadingHTTPServer((host, port), make_handler(plane))
         self.host, self.port = self._httpd.server_address[:2]

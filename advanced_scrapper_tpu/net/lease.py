@@ -67,10 +67,24 @@ class _LineReader:
 class LeaseServer:
     """Task server: leases url batches, collects results, survives client loss."""
 
-    def __init__(self, cfg: FeedConfig, urls: list[str], *, host: str | None = None, port: int | None = None):
+    def __init__(
+        self,
+        cfg: FeedConfig,
+        urls: list[str],
+        *,
+        host: str | None = None,
+        port: int | None = None,
+        status_port: int | None = None,
+    ):
+        """``status_port`` mirrors the control plane's observability
+        endpoints (``GET /metrics`` + ``GET /status``) on a small HTTP
+        server beside the TCP lease socket: 0 = ephemeral port, None =
+        only when telemetry is enabled (``ASTPU_TELEMETRY``)."""
         self.cfg = cfg
         self.host = host if host is not None else cfg.host
         self.port = port if port is not None else cfg.port
+        self._status_port = status_port
+        self.status_server = None
         self._urls: queue.SimpleQueue[str] = queue.SimpleQueue()
         # dedup on ingest: a url is one unit of work (the per-client
         # assigned sets — and the stray-result guard built on them — are
@@ -90,6 +104,106 @@ class LeaseServer:
         self._threads: list[threading.Thread] = []
         self._sock: socket.socket | None = None
         self._next_client = 0
+        self._instrument()
+
+    _seq_lock = threading.Lock()
+    _seq = 0
+
+    def _instrument(self) -> None:
+        """Per-worker fleet gauges + protocol counters.  The per-client
+        assigned counts export as ONE expanding callback gauge, so a fleet
+        of N workers is N series on ``/metrics`` without per-connect
+        registration churn.  An EXPLICIT ``status_port`` forces the lease
+        instrumentation live even when ``ASTPU_TELEMETRY`` is off — an
+        operator who asked for the mirror must not scrape an empty one.
+        Per-instance ``server=`` label: concurrent lease servers in one
+        process must not replace each other's series."""
+        from advanced_scrapper_tpu.obs import telemetry
+
+        always = self._status_port is not None
+        with LeaseServer._seq_lock:
+            sid = str(LeaseServer._seq)
+            LeaseServer._seq += 1
+        self._m_leased = telemetry.REGISTRY.counter(
+            "astpu_lease_urls_leased_total", "urls handed to clients",
+            always=always, server=sid,
+        )
+        self._m_results = telemetry.REGISTRY.counter(
+            "astpu_lease_results_total", "results accepted from clients",
+            always=always, server=sid,
+        )
+        self._m_stray = telemetry.REGISTRY.counter(
+            "astpu_lease_stray_results_total",
+            "duplicate/stray results rejected by the assignment guard",
+            always=always, server=sid,
+        )
+        self._m_requeued = telemetry.REGISTRY.counter(
+            "astpu_lease_urls_requeued_total",
+            "urls returned to the queue by client disconnects",
+            always=always, server=sid,
+        )
+        telemetry.gauge_fn(
+            "astpu_lease_pending",
+            lambda s: s._pending,
+            owner=self,
+            always=always,
+            help="urls not yet successfully resulted",
+            server=sid,
+        )
+        telemetry.gauge_fn(
+            "astpu_lease_clients_connected",
+            lambda s: len(s._assigned),
+            owner=self,
+            always=always,
+            help="clients with an open assignment ledger",
+            server=sid,
+        )
+        telemetry.gauge_fn(
+            "astpu_lease_assigned",
+            lambda s: {
+                cid: len(urls) for cid, urls in s._assigned_snapshot().items()
+            },
+            owner=self,
+            expand="client",
+            always=always,
+            help="urls currently leased per client",
+            server=sid,
+        )
+        telemetry.gauge_fn(
+            "astpu_lease_request_rate",
+            lambda s: s.stats.rates()[0],
+            owner=self,
+            always=always,
+            help="task requests/s over the stats window",
+            server=sid,
+        )
+        telemetry.gauge_fn(
+            "astpu_lease_response_rate",
+            lambda s: s.stats.rates()[1],
+            owner=self,
+            always=always,
+            help="results/s over the stats window",
+            server=sid,
+        )
+
+    def _assigned_snapshot(self) -> dict[int, set[str]]:
+        with self._lock:
+            return {cid: set(urls) for cid, urls in self._assigned.items()}
+
+    def fleet_status(self) -> dict:
+        """JSON-able fleet view — merged into the status endpoint's payload
+        and directly usable by dashboards."""
+        req_rate, resp_rate = self.stats.rates()
+        with self._lock:
+            return {
+                "pending": self._pending,
+                "clients": {
+                    str(cid): len(urls) for cid, urls in self._assigned.items()
+                },
+                "results": len(self.results),
+                "request_rate": round(req_rate, 2),
+                "response_rate": round(resp_rate, 2),
+            }
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -104,6 +218,13 @@ class LeaseServer:
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
+        from advanced_scrapper_tpu.obs import telemetry
+
+        if self._status_port is not None or telemetry.enabled():
+            self.status_server = telemetry.StatusServer(
+                port=self._status_port or 0,
+                extra_status=lambda: {"lease": self.fleet_status()},
+            ).start()
         return self
 
     def stop(self) -> None:
@@ -112,6 +233,9 @@ class LeaseServer:
             self._sock.close()
         for t in self._threads:
             t.join(timeout=5)
+        if self.status_server is not None:
+            self.status_server.stop()
+            self.status_server = None
 
     def done(self) -> bool:
         with self._lock:
@@ -156,13 +280,23 @@ class LeaseServer:
                     break
                 out.append(u)
                 self._assigned[cid].add(u)
+        self._m_leased.inc(len(out))
         return out
 
     def _return_unprocessed(self, cid: int) -> None:
         """Lease return on disconnect — the fault-tolerance core (ref :80-84)."""
+        returned = 0
         with self._lock:
             for u in self._assigned.pop(cid, ()):
                 self._urls.put(u)
+                returned += 1
+        if returned:
+            self._m_requeued.inc(returned)
+            from advanced_scrapper_tpu.obs import trace
+
+            trace.record(
+                "event", "lease.requeue", client=cid, urls=returned
+            )
 
     def _handle_client(self, conn: socket.socket, cid: int) -> None:
         reader = _LineReader(conn)
@@ -192,9 +326,12 @@ class LeaseServer:
                             self._assigned[cid].discard(url)
                             self._pending -= 1
                     if known:
+                        self._m_results.inc()
                         self.results.append(
                             {"url": url, "html_content": msg.get("html_content", "")}
                         )
+                    else:
+                        self._m_stray.inc()
                 elif kind == "tasks_completed":
                     _send_json(conn, wlock, {"type": "acknowledge_completion"})
                     return

@@ -1,4 +1,12 @@
 from advanced_scrapper_tpu.obs.stats import StatsTracker
 from advanced_scrapper_tpu.obs.console import ConsoleMux, green, red
+from advanced_scrapper_tpu.obs import telemetry, trace
 
-__all__ = ["StatsTracker", "ConsoleMux", "green", "red"]
+__all__ = [
+    "StatsTracker",
+    "ConsoleMux",
+    "green",
+    "red",
+    "telemetry",
+    "trace",
+]

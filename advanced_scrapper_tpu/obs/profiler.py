@@ -13,11 +13,21 @@ from collections import deque
 
 
 class StepTimer:
-    """Rolling per-step duration tracker (device batches, host stages)."""
+    """Rolling per-step duration tracker (device batches, host stages).
 
-    def __init__(self, maxlen: int = 512):
+    Production step loops own one (``pipeline.feed.DeviceFeed.timer``,
+    ``pipeline.dedup.NearDupEngine.step_timer``) so :meth:`summary` is
+    reachable live, and each observation can mirror into a telemetry
+    histogram (``histogram=``) so the same steps show on ``/metrics`` —
+    the registry hands back a no-op handle when telemetry is disabled,
+    keeping the mirrored path free.  Appends are deque ops (thread-safe
+    under the GIL); :meth:`summary` reads a snapshot.
+    """
+
+    def __init__(self, maxlen: int = 512, histogram=None):
         self._durations: deque[float] = deque(maxlen=maxlen)
         self._items: deque[int] = deque(maxlen=maxlen)
+        self._histogram = histogram
 
     @contextlib.contextmanager
     def step(self, n_items: int = 1):
@@ -25,14 +35,22 @@ class StepTimer:
         try:
             yield
         finally:
-            self._durations.append(time.perf_counter() - t0)
-            self._items.append(n_items)
+            self.add(time.perf_counter() - t0, n_items)
+
+    def add(self, seconds: float, n_items: int = 1) -> None:
+        """Record a step timed by the caller — for loops where the item
+        count is only known after the work (e.g. a pop that may drain a
+        partial tile)."""
+        self._durations.append(seconds)
+        self._items.append(n_items)
+        if self._histogram is not None:
+            self._histogram.observe(seconds)
 
     def summary(self) -> dict:
         if not self._durations:
             return {"steps": 0}
         ds = sorted(self._durations)
-        total_t = sum(self._durations)
+        total_t = sum(ds)
         total_n = sum(self._items)
         return {
             "steps": len(ds),

@@ -11,8 +11,18 @@ spent *waiting* on device results (dispatch is async; a fully-hidden kernel
 contributes ~0).  The numbers answer "where would another millisecond of
 host work hurt", which is what the next PR needs — not a scheduler trace.
 
+Since the telemetry plane landed this module is a thin VIEW over the
+process registry: every :func:`add` lands in the always-on
+``astpu_stage_seconds`` histogram (``obs/telemetry.py``), so the bench's
+``stage_ms`` and the live ``/metrics`` stage series are the same numbers
+by construction.  The histograms are cumulative (Prometheus-style);
+:func:`reset` snapshots per-stage baselines and :func:`snapshot_ms`
+reports the delta since — a windowed read over shared state, so two
+concurrent windowed readers would see each other's time (the bench runs
+its regimes serially; live scrapes read the cumulative series instead).
+
 Thread-safe (the H2D put pool and DeviceFeed workers time from their own
-threads); overhead is one ``perf_counter`` pair and a dict update per
+threads); overhead is one ``perf_counter`` pair and a histogram update per
 *batch*, noise against millisecond-scale stages.
 """
 
@@ -22,16 +32,28 @@ import threading
 import time
 from contextlib import contextmanager
 
+from advanced_scrapper_tpu.obs import telemetry
+
 _lock = threading.Lock()
-_acc: dict[str, float] = {}
+_hists: dict[str, telemetry.Histogram] = {}
+_baseline: dict[str, float] = {}  # per-stage cumulative sum at last reset()
 
 #: canonical stage names (call sites may add others; these are the bench's)
 STAGES = ("encode", "h2d", "kernel", "resolve", "matcher_build")
 
 
+def _hist(stage: str) -> telemetry.Histogram:
+    # local cache so the per-batch path skips the registry lock/lookup
+    h = _hists.get(stage)
+    if h is None:
+        h = telemetry.stage_histogram(stage)
+        with _lock:
+            _hists[stage] = h
+    return h
+
+
 def add(stage: str, seconds: float) -> None:
-    with _lock:
-        _acc[stage] = _acc.get(stage, 0.0) + seconds
+    _hist(stage).observe(seconds)
 
 
 @contextmanager
@@ -44,11 +66,28 @@ def timed(stage: str):
 
 
 def reset() -> None:
+    """Start a measurement window: nothing is cleared (the live series
+    stays cumulative); per-stage baselines are snapshotted instead."""
     with _lock:
-        _acc.clear()
+        _baseline.clear()
+        for h in telemetry.stage_histograms():
+            _baseline[h.labels["stage"]] = h.sum
 
 
 def snapshot_ms() -> dict[str, float]:
     """Cumulative per-stage milliseconds since the last :func:`reset`."""
+    out: dict[str, float] = {}
     with _lock:
-        return {k: round(v * 1e3, 1) for k, v in sorted(_acc.items())}
+        for h in telemetry.stage_histograms():
+            stage = h.labels["stage"]
+            out[stage] = round((h.sum - _baseline.get(stage, 0.0)) * 1e3, 1)
+    return dict(sorted(out.items()))
+
+
+def _clear_for_tests() -> None:
+    """Drop the handle cache and baselines — required after a test calls
+    ``telemetry.REGISTRY.reset()``, or cached handles would keep feeding
+    histograms the registry no longer exports."""
+    with _lock:
+        _hists.clear()
+        _baseline.clear()

@@ -1,0 +1,218 @@
+"""Structured span tracing + in-memory crash flight recorder.
+
+Metrics (``obs/telemetry.py``) answer "how fast, how many"; this module
+answers "what exactly was in flight when it died".  A fixed-size ring
+buffer records spans and events — batch/trace IDs flow from document
+ingest through encode → H2D → kernel dispatch → resolve → matcher — and
+on a crash or a chaos-injected fault the ring is dumped to a JSONL
+sidecar, so PR 1's kill-restart harness (``tools/crashsweep.py``) can
+assert on the recorder's last-known state instead of reconstructing it
+from log lines.
+
+Recording is OFF unless ``ASTPU_TELEMETRY`` is truthy or
+``ASTPU_FLIGHT_RECORDER=<path>`` names a dump destination (the env knob
+forked children inherit, mirroring ``ASTPU_CHAOS_FS``).  Disabled,
+:func:`span` costs one attribute check before yielding.
+
+The dump path deliberately bypasses the ``storage.fsio`` seam: the
+recorder fires *during* injected storage faults, and routing its own
+sidecar through the faulty substrate would recurse the injection (and
+torn flight logs defeat their purpose).  Every record line is
+self-contained JSON, so even a tail cut off by the OS stays parseable
+line-by-line.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+__all__ = [
+    "FlightRecorder",
+    "RECORDER",
+    "enabled",
+    "set_enabled",
+    "set_dump_path",
+    "dump_path",
+    "span",
+    "record",
+    "new_trace_id",
+    "dump",
+    "dump_on_fault",
+    "install_excepthook",
+]
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+_trace_ids = itertools.count(1)
+
+
+def new_trace_id() -> str:
+    """Process-unique trace id (pid-qualified so multi-process sweeps can
+    interleave their sidecars without collision)."""
+    return f"{os.getpid():x}-{next(_trace_ids):x}"
+
+
+class FlightRecorder:
+    """Bounded ring of structured events; thread-safe; cheap when off."""
+
+    def __init__(self, capacity: int = 2048):
+        self._ring: deque[dict] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._active: bool | None = None  # None → resolve from env lazily
+        self._dump_path: str | None = None
+        self._dumped = False
+        self.capacity = capacity
+
+    # -- gating ------------------------------------------------------------
+
+    @property
+    def active(self) -> bool:
+        if self._active is None:
+            env = os.environ
+            self._active = (
+                env.get("ASTPU_TELEMETRY", "").lower() in _TRUTHY
+                or bool(env.get("ASTPU_FLIGHT_RECORDER"))
+            )
+        return self._active
+
+    def set_active(self, on: bool | None) -> None:
+        self._active = on
+
+    def set_dump_path(self, path: str | None) -> None:
+        self._dump_path = path
+
+    def dump_path(self) -> str | None:
+        return self._dump_path or os.environ.get("ASTPU_FLIGHT_RECORDER") or None
+
+    # -- recording ---------------------------------------------------------
+
+    def record(self, kind: str, name: str, **fields) -> None:
+        if not self.active:
+            return
+        ev = {"ts": time.time(), "kind": kind, "name": name}
+        ev.update(fields)
+        with self._lock:
+            self._ring.append(ev)
+
+    @contextmanager
+    def span(self, name: str, **fields):
+        """Timed span; on any exit (including exception) the duration and
+        outcome land in the ring.  ``trace``/``batch`` fields carry IDs
+        across stages."""
+        if not self.active:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        except BaseException as e:
+            self.record(
+                "span",
+                name,
+                dur_ms=round((time.perf_counter() - t0) * 1e3, 3),
+                error=f"{type(e).__name__}: {e}",
+                **fields,
+            )
+            raise
+        self.record(
+            "span",
+            name,
+            dur_ms=round((time.perf_counter() - t0) * 1e3, 3),
+            **fields,
+        )
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+        self._dumped = False
+
+    # -- crash dump --------------------------------------------------------
+
+    def dump(self, path: str | None = None, *, reason: str = "") -> str | None:
+        """Write the ring as JSONL (oldest first) to ``path`` (default: the
+        configured dump path).  Returns the path written, or None when no
+        destination is configured.  Uses plain ``open`` on purpose — see
+        module docstring."""
+        path = path or self.dump_path()
+        if not path:
+            return None
+        events = self.snapshot()
+        header = {
+            "ts": time.time(),
+            "kind": "dump",
+            "name": "flight_recorder",
+            "pid": os.getpid(),
+            "reason": reason,
+            "events": len(events),
+        }
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(header) + "\n")
+            for ev in events:
+                fh.write(json.dumps(ev, default=str) + "\n")
+            fh.flush()
+            try:
+                os.fsync(fh.fileno())
+            except OSError:
+                pass
+        return path
+
+    def dump_on_fault(self, reason: str) -> str | None:
+        """Crash-path dump: records the fault event, writes the sidecar
+        once (repeated faults in one death don't multiply dumps), and
+        never raises — the crash in progress owns the control flow."""
+        try:
+            if not self.active:
+                return None
+            self.record("fault", "crash", reason=reason)
+            with self._lock:
+                if self._dumped:
+                    return None
+                self._dumped = True
+            return self.dump(reason=reason)
+        except Exception:
+            return None
+
+
+RECORDER = FlightRecorder()
+
+# module-level conveniences bound to the process recorder
+span = RECORDER.span
+record = RECORDER.record
+dump = RECORDER.dump
+dump_on_fault = RECORDER.dump_on_fault
+set_dump_path = RECORDER.set_dump_path
+dump_path = RECORDER.dump_path
+
+
+def enabled() -> bool:
+    return RECORDER.active
+
+
+def set_enabled(on: bool | None) -> None:
+    RECORDER.set_active(on)
+
+
+def install_excepthook() -> None:
+    """Chain the flight-recorder dump onto ``sys.excepthook`` so an
+    uncaught exception (not just chaos faults) leaves a sidecar.  Long-
+    running entry points (bench, CLI scrape) opt in; libraries never
+    mutate the hook on import."""
+    import sys
+
+    prev = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        dump_on_fault(f"uncaught {exc_type.__name__}: {exc}")
+        prev(exc_type, exc, tb)
+
+    sys.excepthook = hook

@@ -74,6 +74,78 @@ class NearDupEngine:
         # compiled fused-step cache for dedup_reps_sharded, keyed on
         # (mesh, article bucket, block_len) — meshes are hashable
         self._sharded_steps: dict = {}
+        self._instrument()
+
+    def _instrument(self) -> None:
+        """Telemetry handles (no-ops when disabled) + the production home
+        of the once-orphaned ``StepTimer``: every device block-batch
+        dispatch lands in it, so ``step_summary()`` answers "what is the
+        per-dispatch latency right now" on a live engine."""
+        from advanced_scrapper_tpu.obs import telemetry
+        from advanced_scrapper_tpu.obs.profiler import StepTimer
+
+        self.step_timer = StepTimer(
+            histogram=telemetry.histogram(
+                "astpu_dedup_step_seconds", "device block-batch dispatch latency"
+            )
+        )
+        self._m_batches = telemetry.counter(
+            "astpu_dedup_batches_total", "device block batches dispatched"
+        )
+        self._m_docs = {
+            regime: telemetry.counter(
+                "astpu_dedup_docs_total",
+                "documents entering dedup",
+                regime=regime,
+            )
+            for regime in ("oneshot", "async", "sharded")
+        }
+        self._m_dups = {
+            regime: telemetry.counter(
+                "astpu_dedup_dups_total",
+                "documents resolved as near-duplicates",
+                regime=regime,
+            )
+            for regime in ("oneshot", "sharded")
+        }
+        self._m_ratio = {
+            regime: telemetry.gauge(
+                "astpu_dedup_ratio",
+                "last corpus' duplicate fraction",
+                regime=regime,
+            )
+            for regime in ("oneshot", "sharded")
+        }
+        self._m_cand = telemetry.counter(
+            "astpu_dedup_candidate_pairs_total",
+            "LSH candidate (row, band) hits examined by the certified "
+            "one-shot resolution (async/sharded never sync candidates)",
+        )
+        self._m_borderline = telemetry.counter(
+            "astpu_dedup_borderline_edges_total",
+            "estimator-fragile edges flagged for exact confirmation",
+        )
+        self._m_exact_checks = telemetry.counter(
+            "astpu_dedup_exact_checks_total",
+            "exact shingle-set Jaccard confirmations run",
+        )
+
+    def step_summary(self) -> dict:
+        """Rolling per-dispatch latency/throughput (``StepTimer.summary``)."""
+        return self.step_timer.summary()
+
+    def _count_result(self, regime: str, n: int, reps: np.ndarray) -> None:
+        """Host-side dedup-ratio accounting — only for paths that already
+        synced ``reps`` (the async path never syncs; it counts docs only).
+        The numpy reduction is metric-only work, so it is skipped entirely
+        when telemetry handed out no-op handles (the disabled cost model)."""
+        from advanced_scrapper_tpu.obs.telemetry import NOOP
+
+        if n == 0 or self._m_dups[regime] is NOOP:
+            return
+        dups = int((reps[:n] != np.arange(n)).sum())
+        self._m_dups[regime].inc(dups)
+        self._m_ratio[regime].set(dups / n)
 
     def signatures(self, texts: Sequence[str | bytes]) -> np.ndarray:
         """uint32[N, num_perm] MinHash signatures (blockwise, batched).
@@ -85,13 +157,16 @@ class NearDupEngine:
         """
         if len(texts) == 0:
             return np.zeros((0, self.params.num_perm), np.uint32)
-        from advanced_scrapper_tpu.obs import stages
+        from advanced_scrapper_tpu.obs import stages, trace
 
-        sigs = self._signatures_device(texts)
-        with stages.timed("kernel"):  # readback sync: the device drains here
+        tid = trace.new_trace_id()
+        sigs = self._signatures_device(texts, trace_id=tid)
+        with stages.timed("kernel"), trace.span(
+            "dedup.readback", trace=tid, docs=len(texts)
+        ):  # readback sync: the device drains here
             return np.asarray(sigs)[: len(texts)]
 
-    def _signatures_device(self, texts: Sequence[str | bytes]):
+    def _signatures_device(self, texts: Sequence[str | bytes], trace_id=None):
         """Device ``uint32[bucket_len(N), num_perm]`` combined signatures.
 
         The ragged corpus is grouped by power-of-two *width buckets* (a doc
@@ -128,10 +203,11 @@ class NearDupEngine:
             block_counts,
             encode_blocks_ranges,
         )
-        from advanced_scrapper_tpu.obs import stages
+        from advanced_scrapper_tpu.obs import stages, trace
         from advanced_scrapper_tpu.ops.minhash import accumulate_block_signatures
         from advanced_scrapper_tpu.ops.shingle import U32_MAX
 
+        tid = trace_id or trace.new_trace_id()
         raw = [to_bytes(t) for t in texts]
         n = len(raw)
         # Bucket the article count so combine compiles O(log N) variants, not
@@ -139,7 +215,9 @@ class NearDupEngine:
         n_bucket = bucket_len(n, min_bucket=64)
         overlap = params.shingle_k - 1
         stride = cfg.block_len - overlap
-        with stages.timed("encode"):
+        with stages.timed("encode"), trace.span(
+            "dedup.encode", trace=tid, docs=n
+        ):
             # Vectorised RANGE bucketing, one numpy pass, no per-article
             # Python loop.  Every document becomes one TAIL range (the
             # whole doc when it fits a single block) routed to the
@@ -257,6 +335,7 @@ class NearDupEngine:
         # interleaving untouched.
         put_workers = resolve_put_workers(cfg)
         running = jnp.full((n_bucket, params.num_perm), U32_MAX, jnp.uint32)
+        dispatched = 0
         if put_workers > 1:
             from collections import deque
             from concurrent.futures import ThreadPoolExecutor
@@ -277,14 +356,20 @@ class NearDupEngine:
                     if len(pending) <= put_workers:
                         continue
                     t, l, o = pending.popleft().result()
-                    with stages.timed("kernel"):
+                    dispatched += 1
+                    with stages.timed("kernel"), self.step_timer.step(
+                        int(t.shape[0])
+                    ):
                         running = accumulate_block_signatures(
                             running, block_fn(t, l, params), o,
                             num_articles=n_bucket,
                         )
                 while pending:
                     t, l, o = pending.popleft().result()
-                    with stages.timed("kernel"):
+                    dispatched += 1
+                    with stages.timed("kernel"), self.step_timer.step(
+                        int(t.shape[0])
+                    ):
                         running = accumulate_block_signatures(
                             running, block_fn(t, l, params), o,
                             num_articles=n_bucket,
@@ -295,10 +380,18 @@ class NearDupEngine:
                     t, l, o = (
                         jax.device_put(t), jax.device_put(l), jax.device_put(o)
                     )
-                with stages.timed("kernel"):  # async dispatch; waits land here
+                dispatched += 1
+                with stages.timed("kernel"), self.step_timer.step(
+                    int(t.shape[0])
+                ):  # async dispatch; waits land here
                     running = accumulate_block_signatures(
                         running, block_fn(t, l, params), o, num_articles=n_bucket
                     )
+        self._m_batches.inc(dispatched)
+        if trace.RECORDER.active:
+            trace.record(
+                "span", "dedup.dispatch", trace=tid, batches=dispatched, docs=n
+            )
         if use_oph:
             running = densify(running)
         return running
@@ -308,24 +401,27 @@ class NearDupEngine:
         signatures → candidate keys → per-band candidates."""
         import jax
 
-        from advanced_scrapper_tpu.obs import stages
+        from advanced_scrapper_tpu.obs import stages, trace
 
+        tid = trace.new_trace_id()
         n = len(texts)
         raw = [to_bytes(t) for t in texts]  # encode once; identity on bytes
-        sigs = self._signatures_device(raw)
+        sigs = self._signatures_device(raw, trace_id=tid)
         n_bucket = sigs.shape[0]
         lens = np.fromiter((len(r) for r in raw), np.int64, count=n)
         valid = np.zeros((n_bucket,), bool)
         valid[:n] = lens >= self.params.shingle_k
         valid = jax.device_put(valid)
-        with stages.timed("resolve"):
+        with stages.timed("resolve"), trace.span(
+            "dedup.candidates", trace=tid, docs=n
+        ):
             keys = candidate_keys(
                 sigs, self.params.band_salt, self.cfg.cand_subbands
             )
             rep_bands = duplicate_rep_bands(keys, valid)
-        return raw, sigs, keys, valid, rep_bands, n_bucket
+        return raw, sigs, keys, valid, rep_bands, n_bucket, tid
 
-    def dedup_reps_async(self, texts: Sequence[str | bytes]):
+    def dedup_reps_async(self, texts: Sequence[str | bytes], *, _regime: str = "async"):
         """Dispatch the full dedup and return the DEVICE ``int32[bucket]``
         rep array without syncing — everything from encode to resolve is
         async, so a caller streaming multiple corpora overlaps corpus i+1's
@@ -341,10 +437,15 @@ class NearDupEngine:
         # Device-resident end to end: combined signatures never round-trip to
         # the host (the sig D2H + re-H2D bounce cost ~0.3 s per 8k articles
         # on the tunneled link); the only D2H is the final int32[N] reps.
-        from advanced_scrapper_tpu.obs import stages
+        from advanced_scrapper_tpu.obs import stages, trace
 
-        _raw, sigs, keys, valid, rep_bands, n_bucket = self._prepare(texts)
-        with stages.timed("resolve"):
+        _raw, sigs, keys, valid, rep_bands, n_bucket, tid = self._prepare(texts)
+        # _regime: the one-shot API's estimator-only branch delegates here —
+        # its documents must count as "oneshot", not inflate the async series
+        self._m_docs[_regime].inc(len(texts))
+        with stages.timed("resolve"), trace.span(
+            "dedup.resolve", trace=tid, regime=_regime, docs=len(texts)
+        ):
             if self.cfg.cand_subbands and self.cfg.fine_margin:
                 thr = fine_edge_thresholds(
                     rep_bands,
@@ -369,7 +470,7 @@ class NearDupEngine:
         use the one-shot :meth:`dedup_reps` when the exact-verify precision
         path is required.
         """
-        from advanced_scrapper_tpu.obs import stages
+        from advanced_scrapper_tpu.obs import stages, trace
         from advanced_scrapper_tpu.parallel.sharded import (
             make_sharded_block_dedup,
         )
@@ -377,6 +478,8 @@ class NearDupEngine:
         n = len(texts)
         if n == 0:
             return np.zeros((0,), np.int32)
+        tid = trace.new_trace_id()
+        self._m_docs["sharded"].inc(n)
         cfg = self.cfg
         raw = [to_bytes(t) for t in texts]
         with stages.timed("encode"):
@@ -413,9 +516,15 @@ class NearDupEngine:
                 fine_margin=cfg.fine_margin,
             )
             self._sharded_steps[key] = step
-        rep, _hist = step(tok, lens, owners)
-        with stages.timed("resolve"):
-            return np.asarray(rep)[:n]
+        with self.step_timer.step(int(tok.shape[0])):
+            rep, _hist = step(tok, lens, owners)
+        self._m_batches.inc()
+        with stages.timed("resolve"), trace.span(
+            "dedup.resolve", trace=tid, regime="sharded", docs=n
+        ):
+            out = np.asarray(rep)[:n]
+        self._count_result("sharded", n, out)
+        return out
 
     def _exact_verified_ok(self, raw, sigs, keys, valid, rep_bands):
         """Verified-edge matrix with statistically fragile edges confirmed
@@ -444,7 +553,18 @@ class NearDupEngine:
             self.cfg.exact_verify_band,
             num_coarse=self.params.num_bands,
         )
+        from advanced_scrapper_tpu.obs.telemetry import NOOP
+
         need = np.asarray(need_dev)
+        if self._m_cand is not NOOP:
+            # metric-only host work (skipped when telemetry is disabled),
+            # counted BEFORE the borderline early-return: candidate volume
+            # must not read 0 just because every edge cleared the bar
+            rb_m = np.asarray(rep_bands)
+            self._m_cand.inc(
+                int((rb_m != np.arange(rb_m.shape[0])[:, None]).sum())
+            )
+            self._m_borderline.inc(int(need.sum()))
         if not need.any():
             return ok_dev
         rb = np.asarray(rep_bands)
@@ -486,6 +606,7 @@ class NearDupEngine:
                     )
             if not pairs[key]:
                 ok[r, c] = False  # exact Jaccard (or strict bar) refuted it
+        self._m_exact_checks.inc(checked)
         return ok
 
     def dedup_reps(self, texts: Sequence[str | bytes]) -> np.ndarray:
@@ -495,17 +616,25 @@ class NearDupEngine:
         n = len(texts)
         if n == 0:
             return np.zeros((0,), np.int32)
+        from advanced_scrapper_tpu.obs import trace
+
         # exact verification is independent of fine-band candidacy:
         # coarse-borderline edges need confirmation even at cand_subbands=0
         # (borderline_edge_mask handles the no-fine-columns case)
         if not self.cfg.exact_verify_band:
-            return np.asarray(self.dedup_reps_async(texts))[:n]
-        raw, sigs, keys, valid, rep_bands, n_bucket = self._prepare(texts)
-        ok = self._exact_verified_ok(raw, sigs, keys, valid, rep_bands)
-        rep = resolve_rep_bands_from_ok(
-            rep_bands, ok, valid, jump_rounds=_jump_rounds(n_bucket)
-        )
-        return np.asarray(rep)[:n]
+            out = np.asarray(self.dedup_reps_async(texts, _regime="oneshot"))[:n]
+            self._count_result("oneshot", n, out)
+            return out
+        raw, sigs, keys, valid, rep_bands, n_bucket, tid = self._prepare(texts)
+        self._m_docs["oneshot"].inc(n)
+        with trace.span("dedup.resolve", trace=tid, regime="oneshot", docs=n):
+            ok = self._exact_verified_ok(raw, sigs, keys, valid, rep_bands)
+            rep = resolve_rep_bands_from_ok(
+                rep_bands, ok, valid, jump_rounds=_jump_rounds(n_bucket)
+            )
+            out = np.asarray(rep)[:n]
+        self._count_result("oneshot", n, out)
+        return out
 
     def keep(self, texts: Sequence[str | bytes]) -> np.ndarray:
         reps = self.dedup_reps(texts)

@@ -92,6 +92,7 @@ class DeviceFeed:
         self.min_fill = batch_size if min_fill is None else min_fill
         depth = resolve_prefetch_depth(depth)
         self._out: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._instrument()
         self._error: BaseException | None = None
         self._jax = jax
         self._exit_lock = threading.Lock()
@@ -103,6 +104,73 @@ class DeviceFeed:
         for t in self._threads:
             t.start()
 
+    _seq_lock = threading.Lock()
+    _seq = 0
+
+    def _instrument(self) -> None:
+        """Telemetry handles, fetched once (no-ops when disabled).  Queue
+        depth / arena occupancy / rejected pushes export as CALLBACK gauges
+        read at scrape time — the feed loop itself never samples them —
+        and the per-batch step loop owns the once-orphaned ``StepTimer``
+        so ``summary()`` is reachable from production code (and mirrors
+        into ``astpu_feed_step_seconds``)."""
+        from advanced_scrapper_tpu.obs import telemetry
+        from advanced_scrapper_tpu.obs.profiler import StepTimer
+
+        with DeviceFeed._seq_lock:
+            feed_id = str(DeviceFeed._seq)
+            DeviceFeed._seq += 1
+        self._m_batches = telemetry.counter(
+            "astpu_feed_batches_total", "tiles popped and staged on device"
+        )
+        self._m_docs = telemetry.counter(
+            "astpu_feed_docs_total", "documents staged on device"
+        )
+        self._m_partial = telemetry.counter(
+            "astpu_feed_partial_tiles_total",
+            "tiles dispatched below batch_size (timeout/close drains)",
+        )
+        self._m_fill = telemetry.gauge(
+            "astpu_feed_fill_ratio", "last tile's rows / batch_size", feed=feed_id
+        )
+        self.timer = StepTimer(
+            histogram=telemetry.histogram(
+                "astpu_feed_step_seconds", "pop→device_put cycle latency"
+            )
+        )
+        telemetry.gauge_fn(
+            "astpu_feed_queue_depth",
+            lambda feed: feed.batcher.size(),
+            owner=self,
+            help="documents buffered in the host batcher",
+            feed=feed_id,
+        )
+        telemetry.gauge_fn(
+            "astpu_feed_arena_used_bytes",
+            lambda feed: feed.batcher.arena_used(),
+            owner=self,
+            help="host batcher arena occupancy",
+            feed=feed_id,
+        )
+        telemetry.gauge_fn(
+            "astpu_feed_rejected_pushes",
+            lambda feed: feed.batcher.stats()["rejected"],
+            owner=self,
+            help="pushes rejected by doc/arena backpressure",
+            feed=feed_id,
+        )
+        telemetry.gauge_fn(
+            "astpu_feed_staged_depth",
+            lambda feed: feed._out.qsize(),
+            owner=self,
+            help="device-staged tiles awaiting the consumer",
+            feed=feed_id,
+        )
+
+    def summary(self) -> dict:
+        """Rolling per-tile step latency/throughput (``StepTimer.summary``)."""
+        return self.timer.summary()
+
     def _put_device(self, arr: np.ndarray, spec=None):
         if self.sharding is not None and spec is not None:
             return self._jax.device_put(arr, spec)
@@ -112,10 +180,13 @@ class DeviceFeed:
         tok_spec = len_spec = None
         if self.sharding is not None:
             tok_spec, len_spec = self.sharding
-        from advanced_scrapper_tpu.obs import stages
+        import time as _time
+
+        from advanced_scrapper_tpu.obs import stages, trace
 
         try:
             while self._error is None:  # a peer's death stops this worker too
+                t0 = _time.perf_counter()
                 # host tile assembly (pop+memcpy); a slow producer's waits
                 # land here too — "the host couldn't feed the device" is
                 # exactly what this stage exists to expose
@@ -135,6 +206,23 @@ class DeviceFeed:
                     t_dev = self._put_device(tok, tok_spec)
                     l_dev = self._put_device(lens, len_spec)
                 self._out.put((n, t_dev, l_dev, tags))
+                self.timer.add(_time.perf_counter() - t0, n)
+                self._m_batches.inc()
+                self._m_docs.inc(n)
+                self._m_fill.set(n / self.batch_size)
+                if n < self.batch_size:
+                    self._m_partial.inc()
+                if trace.RECORDER.active:
+                    # the ingest end of the span chain: the first tag names
+                    # the batch, so a dump ties "what was staging" to the
+                    # kernel/resolve spans downstream
+                    trace.record(
+                        "span",
+                        "feed.stage",
+                        batch=int(tags[0]),
+                        rows=n,
+                        dur_ms=round((_time.perf_counter() - t0) * 1e3, 3),
+                    )
         except BaseException as e:  # a dying feed thread must not hang the
             with self._exit_lock:    # consumer: deliver the FIRST error,
                 if self._error is None:  # then the sentinel, and re-raise

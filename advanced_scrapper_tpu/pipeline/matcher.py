@@ -622,6 +622,16 @@ def match_chunk_async(
         ctrl = getattr(index, "refine_controller", None)
         use_refine = ctrl.verdict() if ctrl is not None else False
 
+    from advanced_scrapper_tpu.obs import telemetry, trace
+
+    m_articles = telemetry.counter(
+        "astpu_matcher_articles_total", "articles entering the matcher"
+    )
+    m_matches = telemetry.counter(
+        "astpu_matcher_matches_total", "(ticker, article) matches produced"
+    )
+    tid = trace.new_trace_id()
+
     rows = []
     # plain dicts, not Series: ~100 µs/row cheaper to build, identical
     # mapping access in _get_col, and far cheaper to pickle to pool workers
@@ -635,6 +645,7 @@ def match_chunk_async(
             adate = None
         rows.append((text, title, adate, row))
 
+    m_articles.inc(len(rows))
     masks: list[np.ndarray | None] = [None] * len(rows)
     text_prunes: list[set | None] = [None] * len(rows)
     if use_screen and index.entries:
@@ -645,6 +656,7 @@ def match_chunk_async(
         fuzzy_ix, fuzzy_names, mask_tables = (
             _refine_candidates(index) if use_refine else (np.array([]), [], None)
         )
+        t_screen = time.perf_counter()
         for start in range(0, len(rows), screen_batch):
             batch = rows[start : start + screen_batch]
             # bitmap over title+text; part lengths drive the soundness bounds
@@ -682,6 +694,14 @@ def match_chunk_async(
                 )
                 for i, pr in enumerate(prunes):
                     text_prunes[start + i] = pr
+        if trace.RECORDER.active:
+            trace.record(
+                "span",
+                "matcher.screen",
+                trace=tid,
+                articles=len(rows),
+                dur_ms=round((time.perf_counter() - t_screen) * 1e3, 3),
+            )
 
     if pool is not None and len(rows) > 1:
         # ship (text, title, date, row-INDEX) out; the full row record stays
@@ -700,8 +720,12 @@ def match_chunk_async(
 
         def collect():
             out = []
-            for f in futures:  # slice order == row order
-                out.extend((ticker, m, rows[i][3]) for ticker, m, i in f.result())
+            with trace.span("matcher.verify", trace=tid, articles=len(rows)):
+                for f in futures:  # slice order == row order
+                    out.extend(
+                        (ticker, m, rows[i][3]) for ticker, m, i in f.result()
+                    )
+            m_matches.inc(len(out))
             return out
 
         collect.futures = futures  # introspectable: the in-flight slices
@@ -709,10 +733,16 @@ def match_chunk_async(
 
     def collect():
         out = []
-        for (text, title, adate, row), mask, pruned in zip(rows, masks, text_prunes):
-            matches = match_article(text, title, adate, index, mask, threshold, pruned)
-            for ticker, m in matches.items():
-                out.append((ticker, m, row))
+        with trace.span("matcher.verify", trace=tid, articles=len(rows)):
+            for (text, title, adate, row), mask, pruned in zip(
+                rows, masks, text_prunes
+            ):
+                matches = match_article(
+                    text, title, adate, index, mask, threshold, pruned
+                )
+                for ticker, m in matches.items():
+                    out.append((ticker, m, row))
+        m_matches.inc(len(out))
         return out
 
     return collect

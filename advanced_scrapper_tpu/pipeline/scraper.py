@@ -76,9 +76,18 @@ class PauseController:
         self.trips = 0
 
     def trigger(self, duration: float) -> None:
+        from advanced_scrapper_tpu.obs import telemetry, trace
+
         with self._lock:
             self._until = max(self._until, self._clock() + duration)
             self.trips += 1
+        # a circuit-breaker trip is exactly the rare event the telemetry
+        # plane exists for: always counted, and on the flight recorder so
+        # a crash dump shows whether the fleet died paused
+        telemetry.event_counter(
+            "astpu_rate_limit_trips_total", "rate-limit circuit-breaker trips"
+        ).inc()
+        trace.record("event", "scraper.rate_limit_trip", wait_s=duration)
 
     def remaining(self) -> float:
         with self._lock:
@@ -127,6 +136,51 @@ class ScraperEngine:
         self.stats = StatsTracker(window=cfg.stats_time_window)
         self.pause = PauseController()
         self._stop = threading.Event()
+        self._bridge_stats()
+
+    _seq_lock = threading.Lock()
+    _seq = 0
+
+    def _bridge_stats(self) -> None:
+        """Bridge the engine-local :class:`StatsTracker` (and the pause
+        controller) into the process registry as scrape-time callback
+        gauges — the 10 Hz console line and ``/metrics`` now read the same
+        tracker.  Weakref'd on the engine: a finished run unregisters
+        itself; no hot-path cost (workers keep calling the tracker
+        directly)."""
+        from advanced_scrapper_tpu.obs import telemetry
+
+        with ScraperEngine._seq_lock:
+            eid = str(ScraperEngine._seq)
+            ScraperEngine._seq += 1
+        telemetry.gauge_fn(
+            "astpu_scraper_success_total",
+            lambda e: e.stats.get_cumulative_stats()[0],
+            owner=self,
+            help="cumulative successful fetches this run",
+            engine=eid,
+        )
+        telemetry.gauge_fn(
+            "astpu_scraper_fail_total",
+            lambda e: e.stats.get_cumulative_stats()[1],
+            owner=self,
+            help="cumulative failed fetches this run",
+            engine=eid,
+        )
+        telemetry.gauge_fn(
+            "astpu_scraper_request_rate",
+            lambda e: e.stats.get_actual_rate(),
+            owner=self,
+            help="requests/s over the stats window",
+            engine=eid,
+        )
+        telemetry.gauge_fn(
+            "astpu_scraper_pause_remaining_seconds",
+            lambda e: e.pause.remaining(),
+            owner=self,
+            help="rate-limit circuit-breaker countdown (0 = not paused)",
+            engine=eid,
+        )
 
     # -- worker ------------------------------------------------------------
 

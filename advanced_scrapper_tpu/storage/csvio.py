@@ -137,6 +137,24 @@ def repair_torn_tail(path: str, fs=None) -> int:
     repaired = _stat_sig(path)
     if repaired is not None:
         _clean_cache[os.path.abspath(path)] = repaired
+    # a torn tail IS a detected crash artifact — count it and put it on
+    # the flight recorder so restarts show their repair work on /metrics
+    from advanced_scrapper_tpu.obs import telemetry, trace
+
+    telemetry.event_counter(
+        "astpu_quarantine_total",
+        "crash artifacts quarantined, by kind",
+        kind="csv_torn_tail",
+    ).inc()
+    telemetry.event_counter(
+        "astpu_quarantine_bytes_total",
+        "bytes moved to quarantine sidecars",
+        kind="csv_torn_tail",
+    ).inc(len(torn))
+    trace.record(
+        "event", "quarantine.csv_torn_tail", path=os.path.basename(path),
+        bytes=len(torn),
+    )
     return len(torn)
 
 

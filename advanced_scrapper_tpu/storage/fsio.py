@@ -226,6 +226,17 @@ class ChaosFs:
                     with self._lock:
                         self.injected[kind] += 1
                         self.ledger.append((os.path.basename(path), op, kind))
+                    # fault counts belong on /metrics, not only in the
+                    # ledger object a test happens to hold (always-on:
+                    # injection is rare by construction)
+                    from advanced_scrapper_tpu.obs import telemetry
+
+                    telemetry.event_counter(
+                        "astpu_fault_injected_total",
+                        "chaos faults fired, by plane and kind",
+                        plane="fs",
+                        kind=kind,
+                    ).inc()
                     return kind
                 return None  # kind drawn but not applicable to this op
         return None
@@ -240,6 +251,16 @@ class ChaosFs:
         return self._rng(path, "prefix", n).randrange(1, total)
 
     def _die(self, path: str, op: str):
+        # last act before death: dump the flight recorder so the sweep
+        # harness can assert on what was in flight at the kill point
+        # (covers BOTH flavours — os._exit runs no cleanup handlers, and
+        # SimulatedCrash is a BaseException production code must not catch)
+        try:
+            from advanced_scrapper_tpu.obs import trace
+
+            trace.dump_on_fault(f"chaos-fs crash during {op} of {path}")
+        except Exception:
+            pass
         if self._on_crash is not None:
             self._on_crash()
         raise SimulatedCrash(f"injected crash during {op} of {path}")

@@ -26,6 +26,18 @@ Sweep knobs (env):
   ASTPU_BENCH_BATCH=N         uniform/stream batch size (default 65536)
   ASTPU_BENCH_FEED_WORKERS=N  DeviceFeed put threads for the stream regime
   ASTPU_DEDUP_PUT_WORKERS=N   ragged-path H2D put threads (config knob)
+
+Observability (the telemetry plane rides the bench):
+  --regime NAME               run one regime (uniform|ragged|stream|recall|
+                              exact|matcher) instead of the full battery;
+                              the JSON line carries only that regime's keys
+  ASTPU_TELEMETRY=1           serve live GET /metrics + /status for the
+                              whole run (port: ASTPU_METRICS_PORT, default
+                              ephemeral — address printed to stderr); the
+                              stage histograms behind stage_ms are the same
+                              numbers, by construction (obs/stages.py)
+  ASTPU_TRACE_DIR=DIR         wrap the measured regimes in
+                              jax.profiler.trace(DIR) (obs/profiler.xla_trace)
 """
 
 from __future__ import annotations
@@ -418,7 +430,9 @@ def _reexec_cpu_fallback() -> None:
     env["ASTPU_BENCH_PLATFORM_FALLBACK"] = "1"
     raise SystemExit(
         subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
+            # forward argv (--regime ...) so the fallback child measures
+            # the same selection the parent was asked for
+            [sys.executable, os.path.abspath(__file__), *sys.argv[1:]],
             env=env,
             timeout=3600,  # a CPU full run is slow but bounded; never hang
         ).returncode
@@ -484,11 +498,35 @@ def _jax_or_cpu_fallback(timeout_s: float = 240.0):
     _reexec_cpu_fallback()
 
 
-def main() -> None:
+REGIMES = ("uniform", "ragged", "stream", "recall", "exact", "matcher")
+
+
+def _parse_args(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="MinHash+LSH dedup throughput benchmark (one JSON line)"
+    )
+    p.add_argument(
+        "--regime",
+        default="all",
+        choices=("all",) + REGIMES,
+        help="run one regime instead of the full battery (the JSON line "
+        "then carries only that regime's keys)",
+    )
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> None:
+    args = _parse_args(argv)
+    want = set(REGIMES) if args.regime == "all" else {args.regime}
+
     jax, platform = _jax_or_cpu_fallback()
 
     from advanced_scrapper_tpu.core.hashing import make_params
     from advanced_scrapper_tpu.core.mesh import build_mesh
+    from advanced_scrapper_tpu.obs import telemetry, trace
+    from advanced_scrapper_tpu.obs.profiler import xla_trace
 
     params = make_params()
     # scan is the measured-fastest backend on v5e (oph: sort-bound, ~16×
@@ -505,6 +543,27 @@ def main() -> None:
         # names the stage instead of showing an unattributed traceback
         print(f"bench: {msg}", file=sys.stderr, flush=True)
 
+    # live observability for the run: /metrics + /status while regimes
+    # execute (tools/obs_top.py points here), flight-recorder sidecar on
+    # an uncaught death
+    if telemetry.enabled():
+        metrics_srv = telemetry.StatusServer(
+            port=int(os.environ.get("ASTPU_METRICS_PORT") or 0)
+        ).start()
+        note(
+            "telemetry: GET /metrics and /status live on "
+            f"http://{metrics_srv.host}:{metrics_srv.port}"
+        )
+        trace.install_excepthook()
+
+    out: dict = {
+        "metric": "minhash_lsh_dedup_articles_per_sec",
+        "platform": platform,
+        "unit": "articles/s",
+    }
+    if args.regime != "all":
+        out["regime"] = args.regime
+
     try:
         # device enumeration + mesh build dispatch against the tunnel too —
         # they must sit inside the death handler, not ahead of it
@@ -512,37 +571,65 @@ def main() -> None:
 
         mesh = build_mesh(len(jax.devices()), 1)
         note(f"platform={platform} devices={len(jax.devices())} batch={batch}")
-        uniform = _bench_uniform(jax, mesh, params, backend, batch, block)
-        note(f"uniform done: {uniform:.0f}/s")
-        # stage_ms: per-stage wall attribution over the two host-path
-        # regimes (ragged + stream; obs/stages.py on what the numbers
-        # mean), so the next PR can see where the remaining time goes
-        stages.reset()
-        ragged = _bench_ragged(1024 if quick else 8192)
-        note(f"ragged done: {ragged:.0f}/s")
-        stream = _bench_stream(jax, mesh, params, backend, batch, block, 2 if quick else 4)
-        note(f"stream done: {stream:.0f}/s")
-        stage_ms = {k: 0.0 for k in ("encode", "h2d", "kernel", "resolve")}
-        stage_ms.update(stages.snapshot_ms())
-        recall, recall_pairs, precision, precision_oracle, unchained = (
-            _bench_recall(64 if quick else 512)
-        )
-        note(
-            f"recall done: {recall:.4f} over {recall_pairs} pairs "
-            f"(precision {precision:.4f} vs oracle {precision_oracle:.4f}, "
-            f"unchained {unchained})"
-        )
-        exact, exact_vs_pandas, exact_ms, pandas_ms = _bench_exact(
-            16384 if quick else 262144
-        )
-        note(
-            f"exact done: {exact:.0f}/s ({exact_vs_pandas:.2f}x pandas; "
-            f"{exact_ms:.1f}ms vs {pandas_ms:.1f}ms)"
-        )
-        stages.reset()
-        matcher = _bench_matcher(256 if quick else 1024)
-        stage_ms["matcher_build"] = stages.snapshot_ms().get("matcher_build", 0.0)
-        note(f"matcher done: {matcher:.0f}/s")
+        with xla_trace(os.environ.get("ASTPU_TRACE_DIR") or None):
+            uniform = None
+            if "uniform" in want:
+                uniform = _bench_uniform(jax, mesh, params, backend, batch, block)
+                note(f"uniform done: {uniform:.0f}/s")
+                out["value"] = round(uniform, 1)
+                out["vs_baseline"] = round(uniform / 50000.0, 4)
+            # stage_ms: per-stage wall attribution over the two host-path
+            # regimes (ragged + stream; obs/stages.py on what the numbers
+            # mean), so the next PR can see where the remaining time goes
+            stages.reset()
+            if "ragged" in want:
+                ragged = _bench_ragged(1024 if quick else 8192)
+                note(f"ragged done: {ragged:.0f}/s")
+                out["ragged_articles_per_sec"] = round(ragged, 1)
+                out["ragged_vs_baseline"] = round(ragged / 50000.0, 4)
+            if "stream" in want:
+                stream = _bench_stream(
+                    jax, mesh, params, backend, batch, block, 2 if quick else 4
+                )
+                note(f"stream done: {stream:.0f}/s")
+                out["stream_articles_per_sec"] = round(stream, 1)
+                out["stream_vs_baseline"] = round(stream / 50000.0, 4)
+            stage_ms = {k: 0.0 for k in ("encode", "h2d", "kernel", "resolve")}
+            stage_ms.update(stages.snapshot_ms())
+            if "recall" in want:
+                recall, recall_pairs, precision, precision_oracle, unchained = (
+                    _bench_recall(64 if quick else 512)
+                )
+                note(
+                    f"recall done: {recall:.4f} over {recall_pairs} pairs "
+                    f"(precision {precision:.4f} vs oracle {precision_oracle:.4f}, "
+                    f"unchained {unchained})"
+                )
+                out["recall_vs_oracle"] = round(recall, 4)
+                out["recall_pairs"] = recall_pairs
+                out["precision_vs_oracle"] = round(precision, 4)
+                out["precision_oracle"] = round(precision_oracle, 4)
+                out["unchained_merges"] = unchained
+            if "exact" in want:
+                exact, exact_vs_pandas, exact_ms, pandas_ms = _bench_exact(
+                    16384 if quick else 262144
+                )
+                note(
+                    f"exact done: {exact:.0f}/s ({exact_vs_pandas:.2f}x pandas; "
+                    f"{exact_ms:.1f}ms vs {pandas_ms:.1f}ms)"
+                )
+                out["exact_urls_per_sec"] = round(exact, 1)
+                out["exact_vs_pandas"] = round(exact_vs_pandas, 3)
+                out["exact_ms"] = round(exact_ms, 2)
+                out["pandas_ms"] = round(pandas_ms, 2)
+            if "matcher" in want:
+                stages.reset()
+                matcher = _bench_matcher(256 if quick else 1024)
+                stage_ms["matcher_build"] = stages.snapshot_ms().get(
+                    "matcher_build", 0.0
+                )
+                note(f"matcher done: {matcher:.0f}/s")
+                out["matcher_articles_per_sec"] = round(matcher, 1)
     except Exception as e:
         # A tunnel that came up can still die between dispatches (it has).
         # Better one labeled cpu-fallback line than no round record at all.
@@ -556,39 +643,16 @@ def main() -> None:
             _reexec_cpu_fallback()
         raise
 
-    print(
-        json.dumps(
-            {
-                "metric": "minhash_lsh_dedup_articles_per_sec",
-                "platform": platform,
-                "value": round(uniform, 1),
-                "unit": "articles/s",
-                "vs_baseline": round(uniform / 50000.0, 4),
-                "ragged_articles_per_sec": round(ragged, 1),
-                "ragged_vs_baseline": round(ragged / 50000.0, 4),
-                "stream_articles_per_sec": round(stream, 1),
-                "stream_vs_baseline": round(stream / 50000.0, 4),
-                "recall_vs_oracle": round(recall, 4),
-                "recall_pairs": recall_pairs,
-                "precision_vs_oracle": round(precision, 4),
-                "precision_oracle": round(precision_oracle, 4),
-                "unchained_merges": unchained,
-                "exact_urls_per_sec": round(exact, 1),
-                "exact_vs_pandas": round(exact_vs_pandas, 3),
-                "exact_ms": round(exact_ms, 2),
-                "pandas_ms": round(pandas_ms, 2),
-                "matcher_articles_per_sec": round(matcher, 1),
-                "stage_ms": stage_ms,
-                # MFU-style utilisation is only meaningful against the v5e
-                # peak the constant describes — null on cpu-fallback rounds
-                **(
-                    _vpu_roofline(uniform, block, params)
-                    if platform not in ("cpu", "cpu-fallback")
-                    else {"vpu_util_nominal": None}
-                ),
-            }
+    out["stage_ms"] = stage_ms
+    if uniform is not None:
+        # MFU-style utilisation is only meaningful against the v5e peak the
+        # constant describes — null on cpu-fallback rounds
+        out.update(
+            _vpu_roofline(uniform, block, params)
+            if platform not in ("cpu", "cpu-fallback")
+            else {"vpu_util_nominal": None}
         )
-    )
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -55,6 +55,53 @@ def test_crashsweep_scrape_converges(tmp_path):
     _assert_sweep(report, min_kills=6)
 
 
+def test_flight_recorder_dumps_last_known_state_at_kill_point(tmp_path):
+    """The telemetry plane closes the loop with this harness: a chaos-fs
+    crash mid-persist dumps the flight recorder's ring to its JSONL
+    sidecar BEFORE the process dies (before ``on_crash`` — the hook that
+    becomes ``os._exit(73)`` under the forked-child env spec), so the
+    sweep can assert on what was in flight at the kill point."""
+    import json
+
+    from advanced_scrapper_tpu.obs import trace
+    from advanced_scrapper_tpu.storage.csvio import AppendCsv
+    from advanced_scrapper_tpu.storage.fsio import ChaosFs, OsFs, SimulatedCrash
+
+    dump = tmp_path / "flight.jsonl"
+    trace.set_enabled(True)
+    trace.RECORDER.clear()
+    trace.set_dump_path(str(dump))
+    try:
+        trace.record("event", "scrape.start", urls=3)
+        seen = {}
+        fs = ChaosFs(
+            OsFs(),
+            seed=11,
+            crash_rate=1.0,
+            only="success",
+            on_crash=lambda: seen.setdefault("dump_existed", dump.exists()),
+        )
+        try:
+            AppendCsv(str(tmp_path / "success.csv"), ["url"], fs=fs)
+        except SimulatedCrash:
+            pass
+        else:
+            raise AssertionError("chaos crash_rate=1.0 must fire on the header write")
+        # the sidecar existed BEFORE the death hook ran — an os._exit child
+        # would have left the same evidence
+        assert seen["dump_existed"] is True
+        lines = [json.loads(l) for l in dump.read_text().splitlines()]
+        assert lines[0]["kind"] == "dump"
+        assert "chaos-fs crash" in lines[0]["reason"]
+        names = [l["name"] for l in lines[1:]]
+        assert "scrape.start" in names, "pre-crash state must be in the dump"
+        assert "crash" in names, "the fault itself must be the last-known event"
+    finally:
+        trace.set_enabled(None)
+        trace.set_dump_path(None)
+        trace.RECORDER.clear()
+
+
 def test_crashsweep_stream_dedup_converges(tmp_path):
     """6 kill instants over the streaming dedup: the npz stream-index
     checkpoint is whole-or-absent at every kill point and each doc is

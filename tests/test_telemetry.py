@@ -1,0 +1,671 @@
+"""Telemetry plane: registry correctness, exporter formats, thread safety,
+disabled-mode cost, the stages view, the flight recorder, and the
+fault/quarantine event counters — the observability layer every pipeline
+stage now feeds (``obs/telemetry.py``, ``obs/trace.py``)."""
+
+from __future__ import annotations
+
+import gc
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from advanced_scrapper_tpu.obs import stages, telemetry, trace
+from advanced_scrapper_tpu.obs.telemetry import (
+    BUCKET_BOUNDS,
+    NOOP,
+    Registry,
+    StatusServer,
+)
+
+
+@pytest.fixture()
+def global_telemetry():
+    """Enable the PROCESS registry + recorder for a test, restoring the
+    env-resolved defaults (and clearing accumulated series) afterwards so
+    tier-1 neighbours never see leaked state."""
+    telemetry.REGISTRY.reset()
+    stages._clear_for_tests()
+    telemetry.set_enabled(True)
+    trace.set_enabled(True)
+    trace.RECORDER.clear()
+    trace.set_dump_path(None)
+    yield telemetry
+    telemetry.REGISTRY.reset()
+    stages._clear_for_tests()
+    telemetry.set_enabled(None)
+    trace.set_enabled(None)
+    trace.RECORDER.clear()
+    trace.set_dump_path(None)
+
+
+# -- exporter format ---------------------------------------------------------
+
+
+def test_prometheus_text_golden():
+    """Pin the exposition format byte-for-byte on a small fixed registry —
+    scrapers parse this text; drift is a breaking change."""
+    r = Registry(enabled=True)
+    c = r.counter("astpu_t_total", "things counted", plane="fs")
+    c.inc()
+    c.inc(3)
+    g = r.gauge("astpu_t_depth")
+    g.set(7)
+    h = r.histogram("astpu_t_seconds", stage="encode")
+    h.observe(0.0015)  # → le="0.001953125"
+    h.observe(3.0)     # → le="4"
+    text = r.prometheus_text()
+
+    expected_scalar_lines = [
+        "# TYPE astpu_t_depth gauge",
+        "astpu_t_depth 7",
+        "# HELP astpu_t_total things counted",
+        "# TYPE astpu_t_total counter",
+        'astpu_t_total{plane="fs"} 4',
+        "# TYPE astpu_t_seconds histogram",
+        'astpu_t_seconds_bucket{le="0.001953125",stage="encode"} 1',
+        'astpu_t_seconds_bucket{le="4",stage="encode"} 2',
+        'astpu_t_seconds_bucket{le="+Inf",stage="encode"} 2',
+        'astpu_t_seconds_sum{stage="encode"} 3.0015',
+        'astpu_t_seconds_count{stage="encode"} 2',
+    ]
+    lines = text.splitlines()
+    for want in expected_scalar_lines:
+        assert want in lines, f"missing/changed line: {want!r}"
+    # cumulative bucket monotonicity across the full ladder
+    cums = [
+        int(line.rsplit(" ", 1)[1])
+        for line in lines
+        if line.startswith("astpu_t_seconds_bucket")
+    ]
+    assert len(cums) == len(BUCKET_BOUNDS) + 1
+    assert cums == sorted(cums) and cums[-1] == 2
+    assert text.endswith("\n")
+
+
+def test_status_json_shape():
+    r = Registry(enabled=True)
+    r.counter("astpu_t_total").inc(2)
+    h = r.histogram("astpu_t_seconds")
+    h.observe(0.01)
+    s = r.status()
+    assert {"ts", "pid", "metrics"} <= set(s)
+    by_name = {m["name"]: m for m in s["metrics"]}
+    assert by_name["astpu_t_total"]["value"] == 2
+    hist = by_name["astpu_t_seconds"]
+    assert hist["count"] == 1 and {"p50_ms", "p95_ms", "p99_ms"} <= set(hist)
+    json.dumps(s)  # must be JSON-able as-is
+
+
+def test_histogram_percentiles_land_in_bucket():
+    h = telemetry.Histogram("h", {})
+    for _ in range(100):
+        h.observe(0.003)  # bucket (0.001953, 0.00390625]
+    for q in (0.5, 0.95, 0.99):
+        assert 0.001953125 <= h.percentile(q) <= 0.00390625
+    assert h.percentiles_ms()["p50_ms"] < 4.0
+
+
+def test_histogram_exact_powers_of_two_bucket():
+    h = telemetry.Histogram("h", {})
+    h.observe(0.25)  # exactly 2⁻² must land in the le="0.25" bucket
+    buckets, _s, _c = h.state()
+    assert buckets[BUCKET_BOUNDS.index(0.25)] == 1
+
+
+def test_counter_gauge_histogram_concurrent_writers():
+    """8 writers hammer one handle of each kind: totals must be exact
+    (the thread-safety contract behind every hot-path metric)."""
+    r = Registry(enabled=True)
+    c = r.counter("c_total")
+    g = r.gauge("g")
+    h = r.histogram("h_seconds")
+    N, T = 5000, 8
+
+    def work():
+        for _ in range(N):
+            c.inc()
+            g.inc(2)
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == N * T
+    assert g.value == 2 * N * T
+    assert h.count == N * T
+    assert h.sum == pytest.approx(0.001 * N * T)
+    buckets, _s, count = h.state()
+    assert sum(buckets) == count == N * T
+
+
+def test_same_name_labels_returns_same_handle():
+    r = Registry(enabled=True)
+    a = r.counter("x_total", shard="0")
+    b = r.counter("x_total", shard="0")
+    other = r.counter("x_total", shard="1")
+    a.inc()
+    b.inc()
+    assert a is b and a.value == 2 and other.value == 0
+
+
+# -- disabled mode / overhead regression ------------------------------------
+
+
+def test_disabled_registry_hands_out_shared_noops():
+    """Disabled telemetry must cost nothing structural: every factory
+    returns THE no-op singleton (no lock, no allocation, no registration)
+    and callback gauges register nothing — the guard against accidental
+    always-on locking in per-batch paths."""
+    r = Registry(enabled=False)
+    assert r.counter("a") is NOOP
+    assert r.gauge("b") is NOOP
+    assert r.histogram("c") is NOOP
+    assert not hasattr(NOOP, "_lock")
+    r.gauge_fn("d", lambda: 1)
+    assert r._callbacks == {} and r._metrics == {}
+    # always-on families bypass the gate (stage timing, rare-event counts)
+    assert isinstance(r.histogram("s", always=True), telemetry.Histogram)
+    assert isinstance(r.counter("e", always=True), telemetry.Counter)
+
+
+def test_disabled_hot_path_overhead_regression():
+    """The disabled per-batch path is bare no-op method calls; a generous
+    absolute ceiling (50ns/op-scale work given 100× headroom) so a future
+    'small' addition of locking/allocation to the disabled path fails
+    loudly without making CI timing-flaky."""
+    r = Registry(enabled=False)
+    c = r.counter("hot_total")
+    h = r.histogram("hot_seconds")
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        c.inc()
+        h.observe(0.001)
+    dt = time.perf_counter() - t0
+    assert dt < 2.0, f"disabled-telemetry hot path took {dt:.3f}s for {n} batches"
+
+
+def test_instrumented_layers_get_noops_when_disabled(global_telemetry):
+    """DeviceFeed / NearDupEngine built under disabled telemetry must hold
+    no-op handles — their per-batch loops then do zero metric work."""
+    telemetry.set_enabled(False)
+    from advanced_scrapper_tpu.cpu.hostbatch import HostBatcher
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+    from advanced_scrapper_tpu.pipeline.feed import DeviceFeed
+
+    feed = DeviceFeed(HostBatcher(64, prefer_native=False), 8)
+    assert feed._m_batches is NOOP and feed._m_docs is NOOP
+    assert feed._m_partial is NOOP and feed._m_fill is NOOP
+    eng = NearDupEngine()
+    assert eng._m_batches is NOOP and eng._m_cand is NOOP
+    assert telemetry.REGISTRY._callbacks == {}
+    feed.batcher.close()
+    feed.join()
+
+
+# -- callback gauges ---------------------------------------------------------
+
+
+def test_gauge_fn_weakref_owner_cleanup():
+    r = Registry(enabled=True)
+
+    class Owner:
+        depth = 5
+
+    o = Owner()
+    r.gauge_fn("astpu_depth", lambda owner: owner.depth, owner=o)
+    assert "astpu_depth 5" in r.prometheus_text()
+    del o
+    gc.collect()
+    assert "astpu_depth" not in r.prometheus_text()
+
+
+def test_gauge_fn_expand_fans_out_series():
+    r = Registry(enabled=True)
+
+    class Fleet:
+        assigned = {3: 7, 1: 2}
+
+    f = Fleet()
+    r.gauge_fn(
+        "astpu_assigned", lambda o: o.assigned, owner=f, expand="client"
+    )
+    text = r.prometheus_text()
+    assert 'astpu_assigned{client="1"} 2' in text
+    assert 'astpu_assigned{client="3"} 7' in text
+
+
+def test_gauge_fn_errors_are_skipped_not_fatal():
+    r = Registry(enabled=True)
+
+    class Owner:
+        pass
+
+    o = Owner()
+    r.gauge_fn("astpu_bad", lambda owner: 1 / 0, owner=o)
+    assert "astpu_bad" not in r.prometheus_text()  # skipped, no raise
+
+
+# -- stages as a view over the registry --------------------------------------
+
+
+def test_stages_snapshot_is_registry_backed(global_telemetry):
+    """bench stage_ms and the live stage series must be the same numbers:
+    snapshot_ms == (histogram sum − reset baseline), and the series shows
+    on /metrics with its full distribution."""
+    stages.reset()
+    stages.add("encode", 0.040)
+    stages.add("encode", 0.010)
+    stages.add("kernel", 0.025)
+    snap = stages.snapshot_ms()
+    assert snap["encode"] == 50.0 and snap["kernel"] == 25.0
+    h = telemetry.stage_histogram("encode")
+    assert h.sum >= 0.050 and h.count >= 2
+    text = telemetry.REGISTRY.prometheus_text()
+    assert 'astpu_stage_seconds_count{stage="encode"}' in text
+    # a second window starts from the new baseline, leaving the live
+    # (cumulative) series untouched
+    stages.reset()
+    assert stages.snapshot_ms()["encode"] == 0.0
+    stages.add("encode", 0.002)
+    assert stages.snapshot_ms()["encode"] == 2.0
+    assert telemetry.stage_histogram("encode").count >= 3
+
+
+def test_stage_totals_agree_with_live_metrics_within_tolerance(global_telemetry):
+    """The acceptance-shaped check: run a real (tiny) ragged dedup, then
+    compare the bench-style stage_ms window against the live histogram
+    sums — one source of truth means exact agreement, asserted at the
+    criterion's 5%."""
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    stages.reset()
+    base = {
+        h.labels["stage"]: h.sum for h in telemetry.stage_histograms()
+    }
+    texts = [f"document number {i} with some repeated prose " * 8 for i in range(48)]
+    NearDupEngine().dedup_reps(texts)
+    snap = stages.snapshot_ms()
+    live = {
+        h.labels["stage"]: (h.sum - base.get(h.labels["stage"], 0.0)) * 1e3
+        for h in telemetry.stage_histograms()
+    }
+    for stage in ("encode", "kernel", "resolve"):
+        assert snap[stage] == pytest.approx(live[stage], rel=0.05, abs=0.1), stage
+
+
+# -- export over the real control server -------------------------------------
+
+
+def test_metrics_and_status_roundtrip_over_control_server(
+    global_telemetry, tmp_path
+):
+    from advanced_scrapper_tpu.net.control import ControlPlane, ControlServer
+    from advanced_scrapper_tpu.net.transport import MockTransport
+
+    telemetry.counter("astpu_rt_total", "roundtrip probe").inc(5)
+    stages.add("encode", 0.02)
+    plane = ControlPlane(
+        lambda: MockTransport(lambda u: "<html></html>"),
+        templates_path=str(tmp_path / "t.json"),
+        out_root=str(tmp_path),
+    )
+    srv = ControlServer(plane).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/metrics") as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        assert "astpu_rt_total 5" in text.splitlines()
+        assert 'astpu_stage_seconds_count{stage="encode"}' in text
+        assert "astpu_process_max_rss_bytes" in text
+        with urllib.request.urlopen(base + "/status") as r:
+            st = json.loads(r.read())
+        by_name = {m["name"]: m for m in st["metrics"] if not m["labels"]}
+        assert by_name["astpu_rt_total"]["value"] == 5
+        assert st["control"]["templates"] == []
+        # unknown endpoints still 404 (the observability pair must not
+        # shadow the extraction API's error paths)
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(base + "/nope")
+    finally:
+        srv.stop()
+
+
+def test_status_server_standalone(global_telemetry):
+    telemetry.counter("astpu_sa_total").inc()
+    srv = StatusServer(port=0, extra_status=lambda: {"extra": {"k": 1}}).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        text = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "astpu_sa_total 1" in text
+        st = json.loads(urllib.request.urlopen(base + "/status").read())
+        assert st["extra"] == {"k": 1}
+    finally:
+        srv.stop()
+
+
+def test_lease_server_mirrors_status_endpoints(global_telemetry):
+    from advanced_scrapper_tpu.config import FeedConfig
+    from advanced_scrapper_tpu.net.lease import LeaseServer
+
+    srv = LeaseServer(
+        FeedConfig(), ["http://a/1", "http://b/2"], host="127.0.0.1",
+        port=0, status_port=0,
+    ).start()
+    try:
+        assert srv.status_server is not None
+        base = f"http://127.0.0.1:{srv.status_server.port}"
+        st = json.loads(urllib.request.urlopen(base + "/status").read())
+        assert st["lease"]["pending"] == 2
+        text = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert any(
+            line.startswith("astpu_lease_pending{server=") and line.endswith(" 2")
+            for line in text.splitlines()
+        )
+    finally:
+        srv.stop()
+    assert srv.status_server is None
+
+
+def test_lease_explicit_status_port_forces_instrumentation():
+    """An operator who explicitly asked for the mirror (status_port=) must
+    get the lease series even with ASTPU_TELEMETRY off — a silently empty
+    /metrics would betray the request."""
+    from advanced_scrapper_tpu.config import FeedConfig
+    from advanced_scrapper_tpu.net.lease import LeaseServer
+
+    telemetry.set_enabled(False)
+    srv = None
+    try:
+        srv = LeaseServer(
+            FeedConfig(), ["http://a/1"], host="127.0.0.1", port=0,
+            status_port=0,
+        ).start()
+        assert srv._m_leased is not telemetry.NOOP
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.status_server.port}/metrics"
+        ).read().decode()
+        assert any(
+            line.startswith("astpu_lease_pending{server=") and line.endswith(" 1")
+            for line in text.splitlines()
+        )
+    finally:
+        if srv is not None:
+            srv.stop()
+        telemetry.set_enabled(None)
+        telemetry.REGISTRY.reset()
+        stages._clear_for_tests()
+
+
+def test_lease_fleet_counters_over_real_protocol(global_telemetry):
+    """Drive the NDJSON protocol directly: lease → result → stray result;
+    the counters and per-client gauges must track the ledger."""
+    from advanced_scrapper_tpu.config import FeedConfig
+    from advanced_scrapper_tpu.net.lease import LeaseServer
+
+    srv = LeaseServer(
+        FeedConfig(), ["http://a/1", "http://b/2"], host="127.0.0.1", port=0
+    ).start()
+    try:
+        s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+        f = s.makefile("rwb")
+
+        def send(obj):
+            f.write((json.dumps(obj) + "\n").encode())
+            f.flush()
+
+        send({"type": "request_tasks", "num_urls": 2})
+        batch = json.loads(f.readline())
+        assert len(batch["urls"]) == 2
+        assert srv._m_leased.value == 2
+        # per-client gauge fans out by ledger (labels: client + server id)
+        text = telemetry.REGISTRY.prometheus_text()
+        assert any(
+            line.startswith('astpu_lease_assigned{client="0"')
+            and line.endswith(" 2")
+            for line in text.splitlines()
+        )
+        send({"type": "result", "url": batch["urls"][0], "html_content": "x"})
+        send({"type": "result", "url": "http://stray", "html_content": "y"})
+        send({"type": "tasks_completed"})
+        assert json.loads(f.readline())["type"] == "acknowledge_completion"
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and srv._m_stray.value < 1:
+            time.sleep(0.01)
+        assert srv._m_results.value == 1
+        assert srv._m_stray.value == 1
+        s.close()
+        # disconnect with one url still held → requeue counter
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and srv._m_requeued.value < 1:
+            time.sleep(0.01)
+        assert srv._m_requeued.value == 1
+    finally:
+        srv.stop()
+
+
+# -- layer bridges -----------------------------------------------------------
+
+
+def test_device_feed_metrics_and_step_timer(global_telemetry):
+    from advanced_scrapper_tpu.cpu.hostbatch import HostBatcher
+    from advanced_scrapper_tpu.pipeline.feed import DeviceFeed
+
+    b = HostBatcher(32, prefer_native=False)
+    feed = DeviceFeed(b, 8, min_fill=1, workers=1)
+    for i in range(5):  # one partial tile (5 < 8)
+        b.push(b"doc" * 4, i)
+    b.close()
+    total = sum(n for n, *_ in feed)
+    feed.join()
+    assert total == 5
+    assert feed._m_docs.value == 5
+    assert feed._m_partial.value >= 1
+    assert feed.summary()["steps"] >= 1
+    text = telemetry.REGISTRY.prometheus_text()
+    assert "astpu_feed_docs_total 5" in text
+    assert "astpu_feed_queue_depth" in text  # callback gauge while alive
+
+
+def test_scraper_stats_bridge(global_telemetry):
+    from advanced_scrapper_tpu.config import ScraperConfig
+    from advanced_scrapper_tpu.pipeline.scraper import ScraperEngine
+
+    eng = ScraperEngine(
+        ScraperConfig(), lambda soup: {}, lambda: None
+    )
+    eng.stats.record_success()
+    eng.stats.record_success()
+    eng.stats.record_fail()
+    text = telemetry.REGISTRY.prometheus_text()
+    assert any(
+        line.startswith("astpu_scraper_success_total") and line.endswith(" 2")
+        for line in text.splitlines()
+    )
+    assert any(
+        line.startswith("astpu_scraper_fail_total") and line.endswith(" 1")
+        for line in text.splitlines()
+    )
+    eng.pause.trigger(10.0)
+    assert telemetry.event_counter("astpu_rate_limit_trips_total").value >= 1
+    assert any(
+        line.startswith("astpu_scraper_pause_remaining_seconds")
+        and not line.endswith(" 0")
+        for line in telemetry.REGISTRY.prometheus_text().splitlines()
+    )
+
+
+def test_stream_backend_bridge(global_telemetry):
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+
+    backend = TpuBatchBackend(DedupConfig(batch_size=64))
+    backend.submit({"url": "http://a", "article": "text " * 10})
+    backend.submit({"url": "http://b", "article": "other " * 10})
+    # a SECOND live backend must not replace the first's series
+    backend2 = TpuBatchBackend(DedupConfig(batch_size=64))
+    backend2.submit({"url": "http://c", "article": "more " * 10})
+    text = telemetry.REGISTRY.prometheus_text()
+    submitted = [
+        line for line in text.splitlines()
+        if line.startswith("astpu_stream_submitted{stream=")
+    ]
+    assert sorted(line.rsplit(" ", 1)[1] for line in submitted) == ["1", "2"]
+    assert any(
+        line.startswith("astpu_stream_buffered{stream=") and line.endswith(" 2")
+        for line in text.splitlines()
+    )
+
+
+def test_dedup_counters_and_ratio(global_telemetry):
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    eng = NearDupEngine()
+    texts = [f"unique document {i} " * 20 for i in range(15)]
+    texts.append(texts[0])  # one planted dup
+    eng.dedup_reps(texts)
+    assert eng._m_docs["oneshot"].value == 16
+    assert eng._m_dups["oneshot"].value >= 1
+    assert 0 < eng._m_ratio["oneshot"].value < 1
+    assert eng._m_batches.value >= 1
+    assert eng.step_summary()["steps"] >= 1
+
+
+# -- fault / quarantine event counters (always-on) ---------------------------
+
+
+def test_torn_tail_quarantine_counts_even_when_disabled(tmp_path):
+    """Quarantine counters are ALWAYS-on events: visible on /metrics later
+    even if telemetry was off when the repair ran."""
+    from advanced_scrapper_tpu.storage.csvio import repair_torn_tail
+
+    telemetry.set_enabled(False)
+    try:
+        before = telemetry.event_counter(
+            "astpu_quarantine_total", kind="csv_torn_tail"
+        ).value
+        p = tmp_path / "articles.csv"
+        p.write_bytes(b"url\nhttp://a\nhttp://b,TORN-NO-NEWLINE")
+        torn = repair_torn_tail(str(p))
+        assert torn > 0
+        after = telemetry.event_counter(
+            "astpu_quarantine_total", kind="csv_torn_tail"
+        ).value
+        assert after == before + 1
+    finally:
+        telemetry.set_enabled(None)
+
+
+def test_chaos_fs_faults_counted_and_flight_recorder_dumped(
+    global_telemetry, tmp_path
+):
+    from advanced_scrapper_tpu.storage.fsio import ChaosFs, OsFs, SimulatedCrash
+
+    dump = tmp_path / "flight.jsonl"
+    trace.set_dump_path(str(dump))
+    trace.record("event", "workload.start", docs=3)
+    fs = ChaosFs(OsFs(), seed=3, crash_rate=1.0)
+    with pytest.raises(SimulatedCrash):
+        with fs.open(str(tmp_path / "out.bin"), "wb") as fh:
+            fh.write(b"payload-bytes")
+    c = telemetry.event_counter(
+        "astpu_fault_injected_total", plane="fs", kind="crash"
+    )
+    assert c.value >= 1
+    lines = [json.loads(l) for l in dump.read_text().splitlines()]
+    assert lines[0]["kind"] == "dump" and "chaos-fs crash" in lines[0]["reason"]
+    names = [l["name"] for l in lines[1:]]
+    assert "workload.start" in names and "crash" in names
+
+
+def test_chaos_socket_faults_counted(global_telemetry):
+    from advanced_scrapper_tpu.net.chaos import ChaosSocket
+
+    a, b = socket.socketpair()
+    try:
+        cs = ChaosSocket(a, seed=1, fragment_rate=1.0)
+        b.sendall(b"hello-world")
+        got = cs.recv(65536)
+        assert 0 < len(got) <= 5  # fragmented read
+        c = telemetry.event_counter(
+            "astpu_fault_injected_total", plane="socket", kind="fragment"
+        )
+        assert c.value >= 1
+    finally:
+        a.close()
+        b.close()
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+def test_flight_recorder_ring_spans_and_bounded_capacity(tmp_path):
+    rec = trace.FlightRecorder(capacity=4)
+    rec.set_active(True)
+    for i in range(10):
+        rec.record("event", f"e{i}")
+    snap = rec.snapshot()
+    assert len(snap) == 4 and snap[-1]["name"] == "e9"  # ring, newest kept
+    with rec.span("stage.kernel", trace="t-1", batch=7):
+        time.sleep(0.002)
+    last = rec.snapshot()[-1]
+    assert last["kind"] == "span" and last["name"] == "stage.kernel"
+    assert last["trace"] == "t-1" and last["batch"] == 7
+    assert last["dur_ms"] >= 1.0
+    with pytest.raises(ValueError):
+        with rec.span("stage.fail"):
+            raise ValueError("boom")
+    assert "ValueError: boom" in rec.snapshot()[-1]["error"]
+    # dump is idempotent-on-fault but explicit dump always appends
+    p = tmp_path / "fr.jsonl"
+    assert rec.dump(str(p), reason="manual") == str(p)
+    lines = [json.loads(l) for l in p.read_text().splitlines()]
+    assert lines[0]["kind"] == "dump" and lines[0]["events"] == 4
+
+
+def test_flight_recorder_inactive_records_nothing():
+    rec = trace.FlightRecorder()
+    rec.set_active(False)
+    rec.record("event", "x")
+    with rec.span("y"):
+        pass
+    assert rec.snapshot() == []
+    assert rec.dump_on_fault("dead") is None
+
+
+def test_dump_on_fault_fires_once_per_death(tmp_path):
+    rec = trace.FlightRecorder()
+    rec.set_active(True)
+    rec.set_dump_path(str(tmp_path / "fr.jsonl"))
+    rec.record("event", "pre")
+    assert rec.dump_on_fault("first") is not None
+    assert rec.dump_on_fault("second") is None  # one dump per death
+    headers = [
+        json.loads(l)
+        for l in (tmp_path / "fr.jsonl").read_text().splitlines()
+        if json.loads(l)["kind"] == "dump"
+    ]
+    assert len(headers) == 1
+
+
+def test_trace_ids_flow_across_pipeline_spans(global_telemetry):
+    """One dedup corpus → every stage span carries the same trace id, so a
+    crash dump can reconstruct the batch's path end to end."""
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    trace.RECORDER.clear()
+    NearDupEngine().dedup_reps([f"document {i} " * 30 for i in range(12)])
+    spans = [e for e in trace.RECORDER.snapshot() if e["kind"] == "span"]
+    names = {e["name"] for e in spans}
+    assert {"dedup.encode", "dedup.dispatch", "dedup.candidates"} <= names
+    tids = {e.get("trace") for e in spans if e["name"].startswith("dedup.")}
+    assert len(tids) == 1  # the id flowed, not one per stage

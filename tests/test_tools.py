@@ -182,3 +182,61 @@ def test_profile_hostpath_smoke(capsys):
     out = capsys.readouterr().out
     assert "hostpath ragged 64 articles" in out
     assert "encode=" in out and "kernel=" in out and "articles/s warm" in out
+
+
+def test_obs_top_once_smoke(capsys):
+    """obs_top --once against a live StatusServer: one full frame with the
+    stage table, gauges and counters rendered."""
+    import obs_top
+
+    from advanced_scrapper_tpu.obs import stages, telemetry
+
+    telemetry.REGISTRY.reset()
+    stages._clear_for_tests()
+    telemetry.set_enabled(True)
+    srv = None
+    try:
+        stages.add("encode", 0.05)
+        stages.add("kernel", 0.02)
+        telemetry.event_counter(
+            "astpu_quarantine_total", kind="csv_torn_tail"
+        ).inc()
+        srv = telemetry.StatusServer(port=0).start()
+        rc = obs_top.main(["--url", f"http://127.0.0.1:{srv.port}", "--once"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "obs_top @" in out
+        assert "encode" in out and "kernel" in out and "p95_ms" in out
+        assert "astpu_quarantine_total{kind=csv_torn_tail}" in out
+        assert "astpu_process_max_rss_bytes" in out
+    finally:
+        if srv is not None:
+            srv.stop()
+        telemetry.REGISTRY.reset()
+        stages._clear_for_tests()
+        telemetry.set_enabled(None)
+
+
+def test_obs_top_once_unreachable_exits_nonzero(capsys):
+    import obs_top
+
+    rc = obs_top.main(["--url", "http://127.0.0.1:1", "--once"])
+    assert rc == 1
+    assert "cannot reach" in capsys.readouterr().err
+
+
+def test_bench_regime_selection_args():
+    """`bench.py --regime ragged` (the acceptance invocation) must parse,
+    and only known regimes are accepted."""
+    import bench
+
+    assert bench._parse_args([]).regime == "all"
+    assert bench._parse_args(["--regime", "ragged"]).regime == "ragged"
+    assert set(bench.REGIMES) == {
+        "uniform", "ragged", "stream", "recall", "exact", "matcher"
+    }
+    try:
+        bench._parse_args(["--regime", "nope"])
+        raise AssertionError("unknown regime must be rejected")
+    except SystemExit:
+        pass

@@ -1,0 +1,233 @@
+"""obs_top — live terminal dashboard over a telemetry status endpoint.
+
+Points at any process serving the observability pair (``GET /status`` +
+``GET /metrics``): the control plane (``net/control.py``), a lease server's
+mirror, or a bench run under ``ASTPU_TELEMETRY=1`` (which prints its
+endpoint address to stderr at start).
+
+Two modes:
+
+- ``--once``: fetch one ``/status`` snapshot and print the full frame
+  (per-stage latency table, queue/arena gauges, dedup + fleet counters) —
+  the scriptable/smoke-testable path.
+- live (default): the :class:`obs.console.ConsoleMux` idiom — a sticky
+  one-line summary repainted in place (per-stage rates computed from
+  successive histogram snapshots, queue depths, fleet health) with notable
+  transitions (fault injections, quarantines, rate-limit trips) scrolling
+  above it as colored event lines.
+
+Usage:
+  python tools/obs_top.py --url http://127.0.0.1:PORT [--interval 1.0]
+  python tools/obs_top.py --url ... --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+REPO_IMPORT_HINT = "advanced_scrapper_tpu"  # run from the repo root
+
+#: always-on counters whose increments are worth a scrolling event line
+WATCHED_EVENTS = (
+    "astpu_fault_injected_total",
+    "astpu_quarantine_total",
+    "astpu_rate_limit_trips_total",
+    "astpu_lease_urls_requeued_total",
+)
+
+
+def fetch_status(url: str, timeout: float = 5.0) -> dict:
+    with urllib.request.urlopen(url.rstrip("/") + "/status", timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _series_key(m: dict) -> str:
+    labels = m.get("labels") or {}
+    if not labels:
+        return m["name"]
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{m['name']}{{{inner}}}"
+
+
+def _index(status: dict) -> dict[str, dict]:
+    return {_series_key(m): m for m in status.get("metrics", [])}
+
+
+def render_frame(status: dict, prev: dict | None = None, dt: float = 0.0) -> list[str]:
+    """Full-frame snapshot: stage table, then gauges, then counters.
+
+    ``prev``/``dt`` (the previous snapshot and the seconds between them)
+    add a rate column to histograms and counters; omitted for --once.
+    """
+    lines: list[str] = []
+    idx = _index(status)
+    pidx = _index(prev) if prev else {}
+    ts = status.get("ts")
+    head = f"obs_top @ {time.strftime('%H:%M:%S', time.localtime(ts))}"
+    if "pid" in status:
+        head += f"  pid={status['pid']}"
+    lines.append(head)
+
+    stages = [
+        m for m in status.get("metrics", [])
+        if m["name"] == "astpu_stage_seconds"
+    ]
+    if stages:
+        lines.append("")
+        lines.append(
+            f"  {'stage':<14} {'count':>10} {'total_s':>10} "
+            f"{'p50_ms':>9} {'p95_ms':>9} {'p99_ms':>9} {'rate/s':>9}"
+        )
+        for m in sorted(stages, key=lambda m: m["labels"].get("stage", "")):
+            key = _series_key(m)
+            rate = ""
+            if key in pidx and dt > 0:
+                rate = f"{(m['count'] - pidx[key].get('count', 0)) / dt:.1f}"
+            lines.append(
+                f"  {m['labels'].get('stage', '?'):<14} {m['count']:>10} "
+                f"{m['sum']:>10.2f} {m.get('p50_ms', 0):>9.2f} "
+                f"{m.get('p95_ms', 0):>9.2f} {m.get('p99_ms', 0):>9.2f} "
+                f"{rate:>9}"
+            )
+
+    hists = [
+        m for m in status.get("metrics", [])
+        if m["kind"] == "histogram" and m["name"] != "astpu_stage_seconds"
+    ]
+    for m in hists:
+        lines.append(
+            f"  {_series_key(m):<44} n={m['count']} "
+            f"p50={m.get('p50_ms', 0):.2f}ms p95={m.get('p95_ms', 0):.2f}ms"
+        )
+
+    gauges = [m for m in status.get("metrics", []) if m["kind"] == "gauge"]
+    if gauges:
+        lines.append("")
+        lines.append("  gauges:")
+        for m in sorted(gauges, key=_series_key):
+            lines.append(f"    {_series_key(m):<48} {m['value']:.6g}")
+
+    counters = [m for m in status.get("metrics", []) if m["kind"] == "counter"]
+    if counters:
+        lines.append("")
+        lines.append("  counters:")
+        for m in sorted(counters, key=_series_key):
+            key = _series_key(m)
+            rate = ""
+            if key in pidx and dt > 0:
+                rate = f"  (+{(m['value'] - pidx[key].get('value', 0)) / dt:.1f}/s)"
+            lines.append(f"    {key:<48} {m['value']:.6g}{rate}")
+
+    for section in ("lease", "control"):
+        if section in status:
+            lines.append("")
+            lines.append(f"  {section}: {json.dumps(status[section])}")
+    return lines
+
+
+def summary_line(status: dict, prev: dict | None, dt: float) -> str:
+    """The sticky one-liner: per-stage rates + queue depth + fleet health."""
+    idx = _index(status)
+    pidx = _index(prev) if prev else {}
+
+    def rate_of(name: str, labels: str = "") -> float:
+        key = name + labels
+        m, p = idx.get(key), pidx.get(key)
+        if m is None or p is None or dt <= 0:
+            return 0.0
+        field = "count" if m.get("kind") == "histogram" else "value"
+        return (m.get(field, 0) - p.get(field, 0)) / dt
+
+    parts = []
+    for stage in ("encode", "h2d", "kernel", "resolve"):
+        r = rate_of("astpu_stage_seconds", f"{{stage={stage}}}")
+        if r:
+            parts.append(f"{stage} {r:.0f}/s")
+    depth = sum(
+        m["value"] for k, m in idx.items() if k.startswith("astpu_feed_queue_depth")
+    )
+    if depth:
+        parts.append(f"queue {depth:.0f}")
+    lease = status.get("lease")
+    if lease:
+        parts.append(
+            f"lease pending={lease.get('pending')} "
+            f"clients={len(lease.get('clients', {}))}"
+        )
+    docs = rate_of("astpu_feed_docs_total")
+    if docs:
+        parts.append(f"feed {docs:.0f} docs/s")
+    return " | ".join(parts) if parts else "(no activity yet)"
+
+
+def watch_events(status: dict, prev: dict | None) -> list[tuple[str, bool]]:
+    """``(message, is_bad)`` for every watched counter that moved."""
+    if prev is None:
+        return []
+    idx, pidx = _index(status), _index(prev)
+    out = []
+    for key, m in idx.items():
+        if m.get("kind") != "counter" or m["name"] not in WATCHED_EVENTS:
+            continue
+        delta = m["value"] - pidx.get(key, {}).get("value", 0)
+        if delta > 0:
+            out.append((f"{key} +{delta:.0f}", True))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--url", required=True, help="base url, e.g. http://127.0.0.1:9100")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--once", action="store_true", help="one frame, then exit")
+    ap.add_argument(
+        "--frames", type=int, default=0, help="stop after N polls (0 = forever)"
+    )
+    args = ap.parse_args(argv)
+
+    if args.once:
+        try:
+            status = fetch_status(args.url)
+        except OSError as e:
+            print(f"obs_top: cannot reach {args.url}: {e}", file=sys.stderr)
+            return 1
+        print("\n".join(render_frame(status)))
+        return 0
+
+    from advanced_scrapper_tpu.obs.console import ConsoleMux, green, red
+
+    mux = ConsoleMux().start()
+    prev = None
+    t_prev = 0.0
+    n = 0
+    try:
+        while True:
+            try:
+                status = fetch_status(args.url)
+            except OSError as e:
+                mux.stats(red(f"unreachable: {e}"))
+                time.sleep(args.interval)
+                continue
+            now = time.monotonic()
+            dt = now - t_prev if prev is not None else 0.0
+            for msg, bad in watch_events(status, prev):
+                mux.event(red(msg) if bad else green(msg))
+            mux.stats(summary_line(status, prev, dt))
+            prev, t_prev = status, now
+            n += 1
+            if args.frames and n >= args.frames:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        mux.stop()
+        print()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
