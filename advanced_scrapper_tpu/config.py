@@ -155,9 +155,26 @@ class DedupConfig:
     #   per-put round trips (DESIGN §5 stream-tuning note);
     #   order-independent min-combine makes any arrival order exact
     stream_index: str = "exact"  # exact (attributed, grows with stream) |
-    #                              bloom (LSHBloom: fixed memory, no attribution)
+    #   bloom (LSHBloom: fixed memory, no attribution) |
+    #   persist (index/ subsystem: durable log-structured postings on disk,
+    #   bounded resident memory, doc-id attribution, cross-RUN dedup)
     bloom_bits: int = 1 << 24    # bits per band filter (bloom mode)
     bloom_hashes: int = 4
+    index_dir: str = ""          # persist mode: postings directory ("" →
+    #   the caller derives one, e.g. the scraper uses
+    #   <out_dir>/stream_index_<website>/)
+    index_cut_postings: int = 1 << 16  # persist mode: memtable postings per
+    #   segment cut (the WAL→segment cadence; RAM between cuts is bounded
+    #   by this × ~80 B)
+    index_compact_segments: int = 8    # persist mode: live-segment count
+    #   that triggers background compaction (0 disables)
+    ckpt_every_batches: int = 16  # stream-index checkpoint cadence, in
+    #   device batches: the scraper persists the dedup index every N
+    #   processed batches (persist: WAL fsync + due segment cut — O(new
+    #   postings); exact/bloom: a FULL atomic npz rewrite — O(index), so
+    #   raise N as the corpus grows, or 0 to checkpoint only at run end,
+    #   the pre-knob behaviour) — previously an inline end-of-run-only
+    #   constant in pipeline/scraper.py
 
 
 @dataclass(frozen=True)

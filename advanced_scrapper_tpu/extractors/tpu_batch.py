@@ -40,6 +40,16 @@ from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
 # not (no per-document state exists to attribute against)
 BLOOM_SENTINEL = "(bloom)"
 
+# dup marks in persist stream-index mode: the match is attributed to a
+# STABLE cross-run doc id (resolvable to a url via the index's docmap
+# sidecar, ``PersistentIndex.lookup_names``), not to an in-session key —
+# the matched document may have been kept by an earlier process entirely
+INDEX_REF_PREFIX = "doc:"
+
+
+def index_ref(doc_id: int) -> str:
+    return f"{INDEX_REF_PREFIX}{int(doc_id)}"
+
 
 class IndexFingerprintError(ValueError):
     """Stream-index checkpoint written under a different dedup config.
@@ -80,13 +90,17 @@ class TpuBatchBackend:
         key_field: str = "url",
         sink: Callable[[dict], None] | None = None,
         exact_stage: bool = True,
+        index_dir: str | None = None,
     ):
         """``exact_stage=False`` skips the exact-key dup filter while keys
         stay usable as near-dup targets — for callers whose keys are
         unique BY CONSTRUCTION (e.g. the streaming dedup CLI's line
         numbers).  Load-bearing in bloom mode: inserting millions of
         never-colliding keys into the fixed-size url filter would
-        saturate it into false "exact dup" drops."""
+        saturate it into false "exact dup" drops.
+
+        ``index_dir`` (persist mode) overrides ``cfg.index_dir`` — the
+        directory of the durable log-structured posting index."""
         self.cfg = cfg or DedupConfig()
         self.params = make_params(
             num_perm=self.cfg.num_perm,
@@ -100,20 +114,32 @@ class TpuBatchBackend:
         self.sink = sink
         self.exact_stage = exact_stage
         self._buffer: list[dict] = []  # stats live in _reset_stream_state
-        # cross-batch state — two interchangeable stream indexes:
+        # cross-batch state — three interchangeable stream indexes:
         #   exact: attributed dup targets, host memory grows with the stream;
         #   bloom: LSHBloom (utils/bloom.py) — fixed memory forever, dup
-        #   marks carry the sentinel BLOOM_SENTINEL instead of a target key.
+        #   marks carry the sentinel BLOOM_SENTINEL instead of a target key;
+        #   persist: the index/ subsystem — durable on-disk postings with
+        #   bounded resident memory, dup marks carry ``doc:<id>`` references
+        #   stable across process restarts (cross-RUN dedup).
         self._bloom_mode = self.cfg.stream_index == "bloom"
-        if self._bloom_mode:
+        self._persist_mode = self.cfg.stream_index == "persist"
+        if self._bloom_mode or self._persist_mode:
             from advanced_scrapper_tpu.utils.bloom import hash_key64, pack_keys64
 
             self._hash_key64 = hash_key64
             self._pack_keys64 = pack_keys64
         elif self.cfg.stream_index != "exact":
             raise ValueError(
-                f"unknown stream_index {self.cfg.stream_index!r}; use exact|bloom"
+                f"unknown stream_index {self.cfg.stream_index!r}; "
+                "use exact|bloom|persist"
             )
+        if self._persist_mode:
+            self._index_dir = index_dir or self.cfg.index_dir
+            if not self._index_dir:
+                raise ValueError(
+                    "stream_index='persist' needs an index directory "
+                    "(cfg.index_dir or the index_dir argument)"
+                )
         self._reset_stream_state()
         self._bridge_stats()
 
@@ -150,12 +176,38 @@ class TpuBatchBackend:
         telemetry.gauge_fn(
             "astpu_stream_index_keys",
             lambda b: (
-                b._bloom.inserted if b._bloom_mode else len(b._kept_keys)
+                b._bloom.inserted
+                if b._bloom_mode
+                else b._pindex.posting_count()
+                if b._persist_mode
+                else len(b._kept_keys)
             ),
             owner=self,
             help="cross-batch stream-index population",
             stream=sid,
         )
+        if self._bloom_mode:
+            # live false-positive drift: the predicted row false-drop rate
+            # of both fixed-size filters, next to the per-segment OBSERVED
+            # ratio the persist index exports (astpu_index_bloom_observed_fp)
+            telemetry.gauge_fn(
+                "astpu_stream_bloom_predicted_row_fp",
+                lambda b: b._bloom.predicted_row_fp(),
+                owner=self,
+                help="formula row false-drop rate of the band filters at "
+                "the current insert count (utils.bloom saturation math)",
+                stream=sid,
+                filter="bands",
+            )
+            telemetry.gauge_fn(
+                "astpu_stream_bloom_predicted_row_fp",
+                lambda b: b._bloom_urls.predicted_row_fp(),
+                owner=self,
+                help="formula row false-drop rate of the band filters at "
+                "the current insert count (utils.bloom saturation math)",
+                stream=sid,
+                filter="urls",
+            )
 
     def _reset_stream_state(self) -> None:
         """(Re)initialise every piece of cross-batch stream-index state —
@@ -179,6 +231,33 @@ class TpuBatchBackend:
                 seed=self.cfg.seed + 1,
             )
             self._bloom_fill_warned = False
+        elif self._persist_mode:
+            from advanced_scrapper_tpu.index import PersistentIndex
+
+            # a re-reset must not leave two live WAL handles on one dir
+            if getattr(self, "_pindex", None) is not None:
+                self._pindex.close()
+                self._pindex_urls.close()
+
+            # two key domains, two sub-indexes (mirrors bloom mode's two
+            # filters): band postings and the exact-url stage.  Doc ids are
+            # allocated from the bands index and shared, so every dup mark
+            # attributes into one id space.
+            self._pindex = PersistentIndex(
+                os.path.join(self._index_dir, "bands"),
+                cut_postings=self.cfg.index_cut_postings,
+                compact_segments=self.cfg.index_compact_segments,
+            )
+            self._pindex_urls = PersistentIndex(
+                os.path.join(self._index_dir, "urls"),
+                cut_postings=self.cfg.index_cut_postings,
+                compact_segments=self.cfg.index_compact_segments,
+            )
+            # allocation comes from the bands index but the ids are also
+            # posted into the urls sub-index; union the durable floors so
+            # a crash before the bands index saw an id durably can never
+            # reissue one the urls index (or docmap) already references
+            self._pindex.raise_doc_id_floor(self._pindex_urls.doc_id_floor())
         self.stats = BatchStats()
         self._seen_keys: set[str] = set()
         self._buckets: dict[tuple[int, int], int] = {}  # (band, key) -> sig idx
@@ -221,6 +300,13 @@ class TpuBatchBackend:
             raise ValueError(
                 "flush() before save_index(): buffered records would be lost"
             )
+        if self._persist_mode:
+            # the persist index has no whole-state artifact to rewrite —
+            # durability is continuous (WAL) — so "save" degrades to the
+            # checkpoint cadence work: fsync + due segment cut
+            self._pindex.checkpoint()
+            self._pindex_urls.checkpoint()
+            return
         state: dict = {
             "fingerprint": self._config_fingerprint(),
             "stats": np.array(
@@ -286,6 +372,11 @@ class TpuBatchBackend:
         from advanced_scrapper_tpu.storage.fsio import default_fs
 
         fs = fs or default_fs()
+        if self._persist_mode:
+            # the persist index opened (and recovered) itself at
+            # construction; ``path`` is the LEGACY npz checkpoint location,
+            # auto-imported once into the new index (MIGRATION.md)
+            return self._import_legacy_npz(path, fs)
         if not fs.exists(path):
             return False
         try:
@@ -299,42 +390,59 @@ class TpuBatchBackend:
             # corrupted archives ("Cannot load file containing pickled
             # data...", "EOF: reading array data"), which is why the
             # fingerprint branch above needs its own exception type
-            import sys
 
             # load_index mutates progressively — discard whatever half of
             # the checkpoint made it in before the corruption was hit
             self._reset_stream_state()
-
-            quarantine = f"{path}.quarantine-{os.getpid()}"
-            try:
-                fs.replace(path, quarantine)
-            except OSError:
-                quarantine = "<unmovable>"
-            from advanced_scrapper_tpu.obs import telemetry, trace
-
-            telemetry.event_counter(
-                "astpu_quarantine_total",
-                "crash artifacts quarantined, by kind",
-                kind="stream_index",
-            ).inc()
-            trace.record(
-                "event",
-                "quarantine.stream_index",
-                path=os.path.basename(path),
-                error=str(e),
-            )
-            print(
-                f"tpu_batch: stream-index checkpoint {path} is unreadable "
-                f"({e}); quarantined to {quarantine}, resuming with an "
-                "empty index",
-                file=sys.stderr,
-            )
+            self._quarantine_ckpt(path, fs, e, "resuming with an empty index")
             return False
+
+    def _quarantine_ckpt(self, path: str, fs, e: Exception, tail: str) -> None:
+        """The ONE quarantine contract for an unreadable npz checkpoint
+        (resume and legacy-import paths must never diverge): rename aside,
+        count, flight-record, explain on stderr."""
+        import sys
+
+        quarantine = f"{path}.quarantine-{os.getpid()}"
+        try:
+            fs.replace(path, quarantine)
+        except OSError:
+            quarantine = "<unmovable>"
+        from advanced_scrapper_tpu.obs import telemetry, trace
+
+        telemetry.event_counter(
+            "astpu_quarantine_total",
+            "crash artifacts quarantined, by kind",
+            kind="stream_index",
+        ).inc()
+        trace.record(
+            "event",
+            "quarantine.stream_index",
+            path=os.path.basename(path),
+            error=str(e),
+        )
+        print(
+            f"tpu_batch: stream-index checkpoint {path} is unreadable "
+            f"({e}); quarantined to {quarantine}, {tail}",
+            file=sys.stderr,
+        )
+
+    def close(self) -> None:
+        """Release durable-index handles (persist mode; no-op otherwise)."""
+        if self._persist_mode:
+            self._pindex.close()
+            self._pindex_urls.close()
 
     def load_index(self, path: str) -> None:
         """Inverse of :meth:`save_index`; the backend must be configured
         identically (enforced via a config fingerprint — a mismatched
         num_perm/banding/seed would corrupt membership silently)."""
+        if self._persist_mode:
+            raise ValueError(
+                "persist mode has no npz checkpoint to load; the index "
+                "recovers itself at construction (use load_index_if_valid "
+                "for the legacy-npz auto-import)"
+            )
         with np.load(path) as data:
             if not np.array_equal(data["fingerprint"], self._config_fingerprint()):
                 raise IndexFingerprintError(
@@ -373,6 +481,115 @@ class TpuBatchBackend:
                 for b in range(keys.shape[1]):
                     self._buckets.setdefault((b, int(keys[i, b])), i)
 
+    def checkpoint(self, path: str, fs=None) -> None:
+        """Persist the stream index at the configured cadence
+        (``DedupConfig.ckpt_every_batches``): exact/bloom rewrite the npz
+        atomically; persist mode fsyncs the WAL and cuts a due segment —
+        incremental, so the cadence can be tight without O(index) rewrites."""
+        if self._persist_mode:
+            self._pindex.checkpoint()
+            self._pindex_urls.checkpoint()
+        else:
+            self.save_index(path, fs=fs)
+
+    def _import_legacy_npz(self, path: str, fs) -> bool:
+        """One-shot migration of a pre-persist npz checkpoint into the
+        persistent index: kept signatures re-derive the wide band keys
+        (the npz stores the signatures precisely so keys ARE a pure
+        function of them), kept urls land in the docmap sidecar, and seen
+        urls populate the exact-url sub-index.  The npz is renamed to
+        ``<path>.imported`` afterwards so the migration runs once.
+
+        Only exact-mode checkpoints are importable — a bloom checkpoint
+        holds no per-document state to attribute or re-key.  An index that
+        already has postings skips the import (it already happened, or the
+        operator seeded the index deliberately).
+        """
+        import sys
+
+        if not fs.exists(path):
+            return False
+        st = self._pindex.stats()
+        if st["segment_postings"] or st["wal_postings"] or st["next_doc_id"]:
+            return False  # non-empty index: never double-import
+        try:
+            with np.load(path) as data:
+                fp = data["fingerprint"]
+                cfg = self.cfg
+                expect = [cfg.num_perm, cfg.num_bands, cfg.shingle_k,
+                          cfg.seed, cfg.cand_subbands]
+                if [int(x) for x in fp[:5]] != expect:
+                    raise IndexFingerprintError(
+                        f"legacy checkpoint {path} was written under a "
+                        "different dedup config (num_perm/bands/k/seed/"
+                        "subbands); refusing to import it"
+                    )
+                if int(fp[5]) != 0:
+                    print(
+                        f"tpu_batch: legacy checkpoint {path} is a bloom "
+                        "stream index (no per-document state); it cannot "
+                        "seed the persistent index — starting empty",
+                        file=sys.stderr,
+                    )
+                    return False
+                kept_keys = [str(k) for k in data["kept_keys"].tolist()]
+                sigs = np.asarray(data["kept_sigs"])
+                seen = [str(k) for k in data["seen_keys"].tolist()]
+        except IndexFingerprintError:
+            raise  # operator error — loud by design
+        except Exception as e:
+            # substrate damage: same quarantine contract as the resume path
+            self._quarantine_ckpt(
+                path, fs, e, "persistent index starts empty"
+            )
+            return False
+        n = len(kept_keys)
+        if n:
+            ids = self._pindex.allocate_doc_ids(n)
+            keys64 = self._pack_keys64(
+                np.asarray(band_keys_wide(sigs, self.params.band_salt))
+            )
+            self._pindex.insert_batch(
+                keys64.ravel(), np.repeat(ids, keys64.shape[1])
+            )
+            self._pindex.log_names(ids.tolist(), kept_keys)
+            kept_pos = {k: int(i) for k, i in zip(kept_keys, ids)}
+        else:
+            kept_pos = {}
+        if seen:
+            # urls that were seen but not kept (exact/near dups of a kept
+            # doc) still mark exact-dup membership; attribute them to the
+            # kept doc when the url IS a kept doc's, else to a fresh id
+            url_hash = np.array(
+                [self._hash_key64(k) for k in seen], dtype=np.uint64
+            )
+            url_ids = np.empty((len(seen),), np.uint64)
+            fresh = [i for i, k in enumerate(seen) if k not in kept_pos]
+            for i, k in enumerate(seen):
+                if k in kept_pos:
+                    url_ids[i] = kept_pos[k]
+            if fresh:
+                extra = self._pindex.allocate_doc_ids(len(fresh))
+                for j, i in enumerate(fresh):
+                    url_ids[i] = extra[j]
+                # names for the non-kept seen urls too: any doc:<id> an
+                # url-dup annotation ever emits must resolve via docmap
+                self._pindex.log_names(extra.tolist(), [seen[i] for i in fresh])
+            self._pindex_urls.insert_batch(url_hash, url_ids)
+        self._pindex.checkpoint()
+        self._pindex_urls.checkpoint()
+        try:
+            fs.replace(path, path + ".imported")
+        except OSError:
+            pass
+        print(
+            f"tpu_batch: imported legacy stream-index checkpoint {path} "
+            f"({n} kept docs, {len(seen)} seen urls) into {self._index_dir}; "
+            f"renamed to {path}.imported",
+            file=sys.stderr,
+        )
+        return True
+
     # -- submission --------------------------------------------------------
 
     def submit(self, record: dict) -> list[dict]:
@@ -394,11 +611,78 @@ class TpuBatchBackend:
         records, self._buffer = self._buffer, []
         self.stats.batches += 1
 
+        # persist mode: one monotonic doc id per record up front — url
+        # postings and band postings of a kept doc must share an id, and
+        # ids of records that end up dups are simply never posted under
+        # (monotonicity, not density, is the contract)
+        doc_ids = (
+            self._pindex.allocate_doc_ids(len(records))
+            if self._persist_mode
+            else None
+        )
+
         # exact stage: host dict over record keys (urls); bloom mode uses a
         # fixed-size 1-band filter over a url hash instead of the growing set
+        url_postings = None  # persist mode: deferred (keys, ids, names)
         if not self.exact_stage:
             for rec in records:
                 rec["dup_of"] = None
+        elif self._persist_mode:
+            url_hash = np.array(
+                [self._hash_key64(_key_of(rec, self.key_field)) for rec in records],
+                dtype=np.uint64,
+            )
+            keyed = np.array(
+                [bool(_key_of(rec, self.key_field)) for rec in records]
+            )
+            url_attr = np.full(len(records), -1, np.int64)
+            if keyed.any():
+                # PROBE-only here (cross-run via the durable sub-index,
+                # intra-batch via true hash equality); the url postings are
+                # inserted AFTER the band postings in _near_dup_persist —
+                # a durable url posting with no band postings would make
+                # the restarted run skip the record as an exact dup and
+                # never re-derive its band keys, blinding the index to its
+                # near-dups forever.  (The reverse window — band keys
+                # durable, url not — only self-marks the replayed record a
+                # near-dup of its earlier incarnation: at-least-once.)
+                sub = url_hash[keyed]
+                sub_ids = doc_ids[keyed]
+                cross = np.asarray(self._pindex_urls.probe_batch(sub))
+                _u, first_ix, inverse = np.unique(
+                    sub, return_index=True, return_inverse=True
+                )
+                earlier = first_ix[inverse]
+                rows_l = np.arange(sub.size)
+                # rows sharing a hash share the cross verdict, so an
+                # intra-batch dup of a cross-dup attributes to the SAME
+                # prior doc; an intra dup of a fresh row attributes to
+                # that (posted) row's id
+                url_attr[keyed] = np.where(
+                    cross >= 0,
+                    cross,
+                    np.where(
+                        earlier < rows_l,
+                        sub_ids[earlier].astype(np.int64),
+                        -1,
+                    ),
+                )
+                fresh_sub = np.flatnonzero(url_attr[keyed] < 0)
+                keyed_ix = np.flatnonzero(keyed)
+                url_postings = (
+                    sub[fresh_sub],
+                    sub_ids[fresh_sub],
+                    [
+                        _key_of(records[i], self.key_field)
+                        for i in keyed_ix[fresh_sub].tolist()
+                    ],
+                )
+            for i, rec in enumerate(records):
+                if url_attr[i] >= 0:
+                    rec["dup_of"] = index_ref(url_attr[i])
+                    self.stats.exact_dups += 1
+                else:
+                    rec["dup_of"] = None
         elif self._bloom_mode:
             # 64-bit url hash: a collision here is an unverifiable false
             # "exact dup" drop, so 32-bit (crc32) key width was the dominant
@@ -437,12 +721,17 @@ class TpuBatchBackend:
         texts = [str(r.get(self.text_field, "") or "") for r in records]
         sigs = self.engine.signatures(texts)
         thresh = self.cfg.sim_threshold
-        if self._bloom_mode:
-            # wide (2×uint32 → uint64) keys: the bloom index cannot verify
-            # membership, so key width IS the false-drop floor
+        if self._bloom_mode or self._persist_mode:
+            # wide (2×uint32 → uint64) keys: neither index stores
+            # signatures to verify agreement against, so key width IS the
+            # false-drop floor
             keys64 = self._pack_keys64(
                 np.asarray(band_keys_wide(sigs, self.params.band_salt))
             )
+            if self._persist_mode:
+                return self._near_dup_persist(
+                    records, texts, keys64, doc_ids, url_postings
+                )
             return self._near_dup_bloom(records, texts, keys64)
         # Coarse + fine candidate columns — the same key scheme as the
         # certified batch engine (ops.lsh.candidate_keys), so the streaming
@@ -541,6 +830,61 @@ class TpuBatchBackend:
         for i, rec in enumerate(records):
             rec["near_dup_of"] = BLOOM_SENTINEL if dup[i] else None
             if dup[i]:
+                self.stats.near_dups += 1
+            elif eligible[i]:
+                self.stats.kept += 1
+        if self.sink is not None:
+            for rec in records:
+                self.sink(rec)
+        return records
+
+    def _near_dup_persist(
+        self, records, texts, keys, doc_ids, url_postings=None
+    ) -> list[dict]:
+        """Durable near-dup stage: the persistent posting index decides.
+
+        Same eligibility rules as the other indexes; hits attribute to the
+        matched posting's stable doc id (``doc:<id>``) — a document first
+        seen three process restarts ago still catches today's near-dups.
+        Kept rows post their band keys (WAL-framed, so the decision
+        survives any crash after the append); the exact stage's deferred
+        url postings land AFTER them (see the ordering note in
+        ``_process``), and every url-fresh row's name goes to the docmap
+        sidecar so no ``doc:<id>`` annotation is ever unresolvable.
+        """
+        eligible = np.array(
+            [
+                rec["dup_of"] is None
+                and bool(_key_of(rec, self.key_field))
+                and len(texts[i].encode("utf-8", "replace")) >= self.params.shingle_k
+                for i, rec in enumerate(records)
+            ]
+        )
+        attr = np.full(len(records), -1, np.int64)
+        if eligible.any():
+            attr[eligible] = self._pindex.check_and_add_batch(
+                keys[eligible], doc_ids[eligible]
+            )
+        if url_postings is not None:
+            u_keys, u_ids, u_names = url_postings
+            if u_keys.size:
+                self._pindex_urls.insert_batch(u_keys, u_ids)
+                self._pindex.log_names(u_ids.tolist(), u_names)
+        else:
+            # no url stage (exact_stage=False callers): kept rows are the
+            # only attribution targets — log their keys here instead
+            kept_rows = np.flatnonzero(eligible & (attr < 0))
+            if kept_rows.size:
+                self._pindex.log_names(
+                    doc_ids[kept_rows].tolist(),
+                    [
+                        _key_of(records[i], self.key_field)
+                        for i in kept_rows.tolist()
+                    ],
+                )
+        for i, rec in enumerate(records):
+            rec["near_dup_of"] = index_ref(attr[i]) if attr[i] >= 0 else None
+            if attr[i] >= 0:
                 self.stats.near_dups += 1
             elif eligible[i]:
                 self.stats.kept += 1

@@ -1,0 +1,33 @@
+"""Persistent corpus index — durable, sharded, log-structured LSH postings.
+
+Every other dedup structure in the tree is session-local (``NearDupEngine``
+buckets, ``BloomBandIndex`` bit-planes, the backend's ``_kept_sigs`` lists);
+the only durability was a monolithic whole-index npz checkpoint rewritten in
+full on every save and reloaded in full on every resume.  This package is
+the subsystem that replaces that: an incremental on-disk index of
+``(band-key, doc-id)`` postings with bounded resident memory, so a restarted
+run deduplicates incoming articles against the *entire historical corpus*
+without ever holding that corpus — or its postings — in RAM (the
+FOLD / LSHBloom shape: online fuzzy dedup over an evolving dataset).
+
+Layering: this package may use ``storage.fsio`` (durability seam),
+``utils.bloom`` (filter math) and ``obs`` (telemetry), but never
+``pipeline`` — enforced by ``tools/lint_imports.py``.
+
+- :mod:`.wal` — torn-tail-safe write-ahead log of posting batches.
+- :mod:`.segment` — immutable sorted segment files with per-segment Blooms.
+- :mod:`.store` — :class:`PersistentIndex`: WAL → memtable → segment cut →
+  compaction, crash-safe via manifest swap.
+"""
+
+from advanced_scrapper_tpu.index.segment import Segment, write_segment
+from advanced_scrapper_tpu.index.store import PersistentIndex
+from advanced_scrapper_tpu.index.wal import WriteAheadLog, replay_wal
+
+__all__ = [
+    "PersistentIndex",
+    "Segment",
+    "write_segment",
+    "WriteAheadLog",
+    "replay_wal",
+]

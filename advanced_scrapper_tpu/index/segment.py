@@ -1,0 +1,201 @@
+"""Immutable sorted segment files with per-segment Bloom filters.
+
+A segment is one generation of postings cut from the WAL: keys sorted
+ascending (ties broken by doc id), written ONCE through
+``storage.fsio.atomic_write`` — so a segment on disk is whole-or-absent by
+construction, never torn — and never modified again.  Readers keep only the
+per-segment Bloom filter (and a 64-byte header) resident; the sorted key and
+doc arrays are ``np.memmap``'d, so probing an N-posting history costs RAM
+proportional to the *Bloom* sizing (~10 bits/posting at the 1% default),
+not to the postings themselves — the LSHBloom memory contract, with
+attribution kept because the postings still exist on disk.
+
+Probe path per batch: Bloom membership first (a negative — the common case
+for fresh content — never touches the posting arrays), then a vectorised
+``searchsorted`` equal-range scan for the surviving keys.  A Bloom positive
+that finds no posting is an *observed* false positive and is counted, so
+``/status`` shows the live observed-FP ratio next to the predicted one.
+
+Layout (little-endian)::
+
+    magic 8s | version u32 | count u64 | bloom_bits u64 | bloom_hashes u32 |
+    bloom_seed u32 | header crc32 u32 | pad → 64 B
+    bloom words u64[bloom_bits/64]
+    keys u64[count]          (sorted)
+    docs u64[count]          (parallel to keys)
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+from advanced_scrapper_tpu.storage.fsio import atomic_write, default_fs
+from advanced_scrapper_tpu.utils.bloom import BloomBandIndex
+
+__all__ = ["Segment", "write_segment", "bloom_for_count"]
+
+_MAGIC = b"ASTPUSEG"
+_VERSION = 1
+_HEAD = struct.Struct("<8sIQQIII")  # magic, ver, count, bits, hashes, seed, crc
+HEADER_LEN = 64
+
+
+def bloom_for_count(count: int, *, seed: int = 0, row_fp: float = 0.01) -> BloomBandIndex:
+    """Per-segment filter sized for ``count`` keys at ~``row_fp`` — a
+    single-band :class:`BloomBandIndex`, so the sizing/saturation math is
+    the one already measured in ``tools/soak_bloom.py``."""
+    return BloomBandIndex.for_capacity(
+        max(1, count), num_bands=1, row_fp=row_fp, seed=seed
+    )
+
+
+def _header_bytes(count: int, bloom: BloomBandIndex) -> bytes:
+    body = _HEAD.pack(
+        _MAGIC, _VERSION, count, bloom.bits, bloom.num_hashes, bloom.seed, 0
+    )
+    crc = zlib.crc32(body)
+    packed = _HEAD.pack(
+        _MAGIC, _VERSION, count, bloom.bits, bloom.num_hashes, bloom.seed, crc
+    )
+    return packed + b"\0" * (HEADER_LEN - len(packed))
+
+
+def write_segment(
+    path: str,
+    keys: np.ndarray,
+    docs: np.ndarray,
+    *,
+    seed: int = 0,
+    fs=None,
+) -> None:
+    """Sort + deduplicate the posting batch and atomically persist it.
+
+    Duplicate ``(key, doc)`` pairs collapse to one; multiple docs per key
+    survive (compaction tombstones all but the first-seen later).  The
+    rename inside :func:`atomic_write` is the commit point — a crash at any
+    earlier byte leaves no segment at ``path``.
+    """
+    keys = np.ascontiguousarray(keys, dtype=np.uint64).ravel()
+    docs = np.ascontiguousarray(docs, dtype=np.uint64).ravel()
+    if keys.shape != docs.shape:
+        raise ValueError(f"keys/docs length mismatch: {keys.shape} vs {docs.shape}")
+    order = np.lexsort((docs, keys))
+    keys, docs = keys[order], docs[order]
+    if keys.size:
+        fresh = np.empty(keys.size, bool)
+        fresh[0] = True
+        fresh[1:] = (keys[1:] != keys[:-1]) | (docs[1:] != docs[:-1])
+        keys, docs = keys[fresh], docs[fresh]
+    bloom = bloom_for_count(int(keys.size), seed=seed)
+    if keys.size:
+        bloom.add_batch(keys[:, None])
+
+    def writer(fh):
+        fh.write(_header_bytes(int(keys.size), bloom))
+        fh.write(bloom._words.tobytes())
+        fh.write(keys.tobytes())
+        fh.write(docs.tobytes())
+
+    atomic_write(path, writer, fs=fs)
+
+
+class Segment:
+    """Reader over one immutable segment file.
+
+    Resident memory: header + Bloom words.  ``keys``/``docs`` are memmaps —
+    the OS pages postings in only for the (rare) Bloom-positive probes.
+    """
+
+    def __init__(self, path: str, fs=None):
+        self.path = path
+        fs = fs or default_fs()
+        with fs.open(path, "rb") as fh:
+            head = fh.read(HEADER_LEN)
+            if len(head) < HEADER_LEN:
+                raise ValueError(f"segment {path}: truncated header")
+            magic, ver, count, bits, hashes, seed, crc = _HEAD.unpack_from(head)
+            if magic != _MAGIC or ver != _VERSION:
+                raise ValueError(f"segment {path}: bad magic/version")
+            expect = zlib.crc32(
+                _HEAD.pack(_MAGIC, ver, count, bits, hashes, seed, 0)
+            )
+            if crc != expect:
+                raise ValueError(f"segment {path}: header checksum mismatch")
+            words = np.frombuffer(fh.read(bits // 8), dtype=np.uint64)
+            if words.size != bits // 64:
+                raise ValueError(f"segment {path}: truncated bloom plane")
+        self.count = int(count)
+        self.bloom = BloomBandIndex(1, bits=int(bits), num_hashes=int(hashes), seed=int(seed))
+        self.bloom.restore(words.reshape(1, -1).copy(), self.count, 64)
+        expected = HEADER_LEN + bits // 8 + 16 * self.count
+        actual = fs.size(path)
+        if actual != expected:
+            raise ValueError(
+                f"segment {path}: size {actual} != expected {expected}"
+            )
+        keys_off = HEADER_LEN + bits // 8
+        if self.count:
+            self.keys = np.memmap(path, dtype=np.uint64, mode="r",
+                                  offset=keys_off, shape=(self.count,))
+            self.docs = np.memmap(path, dtype=np.uint64, mode="r",
+                                  offset=keys_off + 8 * self.count,
+                                  shape=(self.count,))
+        else:
+            self.keys = np.zeros((0,), np.uint64)
+            self.docs = np.zeros((0,), np.uint64)
+        # observed-FP accounting (scraped as a ratio by the store's gauges)
+        self.bloom_hits = 0
+        self.bloom_false = 0
+
+    @property
+    def resident_bytes(self) -> int:
+        return self.bloom.memory_bytes + HEADER_LEN
+
+    @property
+    def file_bytes(self) -> int:
+        return HEADER_LEN + self.bloom.memory_bytes + 16 * self.count
+
+    def probe(self, flat_keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """``(query_rows, doc_ids)`` posting matches for ``uint64[n]`` keys.
+
+        Bloom-negative keys never touch the posting memmaps; a key may
+        match several postings (several doc ids), all are returned.
+        """
+        flat_keys = np.asarray(flat_keys, dtype=np.uint64).ravel()
+        if self.count == 0 or flat_keys.size == 0:
+            e = np.zeros((0,), np.int64)
+            return e, e.astype(np.uint64)
+        maybe = self.bloom.contains_batch(flat_keys[:, None])
+        rows = np.flatnonzero(maybe)
+        if rows.size == 0:
+            e = np.zeros((0,), np.int64)
+            return e, e.astype(np.uint64)
+        q = flat_keys[rows]
+        lo = np.searchsorted(self.keys, q, side="left")
+        hi = np.searchsorted(self.keys, q, side="right")
+        n_match = hi - lo
+        hit = n_match > 0
+        self.bloom_hits += int(rows.size)
+        self.bloom_false += int(rows.size - hit.sum())
+        if not hit.any():
+            e = np.zeros((0,), np.int64)
+            return e, e.astype(np.uint64)
+        rows, lo, n_match = rows[hit], lo[hit], n_match[hit]
+        out_rows = np.repeat(rows, n_match)
+        flat_ix = np.concatenate(
+            [np.arange(l, l + n) for l, n in zip(lo.tolist(), n_match.tolist())]
+        )
+        return out_rows.astype(np.int64), np.asarray(self.docs[flat_ix])
+
+    def arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """Materialised ``(keys, docs)`` copies — compaction/verification
+        input, not a probe path."""
+        return np.asarray(self.keys).copy(), np.asarray(self.docs).copy()
+
+    def close(self) -> None:
+        # memmaps release on GC; drop references eagerly so Windows-style
+        # holders (and ChaosFs tests) can delete files after compaction
+        self.keys = self.docs = None

@@ -1,0 +1,668 @@
+"""The persistent corpus index: WAL → memtable → segments → compaction.
+
+:class:`PersistentIndex` is the durable cross-run successor of every
+session-local dedup index in the tree.  It stores ``(band-key → doc-id)``
+postings for an evolving corpus with three properties the npz-checkpoint
+model could not give:
+
+- **incremental durability** — every posting batch is framed into a
+  write-ahead log (:mod:`.wal`) through the ``storage.fsio`` seam *before*
+  it becomes probe-able, so no save/load of the whole index ever happens
+  and a crash at any byte loses at most one in-flight batch (which the
+  producer re-derives on resume);
+- **bounded resident memory** — postings live in immutable sorted segment
+  files (:mod:`.segment`); only their per-segment Bloom filters stay in
+  RAM, so probing a billion-posting history is a Bloom check plus a rare
+  memmap'd binary search (the LSHBloom contract, with attribution);
+- **crash-safe reorganisation** — segment cuts and compactions commit by
+  atomically swapping ``manifest.json`` (the single source of truth for
+  which files are live); every file not named by the manifest is an orphan
+  from a crashed writer and is swept on open.
+
+First-seen-wins attribution is encoded in doc-id order: doc ids are
+allocated monotonically (persisted via the manifest, re-derived from the
+WAL on crash), a probe returns the *minimum* doc id over all postings for
+a key, and compaction tombstones every posting for a key except the
+minimum — later postings are superseded by definition, because no probe
+can ever prefer them.
+
+Concurrency: one writer thread (insert/cut) + N probe threads + an
+optional background compaction thread.  Mutable state (memtable, segment
+list, manifest) is guarded by one lock; segment files themselves are
+immutable, so the heavy merge work runs outside the lock.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from advanced_scrapper_tpu.index.segment import Segment, write_segment
+from advanced_scrapper_tpu.index.wal import WriteAheadLog, replay_wal
+from advanced_scrapper_tpu.storage.fsio import atomic_replace, default_fs
+
+__all__ = ["PersistentIndex"]
+
+MANIFEST = "manifest.json"
+DOCMAP = "docmap.log"
+
+NO_DOC = np.int64(-1)
+
+
+def _wal_name(seq: int) -> str:
+    return f"wal-{seq:08d}.log"
+
+
+def _seg_name(seq: int) -> str:
+    return f"seg-{seq:08d}.seg"
+
+
+class PersistentIndex:
+    """A sharded log-structured (key → doc-id) posting index on disk."""
+
+    _seq_lock = threading.Lock()
+    _seq = 0
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        cut_postings: int = 1 << 16,
+        compact_segments: int = 8,
+        compact_inline: bool = False,
+        read_only: bool = False,
+        fs=None,
+    ):
+        """Open (or create) the index at ``directory``.
+
+        ``cut_postings`` — memtable postings that trigger a segment cut
+        (the WAL/segment-cut cadence; the scraper maps its checkpoint knob
+        here).  ``compact_segments`` — live-segment count that triggers
+        compaction (0 disables); compaction runs on a daemon thread unless
+        ``compact_inline`` (tests, and the crashsweep child, need the
+        deterministic ordering).
+
+        ``read_only`` — open for probing/inspection WITHOUT mutating the
+        directory: no orphan sweep, no WAL tail repair, no append handle.
+        The only safe way to open a directory a live writer may own (the
+        offline ``lookup_names`` flow, the crashsweep safety checker) —
+        a writable open would sweep the writer's pre-commit cut files out
+        from under it.  Mutating calls raise.
+        """
+        self.dir = directory
+        self.cut_postings = int(cut_postings)
+        self.compact_segments = int(compact_segments)
+        self.compact_inline = bool(compact_inline)
+        self.read_only = bool(read_only)
+        self._fs = fs or default_fs()
+        self._lock = threading.RLock()
+        self._compact_busy = threading.Lock()
+        if not read_only:
+            os.makedirs(directory, exist_ok=True)
+
+        t0 = time.perf_counter()
+        man = self._load_manifest()
+        self._seg_seq = int(man.get("seg_seq", 0))
+        self._wal_seq = int(man.get("wal_seq", 0))
+        self._segments: list[Segment] = [
+            Segment(os.path.join(directory, name), fs=self._fs)
+            for name in man.get("segments", [])
+        ]
+        if not read_only:
+            self._sweep_orphans(set(man.get("segments", [])))
+        # WAL replay rebuilds the memtable; its doc ids also re-derive the
+        # allocation high-water mark a crash may have kept out of the
+        # manifest (manifest next_doc_id is only persisted at cut time)
+        wal_path = os.path.join(directory, _wal_name(self._wal_seq))
+        mk, md, wal_end = replay_wal(wal_path, fs=self._fs)
+        self._mem_keys: list[np.ndarray] = [mk] if mk.size else []
+        self._mem_docs: list[np.ndarray] = [md] if md.size else []
+        self._mem_count = int(mk.size)
+        self._mem_map: dict[int, int] = {}
+        for k, d in zip(mk.tolist(), md.tolist()):
+            prev = self._mem_map.get(k)
+            if prev is None or d < prev:
+                self._mem_map[k] = d
+        self._next_doc_id = int(man.get("next_doc_id", 0))
+        if md.size:
+            self._next_doc_id = max(self._next_doc_id, int(md.max()) + 1)
+        if read_only:
+            self._wal = None
+        else:
+            self._repair_wal_tail(wal_path, wal_end)
+            self._wal = WriteAheadLog(wal_path, fs=self._fs)
+        self.reopen_seconds = time.perf_counter() - t0
+        self._instrument()
+
+    def _repair_wal_tail(self, wal_path: str, valid_end: int) -> None:
+        """Truncate a torn WAL tail before reopening the appender: records
+        appended in ``ab`` mode BEHIND torn garbage would be unreplayable
+        forever (replay stops at the first bad frame), so every posting of
+        the recovered session until the next cut would silently vanish on
+        the following open."""
+        if not self._fs.exists(wal_path):
+            return
+        if self._fs.size(wal_path) <= valid_end:
+            return
+        with self._fs.open(wal_path, "r+b") as fh:
+            fh.truncate(valid_end)
+        from advanced_scrapper_tpu.obs import telemetry
+
+        telemetry.event_counter(
+            "astpu_index_wal_torn_total",
+            "torn WAL tails truncated at index open (crash artifacts)",
+        ).inc()
+
+    def _check_writable(self) -> None:
+        if self.read_only:
+            raise ValueError(
+                f"index at {self.dir} was opened read_only; probing and "
+                "lookup_names are allowed, mutation is not"
+            )
+
+    # -- manifest / recovery -------------------------------------------------
+
+    def _load_manifest(self) -> dict:
+        path = os.path.join(self.dir, MANIFEST)
+        if not self._fs.exists(path):
+            return {}
+        with self._fs.open(path, "rb") as fh:
+            man = json.loads(fh.read().decode("utf-8"))
+        if int(man.get("version", 1)) != 1:
+            raise ValueError(f"unknown index manifest version in {path}")
+        return man
+
+    def _write_manifest(self) -> None:
+        """Atomic commit point for every structural change (cut, compact,
+        rotation): the swapped file names exactly the live segment set,
+        the live WAL generation and the doc-id high-water mark."""
+        man = {
+            "version": 1,
+            "seg_seq": self._seg_seq,
+            "wal_seq": self._wal_seq,
+            "segments": [os.path.basename(s.path) for s in self._segments],
+            "next_doc_id": self._next_doc_id,
+        }
+        atomic_replace(
+            os.path.join(self.dir, MANIFEST),
+            json.dumps(man, indent=1).encode("utf-8"),
+            fs=self._fs,
+        )
+
+    def _sweep_orphans(self, live_segments: set) -> None:
+        """Delete files a crashed writer left that the manifest does not
+        name: cut/compaction outputs whose commit never happened, and WAL
+        generations superseded by a committed rotation.  Never touches the
+        live WAL or live segments, so a sweep is always safe."""
+        live_wal = _wal_name(self._wal_seq)
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        for name in names:
+            stale = (
+                (name.endswith(".seg") and name not in live_segments)
+                or (name.startswith("wal-") and name.endswith(".log")
+                    and name != live_wal)
+            )
+            if stale:
+                try:
+                    self._fs.remove(os.path.join(self.dir, name))
+                except OSError:
+                    pass
+
+    # -- telemetry -----------------------------------------------------------
+
+    def _instrument(self) -> None:
+        from advanced_scrapper_tpu.obs import telemetry
+
+        with PersistentIndex._seq_lock:
+            iid = f"{PersistentIndex._seq}:{os.path.basename(self.dir) or 'index'}"
+            PersistentIndex._seq += 1
+        self._m_probe_rows = telemetry.counter(
+            "astpu_index_probe_rows_total", "query rows probed", index=iid
+        )
+        self._m_probe_hits = telemetry.counter(
+            "astpu_index_probe_hits_total", "query rows that found a candidate",
+            index=iid,
+        )
+        self._m_postings = telemetry.counter(
+            "astpu_index_postings_total", "postings appended (WAL-framed)",
+            index=iid,
+        )
+        self._m_tombstoned = telemetry.counter(
+            "astpu_index_tombstoned_total",
+            "superseded postings dropped by compaction", index=iid,
+        )
+        self._m_cuts = telemetry.counter(
+            "astpu_index_segment_cuts_total", "segments cut from the WAL",
+            index=iid,
+        )
+        self._m_compact_s = telemetry.histogram(
+            "astpu_index_compaction_seconds", "compaction wall clock", index=iid
+        )
+        self._m_cut_s = telemetry.histogram(
+            "astpu_index_segment_cut_seconds", "segment-cut wall clock", index=iid
+        )
+        for name, fn, help in (
+            ("astpu_index_segments", lambda s: len(s._segments),
+             "live segment files"),
+            ("astpu_index_segment_bytes", lambda s: sum(
+                g.file_bytes for g in s._segments), "on-disk segment bytes"),
+            ("astpu_index_wal_postings", lambda s: s._mem_count,
+             "postings in the live WAL/memtable (not yet in a segment)"),
+            ("astpu_index_resident_bytes", lambda s: s.resident_bytes(),
+             "RAM held by the index (segment Blooms + memtable)"),
+            ("astpu_index_next_doc_id", lambda s: s._next_doc_id,
+             "doc-id allocation high-water mark"),
+            ("astpu_index_bloom_observed_fp", lambda s: s.observed_fp_ratio(),
+             "observed per-segment Bloom false-positive ratio"),
+        ):
+            telemetry.gauge_fn(name, fn, owner=self, help=help, index=iid)
+
+    # -- sizing / introspection ----------------------------------------------
+
+    def resident_bytes(self) -> int:
+        """RAM the index holds: segment Blooms + memtable postings (the
+        bounded-memory contract the two-session test asserts — NOT the
+        on-disk posting bytes, which are memmap'd)."""
+        with self._lock:
+            seg = sum(s.resident_bytes for s in self._segments)
+            # dict entry ≈ 2 boxed ints + slot; 64 B is a safe upper figure
+            return seg + self._mem_count * 16 + len(self._mem_map) * 64
+
+    def disk_postings_bytes(self) -> int:
+        with self._lock:
+            return sum(16 * s.count for s in self._segments) + 16 * self._mem_count
+
+    def posting_count(self) -> int:
+        """Live postings (segments + memtable) — the cheap gauge accessor
+        (no resident/byte aggregation; one lock, one sum)."""
+        with self._lock:
+            return sum(s.count for s in self._segments) + self._mem_count
+
+    def observed_fp_ratio(self) -> float:
+        with self._lock:
+            hits = sum(s.bloom_hits for s in self._segments)
+            false = sum(s.bloom_false for s in self._segments)
+        return false / hits if hits else 0.0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "segments": len(self._segments),
+                "segment_postings": sum(s.count for s in self._segments),
+                "segment_bytes": sum(s.file_bytes for s in self._segments),
+                "wal_postings": self._mem_count,
+                "resident_bytes": self.resident_bytes(),
+                "next_doc_id": self._next_doc_id,
+                "observed_bloom_fp": self.observed_fp_ratio(),
+            }
+
+    def dump_postings(self) -> tuple[np.ndarray, np.ndarray]:
+        """Every live posting ``(keys, docs)`` — verification surface for
+        the crash sweep's zero-lost / zero-duplicated assertions."""
+        with self._lock:
+            parts = [s.arrays() for s in self._segments]
+            parts += [(k, d) for k, d in zip(self._mem_keys, self._mem_docs)]
+        if not parts:
+            e = np.zeros((0,), np.uint64)
+            return e, e
+        return (
+            np.concatenate([p[0] for p in parts]),
+            np.concatenate([p[1] for p in parts]),
+        )
+
+    # -- doc-id allocation / attribution -------------------------------------
+
+    def allocate_doc_ids(self, n: int) -> np.ndarray:
+        """``uint64[n]`` monotonically increasing ids.  Durable high-water:
+        every POSTED id raises ``next_doc_id`` (``insert_batch``), which
+        re-derives from the WAL on crash and from the manifest after a
+        cut; ids handed out but never posted anywhere may be reissued
+        after a restart — by then nothing durable references them (a
+        caller posting ids into SIBLING indexes must union the floors at
+        open: :meth:`doc_id_floor` / :meth:`raise_doc_id_floor`)."""
+        self._check_writable()
+        with self._lock:
+            start = self._next_doc_id
+            self._next_doc_id += int(n)
+        return np.arange(start, start + n, dtype=np.uint64)
+
+    def doc_id_floor(self) -> int:
+        """The smallest id this index would allocate next — ≥ every id it
+        has durably seen (posted, or reserved via a committed manifest)."""
+        with self._lock:
+            return self._next_doc_id
+
+    def raise_doc_id_floor(self, floor: int) -> None:
+        """Never allocate below ``floor`` — the cross-sub-index union hook:
+        a backend allocating from THIS index but posting those ids into a
+        sibling index too must, at open, raise this floor to the sibling's
+        (else a crash before this index saw the ids durably would reissue
+        them, silently re-pointing the sibling's old attributions)."""
+        with self._lock:
+            self._next_doc_id = max(self._next_doc_id, int(floor))
+
+    def log_names(self, doc_ids, names) -> None:
+        """Best-effort ``doc-id → name`` sidecar (attribution for humans;
+        the index itself never reads it).  Torn tails are tolerated by the
+        reader, so a crash mid-append costs at most one mapping line."""
+        self._check_writable()
+        lines = "".join(
+            f"{int(d)}\t{str(n)}\n" for d, n in zip(doc_ids, names)
+        ).encode("utf-8")
+        try:
+            with self._fs.open(os.path.join(self.dir, DOCMAP), "ab") as fh:
+                fh.write(lines)
+        except OSError:
+            from advanced_scrapper_tpu.obs import telemetry
+
+            telemetry.event_counter(
+                "astpu_index_docmap_errors_total",
+                "docmap sidecar appends that failed (attribution-only loss)",
+            ).inc()
+
+    def lookup_names(self, doc_ids) -> dict[int, str]:
+        """Resolve doc ids from the sidecar (offline/operator path: O(file))."""
+        want = {int(d) for d in doc_ids}
+        out: dict[int, str] = {}
+        path = os.path.join(self.dir, DOCMAP)
+        if not self._fs.exists(path):
+            return out
+        with self._fs.open(path, "rb") as fh:
+            data = fh.read()
+        for line in data.split(b"\n")[:-1]:  # unterminated tail = torn, skip
+            did, _, name = line.partition(b"\t")
+            try:
+                i = int(did)
+            except ValueError:
+                continue
+            if i in want and i not in out:  # first-seen mapping wins
+                out[i] = name.decode("utf-8", "replace")
+        return out
+
+    # -- core API ------------------------------------------------------------
+
+    def insert_batch(self, keys: np.ndarray, docs: np.ndarray) -> None:
+        """Durably append postings; they become probe-able only after the
+        WAL framed them (all-or-nothing per call), then cut a segment if
+        the memtable crossed the cadence threshold."""
+        self._check_writable()
+        keys = np.ascontiguousarray(keys, dtype=np.uint64).ravel()
+        docs = np.ascontiguousarray(docs, dtype=np.uint64).ravel()
+        if keys.size == 0:
+            return
+        with self._lock:
+            self._wal.append(keys, docs)  # raises ⇒ nothing became visible
+            self._mem_keys.append(keys)
+            self._mem_docs.append(docs)
+            self._mem_count += keys.size
+            mem = self._mem_map
+            for k, d in zip(keys.tolist(), docs.tolist()):
+                prev = mem.get(k)
+                if prev is None or d < prev:
+                    mem[k] = d
+            # posted ids raise the allocation floor so it survives the cut
+            # (manifest persists next_doc_id) and the crash (WAL replay)
+            self._next_doc_id = max(self._next_doc_id, int(docs.max()) + 1)
+            self._m_postings.inc(keys.size)
+            due = self._mem_count >= self.cut_postings
+        if due:
+            self.cut_segment()
+
+    def probe_batch(self, keys: np.ndarray) -> np.ndarray:
+        """``int64[B]`` earliest (minimum) candidate doc id per query row,
+        ``-1`` where no band key of the row has ever been posted.
+
+        ``keys`` is ``uint64[B, nb]`` (one row per document, one column per
+        LSH band) or ``uint64[B]`` (single-key probes, e.g. url hashes).
+        """
+        keys = np.asarray(keys, dtype=np.uint64)
+        if keys.ndim == 1:
+            keys = keys[:, None]
+        B = keys.shape[0]
+        if B == 0:
+            return np.zeros((0,), np.int64)
+        flat = keys.ravel()
+        best = np.full(flat.shape, np.iinfo(np.int64).max, np.int64)
+        with self._lock:
+            segments = list(self._segments)
+            mem = self._mem_map
+            if mem:
+                # B×nb boxed dict lookups under the lock — fine at the
+                # current cut cadence (memtable ≤ cut_postings); if the
+                # memtable probe ever dominates a profile, mirror the
+                # segment path: sorted parallel arrays + searchsorted
+                mem_docs = np.fromiter(
+                    (mem.get(k, -1) for k in flat.tolist()), np.int64, flat.size
+                )
+                hit = mem_docs >= 0
+                best[hit] = mem_docs[hit]
+        for seg in segments:
+            rows, docs = seg.probe(flat)
+            if rows.size:
+                np.minimum.at(best, rows, docs.astype(np.int64))
+        best = best.reshape(B, -1).min(axis=1)
+        out = np.where(best == np.iinfo(np.int64).max, NO_DOC, best)
+        self._m_probe_rows.inc(B)
+        self._m_probe_hits.inc(int((out >= 0).sum()))
+        return out
+
+    def check_and_add_batch(
+        self, keys: np.ndarray, doc_ids: np.ndarray
+    ) -> np.ndarray:
+        """Stream step: per-row attribution (``int64[B]``, -1 = fresh),
+        then insert the fresh rows' postings under their given doc ids.
+
+        Cross-run membership via the index; intra-batch via true key
+        equality against earlier KEPT rows of the batch (first-seen wins)
+        — kept rows only, so every attribution references a doc id that
+        is actually posted (and docmap-resolvable); a dup row's id is
+        never posted and must never be an attribution target.
+        """
+        keys = np.asarray(keys, dtype=np.uint64)
+        if keys.ndim == 1:
+            keys = keys[:, None]
+        doc_ids = np.ascontiguousarray(doc_ids, dtype=np.uint64).ravel()
+        B, nb = keys.shape
+        if B != doc_ids.size:
+            raise ValueError(f"{B} key rows vs {doc_ids.size} doc ids")
+        attr = np.asarray(self.probe_batch(keys))
+        # intra-batch pass only touches rows holding a key that occurs in
+        # MORE than one row of the batch — any other row can neither match
+        # an earlier row nor be matched by a later one, so the (ordered,
+        # kept-rows-only) resolution loop runs over the shared minority
+        uniq, counts = np.unique(keys, return_counts=True)
+        kc = counts[np.searchsorted(uniq, keys.ravel())].reshape(B, nb)
+        shared_rows = np.flatnonzero((kc > 1).any(axis=1))
+        kept_keys: dict[int, int] = {}  # key → doc id of the first KEPT row
+        for r in shared_rows.tolist():
+            row = keys[r].tolist()
+            if attr[r] < 0:
+                for k in row:
+                    d = kept_keys.get(k)
+                    if d is not None:
+                        attr[r] = d
+                        break
+            if attr[r] < 0:
+                for k in row:
+                    kept_keys.setdefault(k, int(doc_ids[r]))
+        fresh = attr < 0
+        if fresh.any():
+            self.insert_batch(
+                keys[fresh].ravel(), np.repeat(doc_ids[fresh], nb)
+            )
+        return attr
+
+    # -- lifecycle: cut / compact / checkpoint / close ------------------------
+
+    def cut_segment(self) -> bool:
+        """Freeze the memtable into an immutable segment and rotate the WAL.
+
+        Commit point: the manifest swap.  A crash before it leaves the old
+        manifest + old WAL (the cut simply re-happens after reopen; the
+        written segment — and the pre-opened next WAL generation — are
+        orphans and are swept); a crash after it leaves the new manifest
+        naming the new, already-created WAL generation, whose replay is
+        empty; the postings live in the committed segment.  Either way:
+        zero lost, zero duplicated.
+        """
+        self._check_writable()
+        # The whole cut (sort, Bloom build, fsync'd write) holds the index
+        # lock: correct but probe-blocking for its duration.  The
+        # single-writer backends probe and insert from one thread, so
+        # nothing stalls today; a multi-threaded prober would want the
+        # compaction treatment (freeze the memtable, build outside the
+        # lock, lock only for the manifest swap).
+        with self._lock:
+            if self._mem_count == 0:
+                return False
+            t0 = time.perf_counter()
+            keys = np.concatenate(self._mem_keys)
+            docs = np.concatenate(self._mem_docs)
+            self._seg_seq += 1
+            name = _seg_name(self._seg_seq)
+            path = os.path.join(self.dir, name)
+            write_segment(path, keys, docs, seed=self._seg_seq, fs=self._fs)
+            old_wal = self._wal
+            old_wal_path = old_wal.path
+            self._wal_seq += 1
+            seg = Segment(path, fs=self._fs)
+            self._segments.append(seg)
+            try:
+                # the new WAL generation opens BEFORE the commit: if the
+                # manifest swap then commits, no fallible step remains —
+                # appending to the superseded generation after a committed
+                # rotation would be silently swept as an orphan on reopen
+                new_wal = WriteAheadLog(
+                    os.path.join(self.dir, _wal_name(self._wal_seq)),
+                    fs=self._fs,
+                )
+                try:
+                    self._write_manifest()  # ← the commit point
+                except BaseException:
+                    new_wal.close()
+                    try:
+                        self._fs.remove(new_wal.path)
+                    except OSError:
+                        pass
+                    raise
+            except BaseException:
+                self._segments.pop()
+                self._seg_seq -= 1
+                self._wal_seq -= 1
+                raise
+            self._mem_keys, self._mem_docs = [], []
+            self._mem_count = 0
+            self._mem_map = {}
+            self._wal = new_wal
+            old_wal.close()
+            try:
+                self._fs.remove(old_wal_path)
+            except OSError:
+                pass  # superseded generation; swept on next open anyway
+            self._m_cuts.inc()
+            self._m_cut_s.observe(time.perf_counter() - t0)
+            n_seg = len(self._segments)
+        if self.compact_segments and n_seg >= self.compact_segments:
+            if self.compact_inline:
+                self.compact()
+            else:
+                threading.Thread(
+                    target=self.compact, daemon=True,
+                    name=f"astpu-index-compact-{os.path.basename(self.dir)}",
+                ).start()
+        return True
+
+    def compact(self) -> bool:
+        """Merge every live segment into one, tombstoning superseded
+        postings (every posting for a key except its minimum doc id).
+
+        The heavy merge runs outside the index lock against immutable
+        files; the swap — manifest first, then the in-memory list — is
+        atomic under the lock.  Segments cut concurrently with the merge
+        are preserved (they are newer than the snapshot by construction).
+        A crash during the manifest swap leaves the old manifest → old
+        segment set, merged file swept as an orphan on reopen.
+        """
+        self._check_writable()
+        if not self._compact_busy.acquire(blocking=False):
+            return False  # a compaction is already running
+        try:
+            with self._lock:
+                snapshot = list(self._segments)
+                if len(snapshot) < 2:
+                    return False
+                self._seg_seq += 1
+                name = _seg_name(self._seg_seq)
+            t0 = time.perf_counter()
+            pairs = [s.arrays() for s in snapshot]  # one materialisation each
+            keys = np.concatenate([k for k, _d in pairs])
+            docs = np.concatenate([d for _k, d in pairs])
+            del pairs
+            order = np.lexsort((docs, keys))
+            keys, docs = keys[order], docs[order]
+            first = np.empty(keys.size, bool)
+            if keys.size:
+                first[0] = True
+                first[1:] = keys[1:] != keys[:-1]
+            tombstoned = int(keys.size - first.sum())
+            keys, docs = keys[first], docs[first]
+            path = os.path.join(self.dir, name)
+            write_segment(path, keys, docs, seed=self._seg_seq, fs=self._fs)
+            merged = Segment(path, fs=self._fs)
+            old_names = {os.path.basename(s.path) for s in snapshot}
+            with self._lock:
+                fresh = [
+                    s for s in self._segments
+                    if os.path.basename(s.path) not in old_names
+                ]
+                self._segments = [merged] + fresh
+                try:
+                    self._write_manifest()  # ← the commit point
+                except BaseException:
+                    self._segments = snapshot + fresh
+                    raise
+            # old segment files: dropped refs keep any racing probe alive
+            # (POSIX unlink semantics); never Segment.close()d here
+            for s in snapshot:
+                try:
+                    self._fs.remove(s.path)
+                except OSError:
+                    pass
+            self._m_tombstoned.inc(tombstoned)
+            self._m_compact_s.observe(time.perf_counter() - t0)
+            return True
+        finally:
+            self._compact_busy.release()
+
+    def checkpoint(self) -> None:
+        """Durability point at the configured cadence: fsync the WAL, and
+        cut a segment if the memtable crossed the cadence threshold."""
+        self._check_writable()
+        with self._lock:
+            self._wal.sync()
+            due = self._mem_count >= self.cut_postings
+        if due:
+            self.cut_segment()
+
+    def close(self) -> None:
+        with self._lock:
+            # terminal close (unlike compaction's swap, where racing
+            # probes keep dropped segments alive): release the memmaps so
+            # a close/reopen-heavy process never accumulates handles
+            for s in self._segments:
+                s.close()
+            self._segments = []
+            if self._wal is None:
+                return
+            try:
+                self._wal.sync()
+            except OSError:
+                pass
+            self._wal.close()

@@ -640,6 +640,47 @@ class NearDupEngine:
         reps = self.dedup_reps(texts)
         return reps == np.arange(len(reps))
 
+    def dedup_against_index(
+        self, texts: Sequence[str | bytes], index, doc_ids=None
+    ) -> np.ndarray:
+        """``int64[N]`` attribution of a corpus against a persistent index
+        (``index.store.PersistentIndex``): device signatures → wide uint64
+        band keys → ``check_and_add_batch``.  A row whose result is ≥ 0 is
+        a near-dup of that (possibly restarts-old) doc id; fresh rows post
+        their keys under ``doc_ids`` (allocated from the index when not
+        given) and return -1.  Sub-shingle rows are never probed or posted
+        (always -1) — same eligibility rule as every stream index.
+
+        This is the engine-level streaming entry the persistent index was
+        built for: the batch backend (`extractors/tpu_batch.py`) wraps it
+        with record bookkeeping, but a raw corpus stream can consume it
+        directly.
+        """
+        from advanced_scrapper_tpu.ops.lsh import band_keys_wide
+        from advanced_scrapper_tpu.utils.bloom import pack_keys64
+
+        n = len(texts)
+        out = np.full((n,), -1, np.int64)
+        if n == 0:
+            return out
+        raw = [to_bytes(t) for t in texts]
+        sigs = self.signatures(raw)
+        keys64 = pack_keys64(
+            np.asarray(band_keys_wide(sigs, self.params.band_salt))
+        )
+        eligible = np.fromiter(
+            (len(r) >= self.params.shingle_k for r in raw), bool, n
+        )
+        if not eligible.any():
+            return out
+        if doc_ids is None:
+            doc_ids = index.allocate_doc_ids(n)
+        doc_ids = np.asarray(doc_ids, dtype=np.uint64)
+        out[eligible] = index.check_and_add_batch(
+            keys64[eligible], doc_ids[eligible]
+        )
+        return out
+
 
 class ExactDedup:
     """First-seen exact dedup with a byte-identical guarantee.

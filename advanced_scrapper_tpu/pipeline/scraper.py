@@ -479,7 +479,7 @@ def run_scraper(
     backend = None
     ann_csv = None
     if with_tpu_backend:
-        from advanced_scrapper_tpu.config import DedupConfig
+        from advanced_scrapper_tpu.config import DedupConfig, from_env
         from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
         from advanced_scrapper_tpu.storage.csvio import AppendCsv as _Csv
 
@@ -487,8 +487,14 @@ def run_scraper(
             os.path.join(cfg.out_dir, f"dedup_annotations_{cfg.website}.csv"),
             ["url", "dup_of", "near_dup_of"],
         )
+        # from_env: the ASTPU_DEDUP_* knobs (stream_index=persist, the
+        # checkpoint cadence, index geometry) reach the production entry
+        dedup_cfg = from_env(DedupConfig, "dedup")
+        index_dir = dedup_cfg.index_dir or os.path.join(
+            cfg.out_dir, f"stream_index_{cfg.website}"
+        )
         backend = TpuBatchBackend(
-            DedupConfig(),
+            dedup_cfg,
             sink=lambda rec: ann_csv.write_row(
                 {
                     "url": rec.get("url", ""),
@@ -496,13 +502,32 @@ def run_scraper(
                     "near_dup_of": rec.get("near_dup_of") or "",
                 }
             ),
+            index_dir=index_dir,
         )
         # the fifth resume artifact: without the stream index a restarted
         # run re-admits near-dups of everything already annotated; a torn
-        # checkpoint (pre-hardening crash) is quarantined and ignored
+        # checkpoint (pre-hardening crash) is quarantined and ignored.  In
+        # persist mode the npz path is the LEGACY artifact, auto-imported
+        # once into the durable index (MIGRATION.md).
         index_ckpt = os.path.join(cfg.out_dir, f"stream_index_{cfg.website}.npz")
         backend.load_index_if_valid(index_ckpt)
-        on_success = backend.submit
+
+        # checkpoint cadence (DedupConfig.ckpt_every_batches — previously
+        # the index persisted only at run end): every N processed device
+        # batches the stream index checkpoints, so a crash loses at most N
+        # batches of dedup memory, never the whole run's.  0 disables the
+        # periodic checkpoint (end-of-run only — the right setting for
+        # huge exact-mode corpora, where each checkpoint is a full npz
+        # rewrite; persist mode checkpoints are O(new postings))
+        every = dedup_cfg.ckpt_every_batches
+
+        def on_success(rec, _backend=backend, _every=every, _ckpt=index_ckpt):
+            if (
+                _backend.submit(rec)
+                and _every > 0
+                and _backend.stats.batches % _every == 0
+            ):
+                _backend.checkpoint(_ckpt)
 
     console = ConsoleMux().start()
     engine = ScraperEngine(
@@ -529,6 +554,7 @@ def run_scraper(
             if backend is not None:
                 backend.flush()
                 backend.save_index(index_ckpt)
+                backend.close()
         finally:
             try:
                 if ann_csv is not None:

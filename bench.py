@@ -29,8 +29,9 @@ Sweep knobs (env):
 
 Observability (the telemetry plane rides the bench):
   --regime NAME               run one regime (uniform|ragged|stream|recall|
-                              exact|matcher) instead of the full battery;
-                              the JSON line carries only that regime's keys
+                              exact|matcher|index) instead of the full
+                              battery; the JSON line carries only that
+                              regime's keys
   ASTPU_TELEMETRY=1           serve live GET /metrics + /status for the
                               whole run (port: ASTPU_METRICS_PORT, default
                               ephemeral — address printed to stderr); the
@@ -363,6 +364,80 @@ def _bench_matcher(n_articles: int) -> float:
     return n_articles / dt
 
 
+def _bench_index(n_docs: int, nb: int = 17) -> dict:
+    """The persistent corpus index (``index/`` subsystem): probe+insert
+    throughput through ``check_and_add_batch`` (WAL append + memtable +
+    Bloom-guarded segment probes, 20% planted dup rows), then COLD reopen
+    latency — manifest load, segment open (Blooms into RAM, postings
+    memmap'd), WAL replay — plus a post-reopen probe pass over history.
+
+    Everything is wall-clock against a real on-disk index in a temp dir;
+    segment cuts and compaction happen at the production cadence logic, so
+    the insert figure pays the real durability cost.
+    """
+    import shutil
+    import tempfile
+
+    from advanced_scrapper_tpu.index import PersistentIndex
+
+    rng = np.random.RandomState(11)
+    B = 2048
+    n_batches = max(1, n_docs // B)
+    base = tempfile.mkdtemp(prefix="astpu-bench-index-")
+    try:
+        # cadence sized so the run cuts ~10 segments and triggers at least
+        # one compaction — the insert figure must pay the full lifecycle
+        cut = max(1 << 14, (n_docs * nb) // 10)
+        idx = PersistentIndex(
+            os.path.join(base, "bands"),
+            cut_postings=cut,
+            compact_segments=6,
+            compact_inline=True,  # pay compaction inside the timed region
+        )
+        t_ins = 0.0
+        probe_keys = []
+        kept_rows: list[np.ndarray] = []
+        for _ in range(n_batches):
+            keys = rng.randint(0, 1 << 62, size=(B, nb)).astype(np.uint64)
+            if kept_rows:
+                src = kept_rows[rng.randint(len(kept_rows))]
+                n_dup = B // 5
+                keys[:n_dup] = src[rng.randint(0, src.shape[0], size=n_dup)]
+            ids = idx.allocate_doc_ids(B)
+            t0 = time.perf_counter()
+            attr = idx.check_and_add_batch(keys, ids)
+            t_ins += time.perf_counter() - t0
+            kept_rows.append(keys[np.asarray(attr) < 0])
+            probe_keys.append(keys)
+        idx.checkpoint()
+        st = idx.stats()
+        # pure-probe pass over the full history (hits + misses mixed)
+        t0 = time.perf_counter()
+        for keys in probe_keys:
+            idx.probe_batch(keys)
+        t_probe = time.perf_counter() - t0
+        idx.close()
+        # cold reopen: fresh process state (fresh object, same files)
+        t0 = time.perf_counter()
+        idx2 = PersistentIndex(os.path.join(base, "bands"), cut_postings=cut)
+        reopen_s = time.perf_counter() - t0
+        hit = idx2.probe_batch(probe_keys[0])
+        assert (np.asarray(hit) >= 0).any(), "reopened index lost postings"
+        idx2.close()
+        total = B * n_batches
+        return {
+            "index_insert_rows_per_sec": round(total / t_ins, 1),
+            "index_probe_rows_per_sec": round(total / t_probe, 1),
+            "index_reopen_ms": round(reopen_s * 1e3, 2),
+            "index_segments": st["segments"],
+            "index_segment_bytes": st["segment_bytes"],
+            "index_resident_bytes": st["resident_bytes"],
+            "index_observed_bloom_fp": round(st["observed_bloom_fp"], 6),
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 #: v5e TensorCore clock derived from the public bf16 peak (197e12 FLOP/s =
 #: 2·128·128 per MXU · 4 MXUs · clock → 1.5 GHz); VPU nominal 32-bit rate =
 #: 8 sublanes × 128 lanes × 4 ALUs × clock.  Full derivation + HBM side in
@@ -498,7 +573,7 @@ def _jax_or_cpu_fallback(timeout_s: float = 240.0):
     _reexec_cpu_fallback()
 
 
-REGIMES = ("uniform", "ragged", "stream", "recall", "exact", "matcher")
+REGIMES = ("uniform", "ragged", "stream", "recall", "exact", "matcher", "index")
 
 
 def _parse_args(argv=None):
@@ -630,6 +705,14 @@ def main(argv=None) -> None:
                 )
                 note(f"matcher done: {matcher:.0f}/s")
                 out["matcher_articles_per_sec"] = round(matcher, 1)
+            if "index" in want:
+                idx = _bench_index(8192 if quick else 65536)
+                note(
+                    f"index done: insert {idx['index_insert_rows_per_sec']:.0f}"
+                    f"/s probe {idx['index_probe_rows_per_sec']:.0f}/s "
+                    f"reopen {idx['index_reopen_ms']:.1f}ms"
+                )
+                out.update(idx)
     except Exception as e:
         # A tunnel that came up can still die between dispatches (it has).
         # Better one labeled cpu-fallback line than no round record at all.

@@ -115,3 +115,22 @@ def test_crashsweep_stream_dedup_converges(tmp_path):
         kill_window=(0.05, 1.0),
     )
     _assert_sweep(report, min_kills=5)
+
+
+def test_crashsweep_pindex_converges(tmp_path):
+    """Kill instants over the persistent corpus index — two wall-clock
+    SIGKILLs plus one seeded in-write ``os._exit`` INSIDE each durability
+    mechanism (WAL append, segment-cut atomic write, cut/compaction
+    manifest swap).  At every kill point the index must reopen (manifest
+    whole-or-previous, WAL torn tail dropped, orphans swept) with zero
+    duplicated postings, and the resumed ingest must converge to the
+    never-killed oracle's exact posting-key set — zero lost."""
+    report = crashsweep.sweep_workload(
+        "pindex",
+        str(tmp_path),
+        sigkills=2,
+        chaos_kills=3,
+        seed=404,
+        chaos_only=crashsweep.PINDEX_CHAOS_TARGETS,
+    )
+    _assert_sweep(report, min_kills=4)

@@ -1,0 +1,691 @@
+"""Persistent corpus index (``index/`` subsystem): durability + semantics.
+
+Covers the full lifecycle — WAL framing and torn-tail recovery, segment
+probe correctness against a dict oracle, cut/compaction crash windows at
+the manifest swap, orphan sweeping — and the acceptance contract: a
+two-session run (ingest half A, die, reopen, ingest half B) produces
+byte-identical dedup annotations to a never-killed single-session run over
+A+B, with resident index memory bounded by the segment Blooms, far below
+the on-disk postings.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from advanced_scrapper_tpu.index import PersistentIndex, replay_wal
+from advanced_scrapper_tpu.index.segment import Segment, write_segment
+from advanced_scrapper_tpu.index.wal import WriteAheadLog
+from advanced_scrapper_tpu.storage.fsio import ChaosFs, OsFs, SimulatedCrash
+
+
+def _rand_keys(rng, n, nb=4):
+    return rng.randint(0, 1 << 60, size=(n, nb)).astype(np.uint64)
+
+
+# -- write-ahead log ---------------------------------------------------------
+
+
+def test_wal_append_replay_roundtrip(tmp_path):
+    path = str(tmp_path / "wal-0.log")
+    wal = WriteAheadLog(path)
+    k1 = np.array([1, 2, 3], np.uint64)
+    d1 = np.array([10, 10, 10], np.uint64)
+    k2 = np.array([4, 5], np.uint64)
+    d2 = np.array([11, 11], np.uint64)
+    wal.append(k1, d1)
+    wal.append(k2, d2)
+    wal.sync()
+    wal.close()
+    keys, docs, _end = replay_wal(path)
+    assert keys.tolist() == [1, 2, 3, 4, 5]
+    assert docs.tolist() == [10, 10, 10, 11, 11]
+
+
+def test_wal_torn_tail_dropped_whole(tmp_path):
+    """A crash mid-record (any byte) must drop that record WHOLE on
+    replay — never a half-applied batch — and keep every record before."""
+    path = str(tmp_path / "wal-0.log")
+    wal = WriteAheadLog(path)
+    wal.append(np.array([7, 8], np.uint64), np.array([1, 1], np.uint64))
+    wal.append(np.array([9], np.uint64), np.array([2], np.uint64))
+    wal.close()
+    whole = open(path, "rb").read()
+    rec2_start = whole.rindex(b"\xde\xc0\x1d\xa5")  # last magic (LE)
+    for cut in range(rec2_start + 1, len(whole)):
+        with open(path, "wb") as fh:
+            fh.write(whole[:cut])
+        keys, docs, _end = replay_wal(path)
+        assert keys.tolist() == [7, 8], f"cut at {cut} leaked a torn record"
+    # corrupt a payload byte of the LAST record only: first record survives
+    data = bytearray(whole)
+    data[-1] ^= 0xFF
+    with open(path, "wb") as fh:
+        fh.write(bytes(data))
+    keys, _docs, _end = replay_wal(path)
+    assert keys.tolist() == [7, 8]
+
+
+def test_wal_failed_append_rolls_back_framing(tmp_path):
+    """An injected short write (EIO) mid-append must leave the log framed:
+    the partial record is truncated away, later appends replay cleanly."""
+    path = str(tmp_path / "wal-0.log")
+    good = WriteAheadLog(path)
+    good.append(np.array([1], np.uint64), np.array([5], np.uint64))
+    good.close()
+    chaos = ChaosFs(OsFs(), seed=3, short_write_rate=1.0, only="wal-")
+    wal = WriteAheadLog(path, fs=chaos)
+    with pytest.raises(OSError):
+        wal.append(np.array([2, 3], np.uint64), np.array([6, 6], np.uint64))
+    wal.close()
+    keys, _docs, _end = replay_wal(path)
+    assert keys.tolist() == [1], "rolled-back record must not replay"
+    wal2 = WriteAheadLog(path)  # clean substrate again
+    wal2.append(np.array([4], np.uint64), np.array([7], np.uint64))
+    wal2.close()
+    keys, docs, _end = replay_wal(path)
+    assert keys.tolist() == [1, 4] and docs.tolist() == [5, 7]
+
+
+# -- segments ----------------------------------------------------------------
+
+
+def test_segment_probe_matches_dict_oracle(tmp_path):
+    rng = np.random.RandomState(0)
+    keys = rng.randint(0, 1 << 40, size=300).astype(np.uint64)
+    docs = np.arange(300, dtype=np.uint64)
+    path = str(tmp_path / "seg-1.seg")
+    write_segment(path, keys, docs, seed=1)
+    seg = Segment(path)
+    oracle: dict[int, list[int]] = {}
+    for k, d in zip(keys.tolist(), docs.tolist()):
+        oracle.setdefault(k, []).append(d)
+    queries = np.concatenate([keys[:50], rng.randint(0, 1 << 40, size=200).astype(np.uint64)])
+    rng.shuffle(queries)
+    rows, hit_docs = seg.probe(queries)
+    got: dict[int, set] = {}
+    for r, d in zip(rows.tolist(), hit_docs.tolist()):
+        got.setdefault(int(queries[r]), set()).add(d)
+    for q in queries.tolist():
+        expect = set(oracle.get(q, ()))
+        assert got.get(q, set()) == expect, q
+    # memory contract: bloom resident, postings memmap'd
+    assert seg.resident_bytes < 16 * seg.count + seg.bloom.memory_bytes
+
+
+def test_segment_write_is_atomic_under_crash(tmp_path):
+    """A crash at any byte of the segment write leaves NO segment file —
+    whole-or-absent, so a reader can never observe a torn segment."""
+    chaos = ChaosFs(OsFs(), seed=5, crash_rate=1.0, only="seg-")
+    path = str(tmp_path / "seg-1.seg")
+    with pytest.raises(SimulatedCrash):
+        write_segment(
+            path, np.array([1, 2], np.uint64), np.array([0, 1], np.uint64),
+            fs=chaos,
+        )
+    assert not os.path.exists(path)
+
+
+def test_segment_duplicate_pairs_collapse(tmp_path):
+    path = str(tmp_path / "seg-1.seg")
+    write_segment(
+        path,
+        np.array([5, 5, 5, 9], np.uint64),
+        np.array([2, 2, 3, 1], np.uint64),  # (5,2) twice → once
+    )
+    seg = Segment(path)
+    assert seg.count == 3
+    rows, docs = seg.probe(np.array([5], np.uint64))
+    assert sorted(docs.tolist()) == [2, 3]
+
+
+# -- store lifecycle ---------------------------------------------------------
+
+
+def test_cut_reopen_never_loses_or_doubles_postings(tmp_path):
+    idx = PersistentIndex(str(tmp_path / "ix"), cut_postings=40, compact_segments=0)
+    rng = np.random.RandomState(1)
+    inserted = {}
+    for batch in range(6):
+        keys = _rand_keys(rng, 8)
+        ids = idx.allocate_doc_ids(8)
+        idx.insert_batch(keys.ravel(), np.repeat(ids, 4))
+        for row, d in zip(keys, ids.tolist()):
+            for k in row.tolist():
+                inserted.setdefault(k, d)
+    idx.close()
+    idx2 = PersistentIndex(str(tmp_path / "ix"), cut_postings=40, compact_segments=0)
+    keys, docs = idx2.dump_postings()
+    assert len(keys) == len(inserted), "lost or doubled postings across reopen"
+    assert set(keys.tolist()) == set(inserted)
+    # probe attribution: min doc id == first inserter
+    sample = list(inserted.items())[:20]
+    out = idx2.probe_batch(np.array([[k] for k, _ in sample], np.uint64))
+    assert out.tolist() == [d for _, d in sample]
+    idx2.close()
+
+
+def test_check_and_add_intra_batch_first_seen(tmp_path):
+    idx = PersistentIndex(str(tmp_path / "ix"), cut_postings=1000)
+    keys = np.array(
+        [[1, 2], [3, 4], [1, 9], [8, 4], [7, 7]], np.uint64
+    )
+    ids = idx.allocate_doc_ids(5)
+    attr = idx.check_and_add_batch(keys, ids)
+    # rows 2 and 3 share a key with rows 0 and 1 → attributed to them;
+    # their own postings are NOT inserted
+    assert attr.tolist() == [-1, -1, int(ids[0]), int(ids[1]), -1]
+    again = idx.probe_batch(np.array([[9], [8]], np.uint64))
+    assert again.tolist() == [-1, -1], "dup rows must not post their keys"
+    idx.close()
+
+
+def test_cut_crash_at_manifest_swap_converges(tmp_path):
+    """Kill exactly at the cut's commit point (manifest replace): reopening
+    must see the OLD manifest + the OLD WAL — every posting still present
+    exactly once, the orphan segment swept."""
+
+    class ReplaceCrashFs(OsFs):
+        armed = False
+
+        def replace(self, src, dst):
+            if self.armed and "manifest" in os.path.basename(dst):
+                raise SimulatedCrash(f"crash replacing {dst}")
+            super().replace(src, dst)
+
+    fs = ReplaceCrashFs()
+    d = str(tmp_path / "ix")
+    idx = PersistentIndex(d, cut_postings=10_000, compact_segments=0, fs=fs)
+    rng = np.random.RandomState(2)
+    keys = _rand_keys(rng, 10, 3)
+    ids = idx.allocate_doc_ids(10)
+    idx.insert_batch(keys.ravel(), np.repeat(ids, 3))
+    fs.armed = True
+    with pytest.raises(SimulatedCrash):
+        idx.cut_segment()
+    # the "process" died; a fresh open recovers from disk alone
+    idx2 = PersistentIndex(d, cut_postings=10_000, compact_segments=0)
+    k2, _ = idx2.dump_postings()
+    assert sorted(k2.tolist()) == sorted(keys.ravel().tolist())
+    assert len(k2) == len(set(k2.tolist()))
+    assert idx2.stats()["segments"] == 0  # orphan segment swept, not adopted
+    assert not [f for f in os.listdir(d) if f.endswith(".seg")]
+    # and the next cut (clean substrate) commits the same postings
+    assert idx2.cut_segment()
+    assert idx2.stats()["segments"] == 1 and idx2.stats()["wal_postings"] == 0
+    idx2.close()
+
+
+def test_compaction_tombstones_and_crash_at_swap_converges(tmp_path):
+    """Compaction keeps exactly the minimum doc id per key (superseded
+    postings tombstoned); a crash at ITS manifest swap leaves the old
+    segment set fully live, and a retry finishes the job."""
+
+    class ReplaceCrashFs(OsFs):
+        armed = False
+
+        def replace(self, src, dst):
+            if self.armed and "manifest" in os.path.basename(dst):
+                raise SimulatedCrash(f"crash replacing {dst}")
+            super().replace(src, dst)
+
+    fs = ReplaceCrashFs()
+    d = str(tmp_path / "ix")
+    idx = PersistentIndex(d, cut_postings=4, compact_segments=0, fs=fs)
+    # same key 77 posted by three docs across three segments: compaction
+    # must keep (77 → 1) only
+    for doc, extra in ((1, 100), (4, 101), (9, 102)):
+        idx.insert_batch(
+            np.array([77, extra, extra + 10, extra + 20], np.uint64),
+            np.full((4,), doc, np.uint64),
+        )
+    assert idx.stats()["segments"] == 3
+    pre_keys, _ = idx.dump_postings()
+    fs.armed = True
+    with pytest.raises(SimulatedCrash):
+        idx.compact()
+    idx2 = PersistentIndex(d, cut_postings=4, compact_segments=0)
+    k2, _ = idx2.dump_postings()
+    assert sorted(k2.tolist()) == sorted(pre_keys.tolist()), (
+        "crashed compaction must not change the live posting set"
+    )
+    assert idx2.stats()["segments"] == 3
+    assert idx2.compact()
+    assert idx2.stats()["segments"] == 1
+    k3, d3 = idx2.dump_postings()
+    assert len(k3) == 10  # 12 postings − 2 tombstoned (77 kept once)
+    assert d3[k3.tolist().index(77)] == 1, "min doc id must survive compaction"
+    assert idx2.probe_batch(np.array([77], np.uint64)).tolist() == [1]
+    idx2.close()
+
+
+def test_probe_across_memtable_and_segments_prefers_earliest(tmp_path):
+    idx = PersistentIndex(str(tmp_path / "ix"), cut_postings=2, compact_segments=0)
+    idx.insert_batch(np.array([50, 51], np.uint64), np.array([0, 0], np.uint64))
+    assert idx.stats()["segments"] == 1  # auto-cut at threshold
+    idx.insert_batch(np.array([50], np.uint64), np.array([7], np.uint64))
+    # 50 lives in a segment (doc 0) AND the memtable (doc 7): min wins
+    assert idx.probe_batch(np.array([50], np.uint64)).tolist() == [0]
+    idx.close()
+
+
+def test_docmap_survives_torn_tail(tmp_path):
+    idx = PersistentIndex(str(tmp_path / "ix"))
+    idx.log_names([0, 1], ["https://a", "https://b"])
+    path = os.path.join(str(tmp_path / "ix"), "docmap.log")
+    with open(path, "ab") as fh:
+        fh.write(b"2\thttps://tor")  # unterminated tail: a crashed append
+    names = idx.lookup_names([0, 1, 2])
+    assert names == {0: "https://a", 1: "https://b"}
+    idx.close()
+
+
+# -- acceptance: two-session convergence -------------------------------------
+
+
+def _convergence_corpus():
+    import random
+
+    rng = random.Random(42)
+    alpha = "abcdefghijklmnopqrstuvwxyz "
+    docs = ["".join(rng.choice(alpha) for _ in range(400)) for _ in range(32)]
+    # cross-half plants: B near-dups A, B exact-url-dups A
+    docs[20] = docs[2][:350] + "".join(rng.choice(alpha) for _ in range(50))
+    docs[27] = docs[5][:350] + "".join(rng.choice(alpha) for _ in range(50))
+    urls = [f"https://x/{i}" for i in range(32)]
+    urls[24] = urls[3]  # exact dup across the halves
+    return docs, urls
+
+
+def _ingest(backend, docs, urls):
+    out = []
+    for doc, url in zip(docs, urls):
+        out += backend.submit({"url": url, "article": doc})
+    out += backend.flush()
+    return [(r["url"], r["dup_of"], r["near_dup_of"]) for r in out]
+
+
+def test_two_session_convergence_and_bounded_memory(tmp_path):
+    """ISSUE acceptance: ingest half A, die (no close, no final cut),
+    reopen, ingest half B — annotations equal a single-session oracle run
+    over A+B byte for byte (same doc ids, same dup structure), and the
+    reopened index's resident memory is far below the on-disk postings."""
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+
+    docs, urls = _convergence_corpus()
+    half = 16
+    mk = lambda sub: DedupConfig(  # noqa: E731
+        batch_size=8, block_len=512, stream_index="persist",
+        index_dir=str(tmp_path / sub), index_cut_postings=48,
+        index_compact_segments=0,
+    )
+
+    oracle = TpuBatchBackend(mk("oracle"))
+    expect = _ingest(oracle, docs, urls)
+    oracle.close()
+    assert any(n for _u, _d, n in expect), "corpus must contain near-dups"
+    assert any(d for _u, d, _n in expect), "corpus must contain url dups"
+
+    sess1 = TpuBatchBackend(mk("two"))
+    got = _ingest(sess1, docs[:half], urls[:half])
+    # simulated kill: NO close, NO checkpoint — durability is the WAL alone
+    del sess1
+
+    sess2 = TpuBatchBackend(mk("two"))
+    got += _ingest(sess2, docs[half:], urls[half:])
+    assert got == expect, "two-session dedup diverged from the oracle"
+
+    # bounded memory: resident = segment Blooms + memtable, postings memmap'd
+    st = sess2._pindex.stats()
+    assert st["segments"] >= 2
+    resident = sess2._pindex.resident_bytes() + sess2._pindex_urls.resident_bytes()
+    disk = (sess2._pindex.disk_postings_bytes()
+            + sess2._pindex_urls.disk_postings_bytes())
+    assert resident < disk / 2, (resident, disk)
+    sess2.close()
+
+
+def test_persist_matches_bloom_dup_pattern(tmp_path):
+    """Same corpus through bloom and persist single-session: the keep/dup
+    decision pattern must agree (both are single-band-hit semantics on the
+    same wide keys); persist adds stable attribution on top."""
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+
+    docs, urls = _convergence_corpus()
+    bloom = TpuBatchBackend(
+        DedupConfig(batch_size=8, block_len=512, stream_index="bloom")
+    )
+    persist = TpuBatchBackend(
+        DedupConfig(batch_size=8, block_len=512, stream_index="persist",
+                    index_dir=str(tmp_path / "p"))
+    )
+    got_b = _ingest(bloom, docs, urls)
+    got_p = _ingest(persist, docs, urls)
+    for (ub, db, nb), (up, dp, np_) in zip(got_b, got_p):
+        assert ub == up
+        assert (db is None) == (dp is None), ub
+        assert (nb is None) == (np_ is None), ub
+        if dp is not None:
+            assert dp.startswith("doc:")
+        if np_ is not None:
+            assert np_.startswith("doc:")
+    persist.close()
+
+
+def test_persist_attribution_resolves_via_docmap(tmp_path):
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+
+    docs, urls = _convergence_corpus()
+    b = TpuBatchBackend(
+        DedupConfig(batch_size=8, block_len=512, stream_index="persist",
+                    index_dir=str(tmp_path / "p"))
+    )
+    got = _ingest(b, docs, urls)
+    hits = [(u, n) for u, _d, n in got if n]
+    assert hits
+    for _url, ref in hits:
+        doc_id = int(ref.split(":", 1)[1])
+        names = b._pindex.lookup_names([doc_id])
+        assert names[doc_id].startswith("https://x/"), names
+    b.close()
+
+
+def test_wal_reopen_after_torn_tail_keeps_new_appends_replayable(tmp_path):
+    """THE second-crash contract: recovering from a torn WAL tail must
+    truncate it before reopening the appender — records appended behind
+    torn garbage would be unreplayable forever (replay stops at the first
+    bad frame), losing every posting of the recovered session."""
+    d = str(tmp_path / "ix")
+    idx = PersistentIndex(d, cut_postings=10_000, compact_segments=0)
+    idx.insert_batch(np.array([1], np.uint64), np.array([0], np.uint64))
+    idx.close()
+    wal = [f for f in os.listdir(d) if f.startswith("wal-")][0]
+    with open(os.path.join(d, wal), "ab") as fh:
+        fh.write(b"\xde\xc0\x1d\xa5GARBAGE-TORN-TAIL")  # crash artifact
+    idx2 = PersistentIndex(d, cut_postings=10_000, compact_segments=0)
+    idx2.insert_batch(np.array([2], np.uint64), np.array([1], np.uint64))
+    idx2.close()
+    idx3 = PersistentIndex(d, cut_postings=10_000, compact_segments=0)
+    keys, _ = idx3.dump_postings()
+    assert sorted(keys.tolist()) == [1, 2], (
+        "the post-recovery append must survive the NEXT reopen"
+    )
+    idx3.close()
+
+
+def test_read_only_open_never_mutates_the_directory(tmp_path):
+    """read_only is the safe open for a directory a live writer may own:
+    no orphan sweep, no WAL repair, no append handle — and mutators raise."""
+    d = str(tmp_path / "ix")
+    idx = PersistentIndex(d, cut_postings=4, compact_segments=0)
+    idx.insert_batch(np.array([5, 6], np.uint64), np.array([0, 0], np.uint64))
+    # fake a writer mid-cut: pre-commit segment + next WAL generation exist
+    open(os.path.join(d, "seg-00000099.seg"), "wb").write(b"inflight")
+    open(os.path.join(d, "wal-00000099.log"), "wb").close()
+    before = sorted(os.listdir(d))
+    ro = PersistentIndex(d, read_only=True)
+    assert ro.probe_batch(np.array([5], np.uint64)).tolist() == [0]
+    assert ro.lookup_names([0]) == {}
+    for call in (
+        lambda: ro.insert_batch(np.array([9], np.uint64), np.array([1], np.uint64)),
+        lambda: ro.allocate_doc_ids(1),
+        lambda: ro.cut_segment(),
+        lambda: ro.compact(),
+        lambda: ro.checkpoint(),
+        lambda: ro.log_names([1], ["x"]),
+    ):
+        with pytest.raises(ValueError):
+            call()
+    ro.close()
+    assert sorted(os.listdir(d)) == before, "read_only open mutated the dir"
+    idx.close()
+
+
+def test_persist_url_postings_land_after_band_postings(tmp_path):
+    """Crash-ordering contract (backend persist mode): a record's url
+    posting must never be durable while its band postings are not — the
+    restarted run would skip it as an exact dup and never post its band
+    keys, blinding the index to its near-dups forever.  Simulate the
+    crash window by dying on the FIRST urls-sub-index WAL write: band
+    postings must already be durable at that point."""
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+
+    docs, urls = _convergence_corpus()
+    cfg = DedupConfig(batch_size=4, block_len=512, stream_index="persist",
+                      index_dir=str(tmp_path / "p"), index_compact_segments=0)
+    b = TpuBatchBackend(cfg)
+
+    class DeadFh:  # the urls WAL appender "crashes" on first write
+        def tell(self):
+            return 0
+
+        def write(self, data):
+            raise SimulatedCrash("crash inside urls WAL append")
+
+    b._pindex_urls._wal._fh.close()
+    b._pindex_urls._wal._fh = DeadFh()
+    with pytest.raises(SimulatedCrash):
+        for doc, url in zip(docs[:4], urls[:4]):
+            b.submit({"url": url, "article": doc})
+    # the band postings of the batch must already be durable
+    bands = PersistentIndex(str(tmp_path / "p" / "bands"), read_only=True)
+    keys, _ = bands.dump_postings()
+    assert len(keys) >= 16, "band postings must precede url postings"
+    bands.close()
+
+
+def test_doc_ids_never_reissued_after_restart(tmp_path):
+    """A doc id durably referenced ANYWHERE (here: only the urls sub-index
+    — the record was a near-dup, so the bands index never saw its id) must
+    not be reallocated after a restart: the backend unions the durable
+    floors of both sub-indexes at open."""
+    idx = PersistentIndex(str(tmp_path / "bands"), cut_postings=1000)
+    urls = PersistentIndex(str(tmp_path / "urls"), cut_postings=1000)
+    ids = idx.allocate_doc_ids(3)  # bands hands out 0,1,2
+    # only the urls index ever posts them (near-dup records post no bands)
+    urls.insert_batch(np.array([11, 12, 13], np.uint64), ids)
+    idx.close()
+    urls.close()
+    # restart: bands alone would restart at 0 — the union must prevent it
+    idx2 = PersistentIndex(str(tmp_path / "bands"), cut_postings=1000)
+    urls2 = PersistentIndex(str(tmp_path / "urls"), cut_postings=1000)
+    idx2.raise_doc_id_floor(urls2.doc_id_floor())
+    fresh = idx2.allocate_doc_ids(1)
+    assert int(fresh[0]) == 3, fresh
+    idx2.close()
+    urls2.close()
+
+
+def test_intra_batch_attribution_only_targets_kept_rows(tmp_path):
+    """An attribution must reference a POSTED doc id: a row matching an
+    earlier intra-batch row that was itself a dup must chain through to
+    the kept root, never to the dup's (never-posted) id."""
+    idx = PersistentIndex(str(tmp_path / "ix"), cut_postings=1000)
+    idx.insert_batch(np.array([100], np.uint64), np.array([0], np.uint64))
+    ids = idx.allocate_doc_ids(3)
+    keys = np.array(
+        [[100, 7],   # cross-run dup of doc 0 — its id never posts
+         [7, 8],     # shares 7 with row 0 (a dup): must NOT attribute to it
+         [8, 9]],    # shares 8 with row 1 (kept): attributes to row 1
+        np.uint64,
+    )
+    attr = idx.check_and_add_batch(keys, ids)
+    assert attr[0] == 0
+    assert attr[1] == -1, "dup rows are not attribution targets"
+    assert attr[2] == int(ids[1])
+    idx.close()
+
+
+def test_engine_dedup_against_index_streaming(tmp_path):
+    """Engine-level streaming entry: corpus i+1 dedups against everything
+    corpus i posted, across an index reopen; sub-shingle rows never probe."""
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    engine = NearDupEngine(DedupConfig(batch_size=8, block_len=512))
+    docs, _urls = _convergence_corpus()
+    d = str(tmp_path / "ix")
+    idx = PersistentIndex(d, cut_postings=64, compact_segments=0)
+    first = engine.dedup_against_index(docs[:16] + ["ab"], idx)
+    assert (first[:16] == -1).all(), "fresh corpus must post, not match"
+    assert first[16] == -1  # sub-shingle: ineligible, silently fresh
+    idx.close()
+    idx2 = PersistentIndex(d, cut_postings=64, compact_segments=0)
+    second = engine.dedup_against_index([docs[2], docs[20], "brand new words " * 30], idx2)
+    assert second[0] >= 0, "exact repeat of a session-1 doc must match"
+    assert second[1] >= 0, "near-dup of a session-1 doc must match"
+    assert second[2] == -1
+    idx2.close()
+
+
+# -- legacy npz auto-import --------------------------------------------------
+
+
+def test_legacy_npz_import_rejects_config_mismatch(tmp_path):
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.extractors.tpu_batch import (
+        IndexFingerprintError,
+        TpuBatchBackend,
+    )
+
+    docs, urls = _convergence_corpus()
+    legacy = TpuBatchBackend(DedupConfig(batch_size=8, block_len=512))
+    _ingest(legacy, docs[:8], urls[:8])
+    ck = str(tmp_path / "stream.npz")
+    legacy.save_index(ck)
+
+    wrong = TpuBatchBackend(
+        DedupConfig(batch_size=8, block_len=512, seed=99,
+                    stream_index="persist", index_dir=str(tmp_path / "p"))
+    )
+    with pytest.raises(IndexFingerprintError):
+        wrong.load_index_if_valid(ck)
+    assert os.path.exists(ck), "a rejected checkpoint must stay in place"
+    wrong.close()
+
+
+def test_legacy_bloom_npz_not_imported(tmp_path, capsys):
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+
+    docs, urls = _convergence_corpus()
+    legacy = TpuBatchBackend(
+        DedupConfig(batch_size=8, block_len=512, stream_index="bloom")
+    )
+    _ingest(legacy, docs[:8], urls[:8])
+    ck = str(tmp_path / "stream.npz")
+    legacy.save_index(ck)
+
+    b = TpuBatchBackend(
+        DedupConfig(batch_size=8, block_len=512, stream_index="persist",
+                    index_dir=str(tmp_path / "p"))
+    )
+    assert b.load_index_if_valid(ck) is False
+    assert os.path.exists(ck)  # left for the operator, not destroyed
+    b.close()
+
+
+def test_legacy_exact_npz_imports_once_and_dedups(tmp_path):
+    """The full migration story: an exact-mode npz seeds the persistent
+    index (keys re-derived from the stored signatures), the npz is renamed
+    ``.imported``, a second open does not re-import, and both url dups and
+    near-dups of LEGACY documents are caught with doc-id attribution."""
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+
+    docs, urls = _convergence_corpus()
+    legacy = TpuBatchBackend(DedupConfig(batch_size=8, block_len=512))
+    _ingest(legacy, docs[:16], urls[:16])
+    ck = str(tmp_path / "stream.npz")
+    legacy.save_index(ck)
+
+    cfg = DedupConfig(batch_size=8, block_len=512, stream_index="persist",
+                      index_dir=str(tmp_path / "p"))
+    b = TpuBatchBackend(cfg)
+    assert b.load_index_if_valid(ck) is True
+    assert os.path.exists(ck + ".imported") and not os.path.exists(ck)
+    out = _ingest(
+        b,
+        [docs[3], docs[2][:350] + "q" * 50, "fresh words " * 40],
+        [urls[3], "https://x/new", "https://x/other"],
+    )
+    assert out[0][1] is not None and out[0][1].startswith("doc:"), out[0]
+    assert out[1][2] is not None and out[1][2].startswith("doc:"), out[1]
+    assert out[2][1] is None and out[2][2] is None, out[2]
+    b.close()
+    # a second session must not double-import (index already populated)
+    b2 = TpuBatchBackend(cfg)
+    assert b2.load_index_if_valid(ck) is False
+    b2.close()
+
+
+# -- telemetry ---------------------------------------------------------------
+
+
+def test_index_telemetry_series_exported(tmp_path):
+    from advanced_scrapper_tpu.obs import telemetry
+
+    telemetry.REGISTRY.reset()
+    telemetry.set_enabled(True)
+    try:
+        idx = PersistentIndex(str(tmp_path / "ix"), cut_postings=8,
+                              compact_segments=0)
+        rng = np.random.RandomState(3)
+        keys = _rand_keys(rng, 6, 2)
+        ids = idx.allocate_doc_ids(6)
+        idx.check_and_add_batch(keys, ids)
+        idx.probe_batch(keys)
+        text = telemetry.REGISTRY.prometheus_text()
+        for series in (
+            "astpu_index_segments",
+            "astpu_index_segment_bytes",
+            "astpu_index_wal_postings",
+            "astpu_index_resident_bytes",
+            "astpu_index_probe_rows_total",
+            "astpu_index_probe_hits_total",
+            "astpu_index_postings_total",
+            "astpu_index_segment_cuts_total",
+            "astpu_index_bloom_observed_fp",
+        ):
+            assert series in text, series
+        idx.close()
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.REGISTRY.reset()
+
+
+def test_bloom_predicted_fp_gauge_exported():
+    """Satellite: the bloom stream backend's predicted row false-drop rate
+    rides /status as a live callback gauge, one series per filter."""
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.extractors.tpu_batch import TpuBatchBackend
+    from advanced_scrapper_tpu.obs import telemetry
+
+    telemetry.REGISTRY.reset()
+    telemetry.set_enabled(True)
+    try:
+        b = TpuBatchBackend(
+            DedupConfig(batch_size=4, block_len=512, stream_index="bloom")
+        )
+        for i in range(4):
+            b.submit({"url": f"u{i}", "article": f"document body {i} " * 30})
+        b.flush()
+        text = telemetry.REGISTRY.prometheus_text()
+        assert 'astpu_stream_bloom_predicted_row_fp{filter="bands"' in text
+        assert 'astpu_stream_bloom_predicted_row_fp{filter="urls"' in text
+        status = telemetry.REGISTRY.status()
+        fp = [
+            m for m in status["metrics"]
+            if m["name"] == "astpu_stream_bloom_predicted_row_fp"
+        ]
+        assert len(fp) == 2 and all(m["value"] >= 0 for m in fp)
+    finally:
+        telemetry.set_enabled(None)
+        telemetry.REGISTRY.reset()

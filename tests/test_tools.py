@@ -233,10 +233,60 @@ def test_bench_regime_selection_args():
     assert bench._parse_args([]).regime == "all"
     assert bench._parse_args(["--regime", "ragged"]).regime == "ragged"
     assert set(bench.REGIMES) == {
-        "uniform", "ragged", "stream", "recall", "exact", "matcher"
+        "uniform", "ragged", "stream", "recall", "exact", "matcher", "index"
     }
     try:
         bench._parse_args(["--regime", "nope"])
         raise AssertionError("unknown regime must be rejected")
     except SystemExit:
         pass
+
+
+def test_bench_index_regime_reports_throughput_and_reopen():
+    """``bench.py --regime index`` acceptance: the JSON carries probe +
+    insert throughput and the cold reopen latency, measured against a real
+    on-disk index (segments cut, at least the resident/disk split real)."""
+    import bench
+
+    out = bench._bench_index(2048, nb=9)
+    assert out["index_insert_rows_per_sec"] > 0
+    assert out["index_probe_rows_per_sec"] > 0
+    assert out["index_reopen_ms"] >= 0
+    assert out["index_segments"] >= 1
+    assert out["index_resident_bytes"] < out["index_segment_bytes"]
+
+
+def test_lint_imports_clean_tree():
+    """Tier-1 layering gate: core/ops/utils must not import pipeline/net/
+    obs, index/ must not import pipeline — over the REAL tree."""
+    import lint_imports
+
+    problems = lint_imports.lint()
+    assert problems == [], "\n".join(problems)
+
+
+def test_lint_imports_catches_violations(tmp_path):
+    """The linter must see absolute imports at any depth — module level,
+    from-imports, and the lazy function-local imports the hot paths use."""
+    import lint_imports
+
+    pkg = tmp_path / "advanced_scrapper_tpu"
+    (pkg / "core").mkdir(parents=True)
+    (pkg / "index").mkdir()
+    (pkg / "core" / "bad.py").write_text(
+        "from advanced_scrapper_tpu.obs import telemetry\n"
+        "def f():\n"
+        "    import advanced_scrapper_tpu.pipeline.dedup\n"
+    )
+    (pkg / "index" / "bad.py").write_text(
+        "def g():\n"
+        "    from advanced_scrapper_tpu.pipeline.scraper import run_scraper\n"
+    )
+    (pkg / "index" / "ok.py").write_text(
+        "from advanced_scrapper_tpu.obs import telemetry\n"  # allowed here
+    )
+    problems = lint_imports.lint(str(tmp_path))
+    assert len(problems) == 3, problems
+    assert any("core/ must not import obs/" in p for p in problems)
+    assert any("core/ must not import pipeline/" in p for p in problems)
+    assert any("index/ must not import pipeline/" in p for p in problems)

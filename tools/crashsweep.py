@@ -48,6 +48,8 @@ SWEEP_CHARS = list("abc123")
 
 SCRAPE_URLS = 80
 STREAM_DOCS = 40
+PINDEX_DOCS = 64
+PINDEX_BANDS = 8
 
 
 # -- deterministic synthetic data -------------------------------------------
@@ -204,10 +206,69 @@ def child_stream(case_dir: str, seed: int) -> int:
     return 0
 
 
+def _pindex_doc_keys(i: int):
+    """Deterministic uint64 band keys for synthetic doc ``i``; every doc
+    with ``i % 7 == 3`` shares its keys with doc ``i - 3`` (a planted
+    near-dup the index must catch across any kill/restart boundary)."""
+    import numpy as np
+
+    src = i - 3 if (i % 7 == 3 and i >= 3) else i
+    x = (np.arange(PINDEX_BANDS, dtype=np.uint64)
+         + np.uint64(src * 1000 + 1)) * np.uint64(0x9E3779B97F4A7C15)
+    x ^= x >> np.uint64(29)
+    return x
+
+
+def _pindex_done_key(i: int):
+    from advanced_scrapper_tpu.utils.bloom import hash_key64
+
+    return hash_key64(f"L{i}")
+
+
+def child_pindex(case_dir: str, seed: int) -> int:
+    """Persistent-index ingest: probe-before-insert with the url key as the
+    done marker, ONE atomic WAL record per doc (done key + band keys share
+    the batch), tight cut/compaction cadence so the kill window lands
+    inside WAL appends, segment cuts and compaction manifest swaps."""
+    import numpy as np
+
+    from advanced_scrapper_tpu.index import PersistentIndex
+
+    idx = PersistentIndex(
+        os.path.join(case_dir, "pindex"),
+        cut_postings=4 * (PINDEX_BANDS + 1),   # a cut every ~4 docs
+        compact_segments=4,
+        compact_inline=True,  # deterministic: compaction is a kill target
+    )
+    _touch_marker(case_dir)
+    for i in range(PINDEX_DOCS):
+        done = np.array([_pindex_done_key(i)], np.uint64)
+        if int(idx.probe_batch(done)[0]) >= 0:
+            continue  # this doc fully landed before a kill
+        keys = _pindex_doc_keys(i)
+        cand = int(idx.probe_batch(keys[None, :])[0])
+        doc = int(idx.allocate_doc_ids(1)[0])
+        if cand >= 0:
+            # near-dup: only the done marker is posted
+            idx.insert_batch(done, np.array([doc], np.uint64))
+        else:
+            # kept: done marker + band postings in ONE WAL record — the
+            # crash atomicity unit (all-or-nothing on replay)
+            idx.insert_batch(
+                np.concatenate([done, keys]),
+                np.full((1 + PINDEX_BANDS,), doc, np.uint64),
+            )
+        time.sleep(0.01)  # widen the wall-clock kill window
+    idx.checkpoint()
+    idx.close()
+    return 0
+
+
 CHILDREN = {
     "harvest": child_harvest,
     "scrape": child_scrape,
     "stream": child_stream,
+    "pindex": child_pindex,
 }
 
 
@@ -319,12 +380,74 @@ def verify_stream(case_dir: str) -> list[str]:
     return problems
 
 
-SAFETY_CHECKS = {"harvest": check_harvest_safety, "stream": check_stream_safety}
+def check_pindex_safety(case_dir: str) -> list[str]:
+    """Kill-point invariant: the persistent index OPENS from whatever the
+    crash left (manifest whole-or-previous, orphans swept, WAL torn tail
+    dropped) and holds no duplicated posting."""
+    pdir = os.path.join(case_dir, "pindex")
+    if not os.path.isdir(pdir):
+        return []
+    from advanced_scrapper_tpu.index import PersistentIndex
+
+    try:
+        # read_only: the checker must OBSERVE the kill-point state, not
+        # repair it (and must never sweep a directory it does not own)
+        idx = PersistentIndex(pdir, read_only=True)
+    except Exception as e:
+        return [f"index unopenable at kill point: {e}"]
+    try:
+        keys, _docs = idx.dump_postings()
+        if len(keys) != len(set(keys.tolist())):
+            return ["duplicated postings at kill point"]
+    finally:
+        idx.close()
+    return []
+
+
+def verify_pindex(case_dir: str) -> list[str]:
+    """Convergence: after the clean resume, the live posting-key set equals
+    the never-killed oracle's — every done marker, every kept doc's band
+    keys, nothing lost, nothing duplicated."""
+    problems = check_pindex_safety(case_dir)
+    from advanced_scrapper_tpu.index import PersistentIndex
+
+    idx = PersistentIndex(os.path.join(case_dir, "pindex"), read_only=True)
+    try:
+        keys, _docs = idx.dump_postings()
+    finally:
+        idx.close()
+    got = set(keys.tolist())
+    expect: set[int] = set()
+    for i in range(PINDEX_DOCS):
+        expect.add(_pindex_done_key(i))
+        if not (i % 7 == 3 and i >= 3):  # planted dups post no band keys
+            expect.update(int(k) for k in _pindex_doc_keys(i))
+    if got != expect:
+        problems.append(
+            f"postings lost/invented: missing={len(expect - got)} "
+            f"extra={len(got - expect)}"
+        )
+    if len(keys) != len(got):
+        problems.append("duplicated postings after resume")
+    return problems
+
+
+SAFETY_CHECKS = {
+    "harvest": check_harvest_safety,
+    "stream": check_stream_safety,
+    "pindex": check_pindex_safety,
+}
 VERIFIERS = {
     "harvest": verify_harvest,
     "scrape": verify_scrape,
     "stream": verify_stream,
+    "pindex": verify_pindex,
 }
+
+#: chaos specs that land the pindex kill-points INSIDE each durability
+#: mechanism: the WAL append, the segment-cut atomic write, and the
+#: cut/compaction manifest swap (`only=` scopes injection by substring)
+PINDEX_CHAOS_TARGETS = ("wal-", "seg-", "manifest.json")
 
 
 # -- parent driver -----------------------------------------------------------
@@ -435,9 +558,14 @@ def sweep_workload(
     chaos_kills: int = 0,
     seed: int = 0,
     kill_window: tuple[float, float] = (0.03, 0.6),
+    chaos_only: tuple[str, ...] | None = None,
 ) -> dict:
     """Seeded sweep of one workload: ``sigkills`` wall-clock SIGKILL
-    instants plus ``chaos_kills`` in-write ``os._exit`` crash points."""
+    instants plus ``chaos_kills`` in-write ``os._exit`` crash points.
+
+    ``chaos_only`` scopes each chaos case's injection to one path
+    substring, cycling through the tuple — how the pindex sweep aims its
+    kill-points inside WAL appends, segment cuts and manifest swaps."""
     rng = random.Random(f"crashsweep|{workload}|{seed}")
     cases = []
     for i in range(sigkills):
@@ -459,6 +587,12 @@ def sweep_workload(
         cases.append(rec)
     for i in range(chaos_kills):
         spec = f"seed={seed * 100 + i},crash=0.08,short_write=0.03,exit=1"
+        if chaos_only:
+            target = chaos_only[i % len(chaos_only)]
+            # targeted: fault the ONE mechanism hard so a kill actually
+            # lands inside it (the untargeted rates are tuned for runs
+            # that touch thousands of files; a scoped run touches few)
+            spec = f"seed={seed * 100 + i},crash=0.25,short_write=0.1,exit=1,only={target}"
         cases.append(
             run_case(
                 workload,
@@ -491,7 +625,7 @@ def main(argv=None) -> int:
     import tempfile
 
     base = args.dir or tempfile.mkdtemp(prefix="crashsweep-")
-    per = max(1, args.kills // 3)
+    per = max(1, args.kills // 4)
     report = {
         "seed": args.seed,
         "workloads": [
@@ -502,9 +636,17 @@ def main(argv=None) -> int:
                 "scrape", base, sigkills=per - 1, chaos_kills=1, seed=args.seed
             ),
             sweep_workload(
+                "pindex",
+                base,
+                sigkills=max(1, per - 3),
+                chaos_kills=3,
+                seed=args.seed,
+                chaos_only=PINDEX_CHAOS_TARGETS,
+            ),
+            sweep_workload(
                 "stream",
                 base,
-                sigkills=args.kills - 2 * per - 1,
+                sigkills=args.kills - 3 * per - 1,
                 chaos_kills=1,
                 seed=args.seed,
                 kill_window=(0.05, 1.2),

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Layering linter: mechanical enforcement of the package import rules.
+
+The tree has an intended layering (README "Layout"): leaf layers hold pure
+math and host runtime (``core/``, ``ops/``, ``utils/``), the durable index
+(``index/``) sits on storage + obs only, and orchestration (``pipeline/``),
+transports (``net/``) and telemetry (``obs/``) sit above.  Nothing enforced
+it until now — one convenience import from ``ops`` into ``pipeline`` would
+silently invert the tree and make the kernels untestable without the whole
+runtime.
+
+Rules (banned prefixes per source layer)::
+
+    core/, ops/, utils/  must not import  pipeline/, net/, obs/
+    index/               must not import  pipeline/
+
+Every ``import``/``from`` statement is found by walking the AST — including
+function-local imports, which the hot paths use deliberately — so a lazy
+import cannot dodge the rule.  Wired as a tier-1 test in
+``tests/test_tools.py``; run standalone::
+
+    python tools/lint_imports.py          # exit 0 clean, 1 with findings
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = "advanced_scrapper_tpu"
+
+#: source layer (top-level package dir) → banned target layers
+RULES: dict[str, tuple[str, ...]] = {
+    "core": ("pipeline", "net", "obs"),
+    "ops": ("pipeline", "net", "obs"),
+    "utils": ("pipeline", "net", "obs"),
+    "index": ("pipeline",),
+}
+
+
+def _imported_modules(tree: ast.AST):
+    """Yield ``(lineno, module_name)`` for every import in the file, at any
+    nesting depth."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                yield node.lineno, alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.level == 0:  # absolute imports only;
+                yield node.lineno, node.module   # the tree uses no relative ones
+
+
+def check_file(path: str, layer: str, banned: tuple[str, ...]) -> list[str]:
+    with open(path, "rb") as fh:
+        try:
+            tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError as e:
+            return [f"{path}: unparseable ({e})"]
+    problems = []
+    for lineno, mod in _imported_modules(tree):
+        for target in banned:
+            prefix = f"{PACKAGE}.{target}"
+            if mod == prefix or mod.startswith(prefix + "."):
+                problems.append(
+                    f"{path}:{lineno}: {layer}/ must not import {target}/ "
+                    f"(imports {mod})"
+                )
+    return problems
+
+
+def lint(root: str = REPO) -> list[str]:
+    problems: list[str] = []
+    pkg_root = os.path.join(root, PACKAGE)
+    for layer, banned in sorted(RULES.items()):
+        layer_dir = os.path.join(pkg_root, layer)
+        if not os.path.isdir(layer_dir):
+            continue
+        for dirpath, _dirs, files in os.walk(layer_dir):
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    problems += check_file(
+                        os.path.join(dirpath, name), layer, banned
+                    )
+    return problems
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--root", default=REPO, help="repo root to lint")
+    args = ap.parse_args(argv)
+    problems = lint(args.root)
+    for p in problems:
+        print(p, file=sys.stderr)
+    if not problems:
+        print(f"lint_imports: {len(RULES)} layers clean")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
