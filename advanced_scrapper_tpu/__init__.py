@@ -22,6 +22,6 @@ Layer map (successor of the reference's five de-facto layers, SURVEY.md §1):
 
 from advanced_scrapper_tpu.config import Config, default_config
 
-__version__ = "0.3.0"
+__version__ = "0.5.0"
 
 __all__ = ["Config", "default_config", "__version__"]

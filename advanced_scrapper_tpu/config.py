@@ -168,6 +168,20 @@ class DedupConfig:
     #   by this × ~80 B)
     index_compact_segments: int = 8    # persist mode: live-segment count
     #   that triggers background compaction (0 disables)
+    index_fleet: str = ""        # persist mode: remote index fleet spec
+    #   ("host:port|host:port;host:port|..." — ';' separates shards, '|'
+    #   separates a shard's primary/replica; see index/fleet.py).  Empty =
+    #   local single-node PersistentIndex (the PR 4 behaviour).  When set,
+    #   the stream_index="persist" path talks to IndexShardServer nodes
+    #   through ShardedIndexClient: consistent-hashed band-key space,
+    #   synchronous replication, lease-TTL-style failover with
+    #   health-checked promotion, journaled local spill when a shard is
+    #   fully dark.
+    index_fleet_timeout: float = 5.0   # per-RPC deadline (seconds)
+    index_fleet_retries: int = 2       # transport retries per call (same
+    #   request id; the shard's idempotency nets make redelivery safe)
+    index_fleet_health_checks: int = 2  # consecutive pings a replica must
+    #   answer before being promoted to write target
     ckpt_every_batches: int = 16  # stream-index checkpoint cadence, in
     #   device batches: the scraper persists the dedup index every N
     #   processed batches (persist: WAL fsync + due segment cut — O(new
@@ -200,6 +214,19 @@ class FeedConfig:
     min_queue_length: int = 10        # ref client1.py:24
     client_threads: int = 8           # ref client1.py:21
     client_rate: float = 8.0          # ref client1.py:18
+    lease_ttl: float = 30.0           # seconds without any complete frame
+    #   (heartbeats count) before a client's leases are requeued and its
+    #   connection cut — a hung-but-connected worker must not strand its
+    #   urls until TCP notices.  0 disables (disconnect-only reclaim, the
+    #   pre-fleet behaviour).
+    heartbeat_interval: float = 0.0   # client heartbeat cadence; 0 = auto
+    #   (lease_ttl / 4, never more than once a second of idleness)
+    max_frame_bytes: int = 16 << 20   # NDJSON line-reassembly cap: a peer
+    #   that never sends a newline is cut off here instead of growing the
+    #   buffer without bound (the drop is counted in telemetry)
+    connect_retries: int = 5          # LeaseClient initial-connect attempts
+    connect_backoff: float = 0.05     # backoff base (capped exponential
+    #   with jitter, cap 2 s) between connect attempts
 
 
 @dataclass(frozen=True)

@@ -14,6 +14,22 @@ from __future__ import annotations
 from importlib import import_module
 from typing import Callable, Protocol
 
+#: the plugin output schema (ref constant_rate_scrapper.py:320-330): every
+#: ``extract_article_data`` dict writes these columns plus ``url``.  Defined
+#: at the extractor boundary — pipeline AND net both consume them, and
+#: ``net/`` must not import ``pipeline/`` (tools/lint_imports.py).
+SUCCESS_FIELDS = [
+    "url",
+    "datetime",
+    "ticker_symbols",
+    "author",
+    "source",
+    "source_url",
+    "title",
+    "article",
+]
+FAILED_FIELDS = ["url", "error"]
+
 _REGISTRY: dict[str, Callable] = {}
 
 

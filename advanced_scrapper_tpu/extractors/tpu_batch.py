@@ -243,16 +243,30 @@ class TpuBatchBackend:
             # filters): band postings and the exact-url stage.  Doc ids are
             # allocated from the bands index and shared, so every dup mark
             # attributes into one id space.
-            self._pindex = PersistentIndex(
-                os.path.join(self._index_dir, "bands"),
-                cut_postings=self.cfg.index_cut_postings,
-                compact_segments=self.cfg.index_compact_segments,
-            )
-            self._pindex_urls = PersistentIndex(
-                os.path.join(self._index_dir, "urls"),
-                cut_postings=self.cfg.index_cut_postings,
-                compact_segments=self.cfg.index_compact_segments,
-            )
+            if self.cfg.index_fleet:
+                # remote fleet (DedupConfig.index_fleet): the same two key
+                # spaces live on every IndexShardServer; the local index
+                # dir holds only the spill journals for dark-shard
+                # degraded mode
+                from advanced_scrapper_tpu.index.fleet import open_fleet_index
+
+                self._pindex = open_fleet_index(
+                    self.cfg, self._index_dir, space="bands"
+                )
+                self._pindex_urls = open_fleet_index(
+                    self.cfg, self._index_dir, space="urls"
+                )
+            else:
+                self._pindex = PersistentIndex(
+                    os.path.join(self._index_dir, "bands"),
+                    cut_postings=self.cfg.index_cut_postings,
+                    compact_segments=self.cfg.index_compact_segments,
+                )
+                self._pindex_urls = PersistentIndex(
+                    os.path.join(self._index_dir, "urls"),
+                    cut_postings=self.cfg.index_cut_postings,
+                    compact_segments=self.cfg.index_compact_segments,
+                )
             # allocation comes from the bands index but the ids are also
             # posted into the urls sub-index; union the durable floors so
             # a crash before the bands index saw an id durably can never
@@ -509,8 +523,10 @@ class TpuBatchBackend:
 
         if not fs.exists(path):
             return False
-        st = self._pindex.stats()
-        if st["segment_postings"] or st["wal_postings"] or st["next_doc_id"]:
+        # emptiness probe that holds for BOTH index flavours: the local
+        # PersistentIndex and the fleet client (whose stats() is a
+        # per-shard list, not the flat dict)
+        if self._pindex.doc_id_floor() or self._pindex.posting_count():
             return False  # non-empty index: never double-import
         try:
             with np.load(path) as data:

@@ -1,0 +1,1058 @@
+"""Replicated, fault-tolerant sharded index fleet — the client half.
+
+:class:`ShardedIndexClient` presents the :class:`~.store.PersistentIndex`
+API (``probe_batch`` / ``insert_batch`` / ``check_and_add_batch`` /
+``allocate_doc_ids`` / ``log_names`` / ``doc_id_floor``) over a fleet of
+:class:`~.remote.IndexShardServer` nodes, so every existing caller — the
+engine's ``dedup_against_index``, the TPU batch backend's persist mode —
+scales past one disk by changing a config string, not a call site.
+
+**Topology.**  The uint64 band-key space is consistent-hashed (virtual
+nodes on a ring) into N shards; each shard is a primary plus a
+configurable replica.  All postings for a key live on exactly one shard,
+so a probe's global minimum doc id is the minimum over per-shard answers
+— the property that keeps fleet attribution byte-equal to a single-node
+index.
+
+**Writes** replicate synchronously: a posting batch is acked only when
+every live node of its shard applied it (same request id on each — the
+transport's idempotency cache and the shard's semantic insert filter make
+redelivery harmless).  **Reads** go to the shard's current write target,
+min-combined with the local spill overlay.
+
+**Failover.**  A node that misses its deadline is marked down and counted.
+If it was the write target, the shard enters *promotion*: reads move to
+the surviving replica immediately; writes spill until the candidate has
+answered ``health_checks`` consecutive pings, then it is promoted and the
+spill journal replays into it.  A shard with NO reachable node degrades
+gracefully: writes journal to a local WAL (crash-safe through the fsio
+seam, reloaded on client restart) with an in-memory overlay answering
+probes for the spilled postings, and the journal replays — original
+request ids — when any node returns.  Degraded probes that might miss
+history are counted, never raised: the pipeline keeps flowing.
+
+**The live-node invariant.**  Every write a shard ACKS is also recorded
+in a *gap ledger* for each node that missed it (dead, or failed the
+call); a returning node must absorb its ledger before it rejoins.  So
+``live ⇒ holding every acked posting``, and promotion may safely elect
+any live node — a replica that was briefly down while the primary took
+writes can never be promoted into silent data loss.  A ledger that
+outgrows ``GAP_LIMIT_POSTINGS`` is dropped and its node sits out this
+client's lifetime (counted; an operator resync is cheaper than
+unbounded client RAM).
+
+Every edge is on the telemetry plane: per-shard RPC latency histograms,
+retry / failover / promotion / spill / replay counters, and a
+``fleet_status()`` dict for ``/status``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from advanced_scrapper_tpu.index.store import NO_DOC, resolve_intra_batch
+from advanced_scrapper_tpu.index.wal import WriteAheadLog, replay_wal
+from advanced_scrapper_tpu.net.rpc import RpcClient, RpcUnavailable
+
+__all__ = [
+    "FleetSpec",
+    "ShardedIndexClient",
+    "open_fleet_index",
+    "ring_assign",
+]
+
+
+def open_fleet_index(cfg, index_dir: str, *, space: str = "bands", **kw):
+    """THE fleet-client factory — every call site (the TPU batch
+    backend's persist mode, ``NearDupEngine.open_stream_index``) builds
+    its :class:`ShardedIndexClient` here, so the knob-to-constructor
+    mapping and the spill layout can never drift between paths.
+
+    ``cfg`` is anything carrying the ``DedupConfig`` fleet fields
+    (``index_fleet`` / ``index_fleet_timeout`` / ``index_fleet_retries``
+    / ``index_fleet_health_checks``); ``index_dir`` is the LOCAL
+    directory — in fleet mode it holds only the spill journals."""
+    return ShardedIndexClient(
+        FleetSpec.parse(cfg.index_fleet),
+        space=space,
+        spill_dir=os.path.join(index_dir, "spill"),
+        timeout=cfg.index_fleet_timeout,
+        retries=cfg.index_fleet_retries,
+        health_checks=cfg.index_fleet_health_checks,
+        **kw,
+    )
+
+_I64_MAX = np.iinfo(np.int64).max
+
+
+# -- topology ----------------------------------------------------------------
+
+@dataclass(frozen=True)
+class FleetSpec:
+    """Parsed fleet topology: ``shards[i]`` is that shard's replica set,
+    primary first.  Wire syntax (the ``DedupConfig.index_fleet`` string)::
+
+        host:port|host:port ; host:port|host:port ; ...
+
+    ``;`` separates shards, ``|`` separates a shard's replicas.
+    Whitespace is ignored.  One shard, one node is valid (a remote
+    single-node index with no failover)."""
+
+    shards: tuple[tuple[tuple[str, int], ...], ...]
+
+    @classmethod
+    def parse(cls, spec: str) -> "FleetSpec":
+        shards = []
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            nodes = []
+            for ep in part.split("|"):
+                ep = ep.strip()
+                if not ep:
+                    continue
+                host, _, port = ep.rpartition(":")
+                if not host or not port.isdigit():
+                    raise ValueError(
+                        f"bad fleet endpoint {ep!r} in {spec!r} "
+                        "(want host:port|host:port;host:port|...)"
+                    )
+                nodes.append((host, int(port)))
+            if nodes:
+                shards.append(tuple(nodes))
+        if not shards:
+            raise ValueError(f"fleet spec {spec!r} names no shards")
+        return cls(shards=tuple(shards))
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.shards)
+
+
+_RING_CACHE: dict[tuple[int, int], tuple[np.ndarray, np.ndarray]] = {}
+
+
+def _ring(num_shards: int, vnodes: int) -> tuple[np.ndarray, np.ndarray]:
+    """Sorted ring points + owning shard per point.  Pure function of
+    ``(num_shards, vnodes)`` — every client of a fleet, in every process,
+    on every run, maps a key to the same shard."""
+    got = _RING_CACHE.get((num_shards, vnodes))
+    if got is not None:
+        return got
+    pts, owner = [], []
+    for s in range(num_shards):
+        for v in range(vnodes):
+            h = hashlib.blake2b(
+                f"astpu-fleet|{s}|{v}".encode(), digest_size=8
+            ).digest()
+            pts.append(int.from_bytes(h, "little"))
+            owner.append(s)
+    pts = np.asarray(pts, np.uint64)
+    owner = np.asarray(owner, np.int32)
+    order = np.argsort(pts)
+    out = (pts[order], owner[order])
+    _RING_CACHE[(num_shards, vnodes)] = out
+    return out
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer: decorrelates band keys from ring positions
+    (band keys are themselves hashes, but cheap insurance against any
+    structure the banding scheme leaves in the low bits)."""
+    x = x.astype(np.uint64, copy=True)
+    x ^= x >> np.uint64(30)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(27)
+    x *= np.uint64(0x94D049BB133111EB)
+    x ^= x >> np.uint64(31)
+    return x
+
+
+def ring_assign(
+    keys: np.ndarray, num_shards: int, vnodes: int = 64
+) -> np.ndarray:
+    """``int32[n]`` owning shard per uint64 key (consistent-hash ring:
+    first ring point clockwise of the mixed key, wrapping)."""
+    if num_shards == 1:
+        return np.zeros(keys.shape, np.int32)
+    pts, owner = _ring(num_shards, vnodes)
+    ix = np.searchsorted(pts, _mix64(np.asarray(keys, np.uint64)))
+    return owner[ix % len(pts)]
+
+
+# -- per-shard state ---------------------------------------------------------
+
+@dataclass
+class _Node:
+    address: tuple[str, int]
+    client: RpcClient
+    alive: bool = True
+
+
+@dataclass
+class _Shard:
+    sid: int
+    nodes: list[_Node]
+    write_target: int = 0          # index into nodes
+    promoting: bool = False        # write target lost, candidate unproven
+    replaying: bool = False        # a spill replay is on this thread's stack
+    last_revive: float = 0.0       # monotonic stamp of the last dead-node ping
+    pending: list = field(default_factory=list)  # [(request_id, keys, docs)]
+    overlay: dict = field(default_factory=dict)  # key → min doc (spilled)
+    gaps: dict = field(default_factory=dict)     # node ix → [(rid, keys, docs)]
+    #   writes ACKED by the shard while this node was unreachable — the
+    #   backfill a returning node must absorb BEFORE it may rejoin (else a
+    #   later promotion could elect a replica missing acked postings)
+    gap_overflow: set = field(default_factory=set)  # node ix: gap dropped,
+    #   node is out for this client's lifetime (needs operator resync)
+    journal: WriteAheadLog | None = None
+    lock: threading.RLock = field(default_factory=threading.RLock)
+
+    def live_nodes(self) -> list[_Node]:
+        return [n for n in self.nodes if n.alive]
+
+
+class ShardedIndexClient:
+    """Fleet-backed drop-in for :class:`~.store.PersistentIndex`."""
+
+    _seq_lock = threading.Lock()
+    _seq = 0
+
+    def __init__(
+        self,
+        spec: FleetSpec | str,
+        *,
+        space: str = "bands",
+        spill_dir: str | None = None,
+        timeout: float = 5.0,
+        retries: int = 2,
+        health_checks: int = 2,
+        health_timeout: float = 0.5,
+        vnodes: int = 64,
+        connect=None,
+        seed: int = 0,
+        fs=None,
+    ):
+        """``spill_dir`` holds one journal per shard (``shardN-<space>
+        .spill``); ``None`` disables the durable journal (spills are then
+        memory-only — fine for tests, wrong for production).  ``connect``
+        is the chaos seam: a dialer wrapped under every node connection.
+        """
+        self.spec = spec if isinstance(spec, FleetSpec) else FleetSpec.parse(spec)
+        self.space = space
+        self.spill_dir = spill_dir
+        self.timeout = timeout
+        self.health_checks = health_checks
+        self.health_timeout = health_timeout
+        self.vnodes = vnodes
+        from advanced_scrapper_tpu.storage.fsio import default_fs
+
+        self._fs = fs or default_fs()
+        # request-id namespace unique ACROSS client processes: a server
+        # that outlived a previous client must never replay that client's
+        # cached response for this one's fresh request
+        self._token = os.urandom(4).hex()
+        self._floor = 0           # local doc-id high water (allocator cache)
+        self._floor_known = False  # True once a durable floor was synced
+        #   from the allocator shard — the gate on degraded local
+        #   allocation (see allocate_doc_ids)
+        self._postings_written = 0  # client-side view for cheap gauges
+        self._floor_lock = threading.Lock()
+        self._closed = False
+        self._shards: list[_Shard] = []
+        for sid, nodes in enumerate(self.spec.shards):
+            self._shards.append(
+                _Shard(
+                    sid=sid,
+                    nodes=[
+                        _Node(
+                            address=addr,
+                            client=RpcClient(
+                                addr,
+                                timeout=timeout,
+                                retries=retries,
+                                connect=connect,
+                                seed=seed * 1000 + sid * 10 + k,
+                            ),
+                        )
+                        for k, addr in enumerate(nodes)
+                    ],
+                )
+            )
+        self._pool = ThreadPoolExecutor(
+            max_workers=min(16, 2 * len(self._shards)),
+            thread_name_prefix=f"astpu-fleet-{space}",
+        )
+        self._instrument()
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+            self._reload_spill()
+            for sh in self._shards:
+                if sh.pending:  # best-effort recovery replay at open
+                    self._ensure_write_target(sh)
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _instrument(self) -> None:
+        from advanced_scrapper_tpu.obs import telemetry
+
+        with ShardedIndexClient._seq_lock:
+            fid = f"{ShardedIndexClient._seq}:{self.space}"
+            ShardedIndexClient._seq += 1
+        self._fid = fid
+        self._m_rpc_s = {}
+        for sid in range(len(self._shards)):
+            for method in ("probe", "insert"):
+                self._m_rpc_s[(sid, method)] = telemetry.histogram(
+                    "astpu_fleet_rpc_seconds",
+                    "per-shard RPC wall clock, by method",
+                    fleet=fid, shard=str(sid), method=method,
+                )
+        mk = lambda name, help: telemetry.counter(name, help, fleet=fid)  # noqa: E731
+        self._m_failovers = mk(
+            "astpu_fleet_failovers_total",
+            "node deadline/connection failures that re-routed traffic",
+        )
+        self._m_promotions = mk(
+            "astpu_fleet_promotions_total",
+            "replicas promoted to write target after health checks",
+        )
+        self._m_spilled = mk(
+            "astpu_fleet_spilled_postings_total",
+            "postings journaled locally because no shard node could ack",
+        )
+        self._m_replayed = mk(
+            "astpu_fleet_replayed_postings_total",
+            "spilled postings successfully replayed into a recovered shard",
+        )
+        self._m_degraded = mk(
+            "astpu_fleet_degraded_probes_total",
+            "probe sub-queries answered without any live shard node "
+            "(overlay-only: historical postings on that shard were invisible)",
+        )
+        self._m_rejoins = mk(
+            "astpu_fleet_rejoins_total",
+            "dead nodes that absorbed their gap ledger and came back",
+        )
+        self._m_backfilled = mk(
+            "astpu_fleet_backfilled_postings_total",
+            "acked-elsewhere postings delivered to returning nodes before "
+            "their rejoin",
+        )
+        telemetry.gauge_fn(
+            "astpu_fleet_gap_postings",
+            lambda s: sum(
+                int(k.size)
+                for sh in s._shards
+                for gap in sh.gaps.values()
+                for (_r, k, _d) in gap
+            ),
+            owner=self, fleet=fid,
+            help="acked postings awaiting backfill into unreachable nodes",
+        )
+        telemetry.gauge_fn(
+            "astpu_fleet_shards_healthy",
+            lambda s: sum(
+                1 for sh in s._shards if sh.live_nodes() and not sh.promoting
+            ),
+            owner=self, fleet=fid,
+            help="shards with a proven write target",
+        )
+        telemetry.gauge_fn(
+            "astpu_fleet_spill_pending_postings",
+            lambda s: sum(
+                int(k.size) for sh in s._shards for (_r, k, _d) in sh.pending
+            ),
+            owner=self, fleet=fid,
+            help="spilled postings awaiting replay",
+        )
+
+    def fleet_status(self) -> dict:
+        """JSON-able fleet view for ``/status`` dashboards."""
+        shards = []
+        for sh in self._shards:
+            with sh.lock:
+                shards.append(
+                    {
+                        "shard": sh.sid,
+                        "nodes": [
+                            {
+                                "address": f"{n.address[0]}:{n.address[1]}",
+                                "alive": n.alive,
+                                "write_target": i == sh.write_target,
+                            }
+                            for i, n in enumerate(sh.nodes)
+                        ],
+                        "promoting": sh.promoting,
+                        "spill_pending": sum(int(k.size) for _r, k, _d in sh.pending),
+                    }
+                )
+        return {"space": self.space, "shards": shards}
+
+    # -- spill journal -----------------------------------------------------
+
+    def _journal_path(self, sh: _Shard) -> str:
+        return os.path.join(
+            self.spill_dir, f"shard{sh.sid}-{self.space}.spill"
+        )
+
+    #: replay/reload chunk size — 256k postings ≈ 4 MiB per insert frame,
+    #: far under the RPC frame cap (one giant reloaded journal must never
+    #: build a frame the server is REQUIRED to refuse)
+    REPLAY_CHUNK_POSTINGS = 1 << 18
+
+    def _reload_spill(self) -> None:
+        """Client restart: re-arm pending replay from the on-disk journals
+        (the 'replayed on recovery' half of the degraded-mode contract),
+        chunked so no single replay frame can exceed the RPC cap.
+
+        A torn tail (client SIGKILLed mid spill append) is truncated away
+        BEFORE any reopen — the WAL reopen contract (``replay_wal``):
+        appending in ``ab`` mode behind torn garbage would make every
+        later spilled posting unreplayable forever."""
+        for sh in self._shards:
+            path = self._journal_path(sh)
+            keys, docs, end = replay_wal(path, fs=self._fs)
+            if self._fs.exists(path) and self._fs.size(path) > end:
+                try:
+                    with self._fs.open(path, "r+b") as fh:
+                        fh.truncate(end)
+                except OSError:
+                    pass
+            if keys.size:
+                for ci, lo in enumerate(
+                    range(0, keys.size, self.REPLAY_CHUNK_POSTINGS)
+                ):
+                    hi = lo + self.REPLAY_CHUNK_POSTINGS
+                    rid = (
+                        f"spill-{self._token}-{self._fid}-s{sh.sid}"
+                        f"-reload{ci}"
+                    )
+                    sh.pending.append((rid, keys[lo:hi], docs[lo:hi]))
+                for k, d in zip(keys.tolist(), docs.tolist()):
+                    prev = sh.overlay.get(k)
+                    if prev is None or d < prev:
+                        sh.overlay[k] = d
+                sh.journal = WriteAheadLog(path, fs=self._fs)
+
+    def _spill(self, sh: _Shard, keys: np.ndarray, docs: np.ndarray, rid: str):
+        """No node could ack: journal + overlay, never raise."""
+        with sh.lock:
+            if self.spill_dir is not None:
+                try:
+                    if sh.journal is None:
+                        sh.journal = WriteAheadLog(
+                            self._journal_path(sh), fs=self._fs
+                        )
+                    sh.journal.append(keys, docs)
+                    sh.journal.sync()
+                except OSError:
+                    pass  # overlay still covers this process's lifetime
+            sh.pending.append((rid, keys, docs))
+            for k, d in zip(keys.tolist(), docs.tolist()):
+                prev = sh.overlay.get(k)
+                if prev is None or d < prev:
+                    sh.overlay[k] = d
+        self._m_spilled.inc(int(keys.size))
+        from advanced_scrapper_tpu.obs import trace
+
+        trace.record(
+            "event", "fleet.spill", shard=sh.sid, postings=int(keys.size)
+        )
+
+    def _drop_journal(self, sh: _Shard) -> None:
+        if sh.journal is not None:
+            sh.journal.close()
+            sh.journal = None
+        if self.spill_dir is not None:
+            try:
+                self._fs.remove(self._journal_path(sh))
+            except OSError:
+                pass
+
+    # -- node health / promotion ------------------------------------------
+
+    def _note_failure(self, sh: _Shard, node: _Node) -> None:
+        with sh.lock:
+            if not node.alive:
+                return
+            node.alive = False
+            if sh.nodes[sh.write_target] is node:
+                sh.promoting = True
+        self._m_failovers.inc()
+        from advanced_scrapper_tpu.obs import trace
+
+        trace.record(
+            "event", "fleet.failover", shard=sh.sid,
+            node=f"{node.address[0]}:{node.address[1]}",
+        )
+
+    def _try_revive(self, sh: _Shard) -> None:
+        """Ping dead nodes (cheap timeout, rate-limited so a dark shard
+        costs one ping round per interval, not per operation); a
+        responder must first absorb its gap ledger — every write the
+        shard ACKED while it was away — and only then rejoins, as a
+        replica, NOT as write target.  That invariant is what makes any
+        live node a safe promotion candidate: live ⇒ not missing any
+        acked posting."""
+        now = time.monotonic()
+        with sh.lock:
+            if now - sh.last_revive < self.health_timeout:
+                return
+            sh.last_revive = now
+        for ix, node in enumerate(sh.nodes):
+            if node.alive or ix in sh.gap_overflow:
+                continue
+            if not node.client.ping(timeout=self.health_timeout):
+                continue
+            with sh.lock:
+                gap = list(sh.gaps.get(ix, ()))
+            backfilled = 0
+            n_done = 0
+            for rid, keys, docs in gap:
+                try:
+                    node.client.call(
+                        "insert",
+                        {"space": self.space},
+                        [keys, docs],
+                        timeout=self.timeout,
+                        request_id=f"{rid}@{node.address[0]}:{node.address[1]}",
+                    )
+                    n_done += 1
+                    backfilled += int(keys.size)
+                except RpcUnavailable:
+                    break
+            with sh.lock:
+                # appends-only discipline (like _replay): drop exactly the
+                # prefix we delivered; anything appended meanwhile — or
+                # left by a mid-drain failure — keeps the node out until
+                # the next revive round finishes the job.  Re-check the
+                # overflow set AT COMMIT: a ledger that overflowed while
+                # we drained was dropped with writes we never delivered —
+                # that node must stay out, not rejoin half-backfilled.
+                if ix in sh.gap_overflow:
+                    continue
+                remaining = sh.gaps.get(ix, [])[n_done:]
+                if remaining:
+                    sh.gaps[ix] = remaining
+                else:
+                    sh.gaps.pop(ix, None)
+                    node.alive = True
+            if backfilled:
+                self._m_backfilled.inc(backfilled)
+            if node.alive:
+                self._m_rejoins.inc()
+
+    def _ensure_write_target(self, sh: _Shard) -> _Node | None:
+        """Advance the shard state machine; returns the proven write
+        target or ``None`` (shard fully down → caller spills).
+
+        Promotion is the health-checked path: a candidate replica must
+        answer ``health_checks`` consecutive pings before any write
+        lands on it, then the spill journal replays into it FIRST — so
+        the moment a promoted node serves reads it already holds every
+        posting this client ever acked or spilled for the shard."""
+        with sh.lock:
+            target = sh.nodes[sh.write_target]
+            healthy = target.alive and not sh.promoting
+        if healthy:
+            if sh.pending:
+                self._replay(sh)
+            return target if target.alive else None
+        # write target is down: look for a promotion candidate
+        self._try_revive(sh)
+        live = sh.live_nodes()
+        if not live:
+            return None
+        candidate = live[0]
+        for _ in range(self.health_checks):
+            if not candidate.client.ping(timeout=self.health_timeout):
+                self._note_failure(sh, candidate)
+                return None
+        promoted = False
+        with sh.lock:
+            # a racing thread may have promoted meanwhile — commit once
+            target = sh.nodes[sh.write_target]
+            if (target.alive and not sh.promoting) or not candidate.alive:
+                candidate = target if target.alive else candidate
+            else:
+                sh.write_target = sh.nodes.index(candidate)
+                sh.promoting = False
+                promoted = True
+        if promoted:
+            self._m_promotions.inc()
+            from advanced_scrapper_tpu.obs import trace
+
+            trace.record(
+                "event", "fleet.promotion", shard=sh.sid,
+                node=f"{candidate.address[0]}:{candidate.address[1]}",
+            )
+        if sh.pending:
+            self._replay(sh)
+        return candidate if candidate.alive else None
+
+    def _replay(self, sh: _Shard) -> None:
+        """Push the spill journal into the (recovered/promoted) shard
+        under the ORIGINAL request ids.
+
+        Runs WITHOUT ``sh.lock`` held across the RPCs — a replay of a few
+        batches at the full call timeout must not stall every probe and
+        status read on the shard.  The ``replaying`` flag makes this a
+        single-flight section (and stops ``_ensure_write_target`` from
+        re-entering it from inside the replay's own inserts); the commit
+        merges in any batches ``_spill`` appended while we were out."""
+        with sh.lock:
+            if sh.replaying or not sh.pending:
+                return
+            sh.replaying = True
+            batch = list(sh.pending)
+        done = 0
+        try:
+            still: list = []
+            for rid, keys, docs in batch:
+                if self._replicated_insert(sh, keys, docs, rid, allow_spill=False):
+                    done += int(keys.size)
+                else:
+                    still.append((rid, keys, docs))
+            with sh.lock:
+                # appends-only discipline: _spill appends, only THIS
+                # single-flight section removes — the snapshot's suffix
+                # is exactly what arrived while we replayed
+                sh.pending = still + sh.pending[len(batch):]
+                if not sh.pending:
+                    sh.overlay.clear()
+                    self._drop_journal(sh)
+        finally:
+            with sh.lock:
+                sh.replaying = False
+        if done:
+            self._m_replayed.inc(done)
+            from advanced_scrapper_tpu.obs import trace
+
+            trace.record("event", "fleet.replay", shard=sh.sid, postings=done)
+
+    # -- RPC fan-out internals --------------------------------------------
+
+    def _shard_probe(self, sh: _Shard, keys: np.ndarray) -> np.ndarray:
+        """Probe one shard's key subset → int64 min doc per key (-1 miss).
+        Prefers the write target (it holds everything acked); falls back
+        across replicas; a fully-dark shard answers from the overlay only
+        and counts the degradation."""
+        t0 = time.perf_counter()
+        hist = self._m_rpc_s[(sh.sid, "probe")]
+        order: list[_Node] = []
+        with sh.lock:
+            wt = sh.nodes[sh.write_target]
+        if wt.alive and not sh.promoting:
+            order.append(wt)
+        order += [n for n in sh.live_nodes() if n not in order]
+        docs = None
+        for node in order:
+            try:
+                _h, (docs,) = node.client.call(
+                    "probe",
+                    {"space": self.space},
+                    [keys],
+                    timeout=self.timeout,
+                )
+                break
+            except RpcUnavailable:
+                # transport fault only: a deterministic handler error
+                # (RpcRemoteError — bad space, operator typo) must stay
+                # LOUD, never quietly mark a healthy node dead
+                self._note_failure(sh, node)
+        if docs is None:
+            # promotion may still rescue a replica that was merely unproven
+            target = self._ensure_write_target(sh)
+            if target is not None:
+                try:
+                    _h, (docs,) = target.client.call(
+                        "probe", {"space": self.space}, [keys],
+                        timeout=self.timeout,
+                    )
+                except RpcUnavailable:
+                    self._note_failure(sh, target)
+        if docs is None:
+            self._m_degraded.inc(int(keys.size))
+            docs = np.full(keys.shape, -1, np.int64)
+        else:
+            docs = np.asarray(docs, np.int64)
+        with sh.lock:
+            # O(probed keys) lookups under the lock — never a full-dict
+            # copy, which would make every degraded probe O(spill size)
+            ov = (
+                np.fromiter(
+                    (sh.overlay.get(k, -1) for k in keys.tolist()),
+                    np.int64, keys.size,
+                )
+                if sh.overlay
+                else None
+            )
+        if ov is not None:
+            hit = ov >= 0
+            miss = docs < 0
+            docs = np.where(
+                hit & miss, ov, np.where(hit, np.minimum(docs, ov), docs)
+            )
+        hist.observe(time.perf_counter() - t0)
+        return docs
+
+    def _replicated_insert(
+        self,
+        sh: _Shard,
+        keys: np.ndarray,
+        docs: np.ndarray,
+        rid: str,
+        *,
+        allow_spill: bool = True,
+    ) -> bool:
+        """Write one shard's postings to EVERY live node (same request
+        id).  True iff at least one node — including a freshly promoted
+        one — durably applied it; on total failure the batch spills
+        (unless this IS the replay path).  Nodes that missed an ACKED
+        write get the batch recorded in their gap ledger: they must
+        absorb it before they may rejoin (``_try_revive``)."""
+        t0 = time.perf_counter()
+        hist = self._m_rpc_s[(sh.sid, "insert")]
+        target = self._ensure_write_target(sh)
+        acked_ix: set[int] = set()
+        for ix, node in enumerate(list(sh.nodes)):
+            if not node.alive:
+                continue
+            try:
+                node.client.call(
+                    "insert",
+                    {"space": self.space},
+                    [keys, docs],
+                    timeout=self.timeout,
+                    request_id=f"{rid}@{node.address[0]}:{node.address[1]}",
+                )
+                acked_ix.add(ix)
+            except RpcUnavailable:
+                self._note_failure(sh, node)
+        if not acked_ix and target is not None:
+            # every node died mid-write: one promotion attempt, then spill
+            target = self._ensure_write_target(sh)
+            if target is not None:
+                try:
+                    target.client.call(
+                        "insert",
+                        {"space": self.space},
+                        [keys, docs],
+                        timeout=self.timeout,
+                        request_id=f"{rid}@{target.address[0]}:{target.address[1]}",
+                    )
+                    acked_ix.add(sh.nodes.index(target))
+                except RpcUnavailable:
+                    self._note_failure(sh, target)
+        hist.observe(time.perf_counter() - t0)
+        acked = bool(acked_ix)
+        if acked:
+            with sh.lock:
+                for ix in range(len(sh.nodes)):
+                    if ix not in acked_ix:
+                        self._gap_append(sh, ix, rid, keys, docs)
+        elif allow_spill:
+            self._spill(sh, keys, docs, rid)
+        return acked
+
+    #: per-node gap ledger cap — beyond this many missed postings the
+    #: ledger is dropped and the node sits out this client's lifetime (an
+    #: operator resync is cheaper than unbounded client RAM)
+    GAP_LIMIT_POSTINGS = 1 << 20
+
+    def _gap_append(self, sh: _Shard, ix: int, rid, keys, docs) -> None:
+        """Record an acked write a node missed; caller holds ``sh.lock``.
+
+        If a racing ``_try_revive`` brought the node back between our
+        fan-out snapshot and this append, the node is live WITHOUT this
+        write — re-kill it so the next revive round drains the ledger;
+        the live-node invariant must hold unconditionally."""
+        if ix in sh.gap_overflow:
+            return
+        if sh.nodes[ix].alive:
+            sh.nodes[ix].alive = False
+            if sh.nodes[sh.write_target] is sh.nodes[ix]:
+                sh.promoting = True
+        gap = sh.gaps.setdefault(ix, [])
+        held = sum(int(k.size) for _r, k, _d in gap)
+        if held + int(keys.size) > self.GAP_LIMIT_POSTINGS:
+            sh.gaps.pop(ix, None)
+            sh.gap_overflow.add(ix)
+            from advanced_scrapper_tpu.obs import telemetry
+
+            telemetry.event_counter(
+                "astpu_fleet_gap_overflow_total",
+                "nodes dropped from the fleet for outliving their gap "
+                "ledger (operator must resync the node)",
+            ).inc()
+            return
+        gap.append((rid, keys, docs))
+
+    # -- PersistentIndex API ----------------------------------------------
+
+    def probe_batch(self, keys: np.ndarray) -> np.ndarray:
+        """``int64[B]`` earliest candidate doc per query row (-1 = none);
+        same contract (and same row-min combination) as the single-node
+        index, fanned out per shard in parallel."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        if keys.ndim == 1:
+            keys = keys[:, None]
+        B = keys.shape[0]
+        if B == 0:
+            return np.zeros((0,), np.int64)
+        flat = keys.ravel()
+        shard_of = ring_assign(flat, len(self._shards), self.vnodes)
+        best = np.full(flat.shape, _I64_MAX, np.int64)
+        futures = []
+        for sid in range(len(self._shards)):
+            ix = np.flatnonzero(shard_of == sid)
+            if ix.size == 0:
+                continue
+            futures.append(
+                (
+                    ix,
+                    self._pool.submit(
+                        self._shard_probe, self._shards[sid], flat[ix]
+                    ),
+                )
+            )
+        for ix, fut in futures:
+            docs = fut.result()
+            hit = docs >= 0
+            best[ix[hit]] = np.minimum(best[ix[hit]], docs[hit])
+        best = best.reshape(B, -1).min(axis=1)
+        return np.where(best == _I64_MAX, NO_DOC, best)
+
+    def insert_batch(self, keys: np.ndarray, docs: np.ndarray) -> None:
+        """Durably append postings, sharded + replicated; a dark shard
+        spills instead of raising."""
+        keys = np.ascontiguousarray(keys, dtype=np.uint64).ravel()
+        docs = np.ascontiguousarray(docs, dtype=np.uint64).ravel()
+        if keys.size == 0:
+            return
+        with self._floor_lock:
+            self._floor = max(self._floor, int(docs.max()) + 1)
+            self._postings_written += int(keys.size)
+        shard_of = ring_assign(keys, len(self._shards), self.vnodes)
+        futures = []
+        for sid in range(len(self._shards)):
+            ix = np.flatnonzero(shard_of == sid)
+            if ix.size == 0:
+                continue
+            sh = self._shards[sid]
+            rid = f"ins-{self._token}-{self._fid}-{sid}-{self._next_wid()}"
+            futures.append(
+                self._pool.submit(
+                    self._replicated_insert, sh, keys[ix], docs[ix], rid
+                )
+            )
+        for fut in futures:
+            fut.result()
+
+    _wid_lock = threading.Lock()
+    _wid = 0
+
+    def _next_wid(self) -> int:
+        with ShardedIndexClient._wid_lock:
+            ShardedIndexClient._wid += 1
+            return ShardedIndexClient._wid
+
+    def check_and_add_batch(
+        self, keys: np.ndarray, doc_ids: np.ndarray
+    ) -> np.ndarray:
+        """Sharded stream step, byte-equal to the single-node oracle:
+        fan-out probe → the SHARED intra-batch resolution
+        (:func:`~.store.resolve_intra_batch`) → replicated insert of the
+        fresh rows' postings."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        if keys.ndim == 1:
+            keys = keys[:, None]
+        doc_ids = np.ascontiguousarray(doc_ids, dtype=np.uint64).ravel()
+        B, nb = keys.shape
+        if B != doc_ids.size:
+            raise ValueError(f"{B} key rows vs {doc_ids.size} doc ids")
+        attr = resolve_intra_batch(
+            keys, doc_ids, np.asarray(self.probe_batch(keys))
+        )
+        fresh = attr < 0
+        if fresh.any():
+            self.insert_batch(
+                keys[fresh].ravel(), np.repeat(doc_ids[fresh], nb)
+            )
+        return attr
+
+    def allocate_doc_ids(self, n: int) -> np.ndarray:
+        """Monotonic uint64 ids from shard 0's durable allocator, floored
+        by the client-side high water (so failover to a lagging replica
+        can never reissue an id this client already referenced).  A fully
+        dark shard 0 degrades to local allocation from the high water —
+        but ONLY once this client has synced a durable floor at least
+        once this session: a fresh client that never reached the
+        allocator would otherwise restart at 0 and alias ids the fleet
+        already holds from earlier runs, silently re-pointing historical
+        attributions.  With no synced floor the darkness is an error."""
+        sh = self._shards[0]
+        with self._floor_lock:
+            floor = self._floor
+            floor_known = self._floor_known
+        target = self._ensure_write_target(sh)
+        ids = None
+        if target is not None:
+            try:
+                _h, (ids,) = target.client.call(
+                    "allocate",
+                    {"space": self.space, "n": int(n), "floor": floor},
+                    timeout=self.timeout,
+                )
+            except RpcUnavailable:
+                self._note_failure(sh, target)
+        synced = ids is not None
+        if ids is None and not floor_known:
+            raise RpcUnavailable(
+                f"cannot allocate doc ids for space {self.space!r}: shard 0 "
+                "is unreachable and no durable id floor was ever synced — "
+                "local allocation could reissue ids the fleet already holds"
+            )
+        if ids is None:
+            ids = np.arange(floor, floor + int(n), dtype=np.uint64)
+        ids = np.asarray(ids, np.uint64)
+        with self._floor_lock:
+            if synced:
+                self._floor_known = True
+            self._floor = max(self._floor, int(ids.max()) + 1 if ids.size else 0)
+        return ids
+
+    def posting_count(self) -> int:
+        """Postings THIS client wrote (acked or spilled) — the cheap gauge
+        accessor; a fleet-wide census would be an RPC fan-out per metrics
+        scrape (use :meth:`stats` for that, deliberately)."""
+        with self._floor_lock:
+            return self._postings_written
+
+    def doc_id_floor(self) -> int:
+        sh = self._shards[0]
+        target = self._ensure_write_target(sh)
+        if target is not None:
+            try:
+                h, _ = target.client.call(
+                    "floor", {"space": self.space}, timeout=self.timeout
+                )
+                with self._floor_lock:
+                    self._floor_known = True
+                    self._floor = max(self._floor, int(h["floor"]))
+            except RpcUnavailable:
+                self._note_failure(sh, target)
+        with self._floor_lock:
+            return self._floor
+
+    def raise_doc_id_floor(self, floor: int) -> None:
+        with self._floor_lock:
+            self._floor = max(self._floor, int(floor))
+
+    def log_names(self, doc_ids, names) -> None:
+        """Best-effort docmap on shard 0 (attribution-only, like local)."""
+        sh = self._shards[0]
+        target = self._ensure_write_target(sh)
+        if target is None:
+            return
+        try:
+            target.client.call(
+                "log_names",
+                {"space": self.space, "names": [str(x) for x in names]},
+                [np.asarray(doc_ids, np.uint64)],
+                timeout=self.timeout,
+            )
+        except RpcUnavailable:
+            self._note_failure(sh, target)
+
+    def checkpoint(self) -> None:
+        """Fan the durability point to every live node; spill journals
+        are already fsync'd at append time.  Also the periodic recovery
+        probe: a dark shard that came back replays its spill here."""
+        for sh in self._shards:
+            if sh.pending or not sh.live_nodes():
+                self._ensure_write_target(sh)
+            for node in sh.live_nodes():
+                try:
+                    node.client.call(
+                        "checkpoint", {"space": self.space}, timeout=self.timeout
+                    )
+                except RpcUnavailable:
+                    self._note_failure(sh, node)
+
+    def dump_postings(self) -> tuple[np.ndarray, np.ndarray]:
+        """Every live posting across the fleet + the un-replayed overlay —
+        the crashsweep verification surface, same contract as local.
+        Paged (``REPLAY_CHUNK_POSTINGS`` per RPC) so a grown shard never
+        produces a frame past the cap; meant to run quiescently — pages
+        are not one snapshot under concurrent inserts."""
+        parts_k, parts_d = [], []
+        for sh in self._shards:
+            target = self._ensure_write_target(sh)
+            if target is not None:
+                try:
+                    off = 0
+                    while True:
+                        h, (k, d) = target.client.call(
+                            "dump",
+                            {
+                                "space": self.space,
+                                "offset": off,
+                                "limit": self.REPLAY_CHUNK_POSTINGS,
+                            },
+                            timeout=self.timeout,
+                        )
+                        parts_k.append(np.asarray(k, np.uint64))
+                        parts_d.append(np.asarray(d, np.uint64))
+                        off += int(np.asarray(k).size)
+                        if off >= int(h.get("total", off)) or np.asarray(k).size == 0:
+                            break
+                except RpcUnavailable:
+                    self._note_failure(sh, target)
+            with sh.lock:
+                for _rid, k, d in sh.pending:
+                    parts_k.append(k)
+                    parts_d.append(d)
+        if not parts_k:
+            e = np.zeros((0,), np.uint64)
+            return e, e
+        return np.concatenate(parts_k), np.concatenate(parts_d)
+
+    def stats(self) -> dict:
+        out = {"space": self.space, "shards": []}
+        for sh in self._shards:
+            target = self._ensure_write_target(sh)
+            st = None
+            if target is not None:
+                try:
+                    st, _ = target.client.call(
+                        "stats", {"space": self.space}, timeout=self.timeout
+                    )
+                except RpcUnavailable:
+                    self._note_failure(sh, target)
+            out["shards"].append(st)
+        return out
+
+    def close(self) -> None:
+        """Release sockets + journals.  Spilled-but-unreplayed postings
+        stay in the on-disk journal for the next client's
+        ``_reload_spill`` — close is NOT a drop."""
+        if self._closed:
+            return
+        self._closed = True
+        self._pool.shutdown(wait=True)
+        for sh in self._shards:
+            if sh.journal is not None:
+                sh.journal.close()
+                sh.journal = None
+            for node in sh.nodes:
+                node.client.close()

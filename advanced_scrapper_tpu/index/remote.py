@@ -1,0 +1,356 @@
+"""Remote persistent-index shards: the server half of the index fleet.
+
+:class:`IndexShardServer` hosts one or more :class:`~.store.PersistentIndex`
+key spaces (``bands`` postings and the exact-``urls`` stage, mirroring the
+local two-sub-index layout) behind the length-framed RPC plane
+(``net/rpc.py``).  A shard owns a deterministic slice of the uint64
+band-key space (the fleet client's consistent-hash ring decides which);
+everything durable about it IS the wrapped ``PersistentIndex`` — WAL,
+segments, manifest swap, crash recovery — so a SIGKILLed shard process
+reopens with the exact guarantees the single-node crashsweep certified.
+
+Retry idempotency has two nets:
+
+- the transport replays cached responses for a duplicated request id
+  (``RpcServer``), which covers retries within one server lifetime;
+- ``insert`` is **semantically idempotent** across server restarts: the
+  handler drops any posting ``(key, doc)`` whose key already attributes
+  to a doc id ≤ ``doc``.  In the probe-before-insert protocols every
+  caller uses (``check_and_add``, done markers, url postings) a key is
+  only ever posted when absent, so the filter is a no-op on first
+  delivery and exactly cancels a redelivery — a retried batch can never
+  double-insert, and no future probe can tell the difference.
+
+``python -m advanced_scrapper_tpu.index.remote --dir D --port 0
+--port-file P`` serves a shard standalone (the crashsweep ``fleet``
+workload SIGKILLs these mid-WAL-append); the module imports no JAX, so a
+shard process is cheap to fork.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+import advanced_scrapper_tpu.net.rpc as rpc  # the ONE allowed net import
+
+from advanced_scrapper_tpu.index.store import PersistentIndex
+
+__all__ = ["IndexShardServer", "RemoteIndex", "serve_main"]
+
+DEFAULT_SPACES = ("bands", "urls")
+
+
+class IndexShardServer:
+    """One fleet shard: N persistent-index key spaces behind one RPC port."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        spaces=DEFAULT_SPACES,
+        cut_postings: int = 1 << 16,
+        compact_segments: int = 8,
+        compact_inline: bool = False,
+        max_frame: int = rpc.DEFAULT_MAX_FRAME,
+        frame_deadline: float = 30.0,
+        name: str = "",
+    ):
+        self.dir = directory
+        self.name = name or os.path.basename(directory.rstrip("/")) or "shard"
+        self._lock = threading.Lock()
+        self._stopped = False
+        self.indexes: dict[str, PersistentIndex] = {
+            sp: PersistentIndex(
+                os.path.join(directory, sp),
+                cut_postings=cut_postings,
+                compact_segments=compact_segments,
+                compact_inline=compact_inline,
+            )
+            for sp in spaces
+        }
+        self.server = rpc.RpcServer(
+            {
+                "probe": self._h_probe,
+                "insert": self._h_insert,
+                "check_and_add": self._h_check_and_add,
+                "allocate": self._h_allocate,
+                "log_names": self._h_log_names,
+                "floor": self._h_floor,
+                "stats": self._h_stats,
+                "dump": self._h_dump,
+                "checkpoint": self._h_checkpoint,
+            },
+            host=host,
+            port=port,
+            max_frame=max_frame,
+            frame_deadline=frame_deadline,
+            name=f"shard:{self.name}",
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def start(self) -> "IndexShardServer":
+        self.server.start()
+        return self
+
+    def stop(self) -> None:
+        """Idempotent: tests stop a 'killed' node and sweep everything
+        again in teardown."""
+        self.server.stop()
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            for idx in self.indexes.values():
+                idx.close()
+
+    def _space(self, header: dict) -> PersistentIndex:
+        sp = header.get("space", "bands")
+        try:
+            return self.indexes[sp]
+        except KeyError:
+            raise KeyError(
+                f"shard {self.name} hosts {sorted(self.indexes)}, not {sp!r}"
+            ) from None
+
+    # -- handlers ----------------------------------------------------------
+
+    def _h_probe(self, header, arrays):
+        (keys,) = arrays
+        docs = self._space(header).probe_batch(np.asarray(keys, np.uint64))
+        return {}, [np.asarray(docs, np.int64)]
+
+    def _h_insert(self, header, arrays):
+        keys, docs = arrays
+        idx = self._space(header)
+        keys = np.ascontiguousarray(keys, np.uint64).ravel()
+        docs = np.ascontiguousarray(docs, np.uint64).ravel()
+        with self._lock:
+            # semantic idempotency (see module docstring): drop postings
+            # already superseded-or-equal, so a redelivered batch — same
+            # request after a crash-reopen wiped the transport cache —
+            # applies zero times instead of twice
+            attr = np.asarray(idx.probe_batch(keys))
+            fresh = (attr < 0) | (attr.astype(np.int64) > docs.astype(np.int64))
+            if fresh.any():
+                idx.insert_batch(keys[fresh], docs[fresh])
+        return {"applied": int(fresh.sum()), "skipped": int((~fresh).sum())}
+
+    def _h_check_and_add(self, header, arrays):
+        keys, doc_ids = arrays
+        idx = self._space(header)
+        with self._lock:
+            attr = idx.check_and_add_batch(
+                np.asarray(keys, np.uint64), np.asarray(doc_ids, np.uint64)
+            )
+        return {}, [np.asarray(attr, np.int64)]
+
+    def _h_allocate(self, header, arrays):
+        idx = self._space(header)
+        n = int(header["n"])
+        floor = int(header.get("floor", 0))
+        with self._lock:
+            if floor:
+                idx.raise_doc_id_floor(floor)
+            ids = idx.allocate_doc_ids(n)
+        return {}, [ids]
+
+    def _h_log_names(self, header, arrays):
+        (ids,) = arrays
+        self._space(header).log_names(
+            np.asarray(ids, np.uint64).tolist(), header.get("names", [])
+        )
+        return {}
+
+    def _h_floor(self, header, arrays):
+        return {"floor": int(self._space(header).doc_id_floor())}
+
+    def _h_stats(self, header, arrays):
+        return {
+            "shard": self.name,
+            "spaces": {sp: idx.stats() for sp, idx in self.indexes.items()},
+        }
+
+    def _h_dump(self, header, arrays):
+        """Paged: a shard past ~4M postings must never build a response
+        frame the client's own cap forces it to refuse."""
+        keys, docs = self._space(header).dump_postings()
+        total = int(keys.size)
+        off = int(header.get("offset", 0))
+        limit = header.get("limit")
+        if limit is not None:
+            hi = off + int(limit)
+            keys, docs = keys[off:hi], docs[off:hi]
+        elif off:
+            keys, docs = keys[off:], docs[off:]
+        return {"total": total}, [keys, docs]
+
+    def _h_checkpoint(self, header, arrays):
+        for idx in self.indexes.values():
+            idx.checkpoint()
+        return {}
+
+
+class RemoteIndex:
+    """Client handle for ONE key space on ONE shard node.
+
+    The per-node building block of ``index/fleet.py`` — and a drop-in
+    single-shard remote for code written against ``PersistentIndex``
+    (``probe_batch`` / ``insert_batch`` / ``check_and_add_batch`` /
+    ``allocate_doc_ids`` / ``log_names``).  Retries ride the RPC layer's
+    request-id discipline; ``check_and_add_batch`` retries are safe for
+    the same reason (response replay within a server lifetime, and the
+    orchestrating fleet client never uses it across one).
+    """
+
+    def __init__(
+        self,
+        address: tuple[str, int],
+        *,
+        space: str = "bands",
+        client: rpc.RpcClient | None = None,
+        timeout: float = 10.0,
+        retries: int = 3,
+        connect=None,
+        seed: int = 0,
+    ):
+        self.address = tuple(address)
+        self.space = space
+        self.client = client or rpc.RpcClient(
+            self.address,
+            timeout=timeout,
+            retries=retries,
+            connect=connect,
+            seed=seed,
+        )
+
+    def _call(self, method, header=None, arrays=(), **kw):
+        h = {"space": self.space}
+        h.update(header or {})
+        return self.client.call(method, h, arrays, **kw)
+
+    def ping(self, *, timeout: float | None = None) -> bool:
+        return self.client.ping(timeout=timeout)
+
+    def probe_batch(self, keys) -> np.ndarray:
+        _h, (docs,) = self._call("probe", arrays=[np.asarray(keys, np.uint64)])
+        return docs
+
+    def insert_batch(self, keys, docs, *, request_id=None) -> int:
+        h, _ = self._call(
+            "insert",
+            arrays=[np.asarray(keys, np.uint64), np.asarray(docs, np.uint64)],
+            request_id=request_id,
+        )
+        return int(h.get("applied", 0))
+
+    def check_and_add_batch(self, keys, doc_ids) -> np.ndarray:
+        _h, (attr,) = self._call(
+            "check_and_add",
+            arrays=[np.asarray(keys, np.uint64), np.asarray(doc_ids, np.uint64)],
+        )
+        return attr
+
+    def allocate_doc_ids(self, n: int, *, floor: int = 0) -> np.ndarray:
+        _h, (ids,) = self._call("allocate", {"n": int(n), "floor": int(floor)})
+        return ids
+
+    def log_names(self, doc_ids, names) -> None:
+        self._call(
+            "log_names",
+            {"names": [str(n) for n in names]},
+            arrays=[np.asarray(doc_ids, np.uint64)],
+        )
+
+    def doc_id_floor(self) -> int:
+        h, _ = self._call("floor")
+        return int(h["floor"])
+
+    def stats(self) -> dict:
+        h, _ = self._call("stats")
+        return h
+
+    def dump_postings(
+        self, *, page: int = 1 << 18
+    ) -> tuple[np.ndarray, np.ndarray]:
+        parts_k, parts_d = [], []
+        off = 0
+        while True:
+            h, (keys, docs) = self._call(
+                "dump", {"offset": off, "limit": int(page)}
+            )
+            parts_k.append(np.asarray(keys, np.uint64))
+            parts_d.append(np.asarray(docs, np.uint64))
+            off += int(parts_k[-1].size)
+            if off >= int(h.get("total", off)) or parts_k[-1].size == 0:
+                break
+        return np.concatenate(parts_k), np.concatenate(parts_d)
+
+    def checkpoint(self) -> None:
+        self._call("checkpoint")
+
+    def close(self) -> None:
+        self.client.close()
+
+
+def serve_main(argv=None) -> int:
+    """Standalone shard entry (``python -m advanced_scrapper_tpu.index.remote``).
+
+    Writes the bound port to ``--port-file`` ATOMICALLY after listen, so a
+    parent that forked N shards can wait for the files instead of racing
+    the bind.  SIGTERM closes cleanly; SIGKILL is the crashsweep's job.
+    """
+    import argparse
+    import signal
+    import time as _time
+
+    ap = argparse.ArgumentParser(description=serve_main.__doc__)
+    ap.add_argument("--dir", required=True, help="shard index directory")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--port-file", default=None)
+    ap.add_argument("--spaces", default=",".join(DEFAULT_SPACES))
+    ap.add_argument("--cut-postings", type=int, default=1 << 16)
+    ap.add_argument("--compact-segments", type=int, default=8)
+    ap.add_argument("--name", default="")
+    args = ap.parse_args(argv)
+
+    srv = IndexShardServer(
+        args.dir,
+        host=args.host,
+        port=args.port,
+        spaces=tuple(s for s in args.spaces.split(",") if s),
+        cut_postings=args.cut_postings,
+        compact_segments=args.compact_segments,
+        compact_inline=True,  # forked shards: deterministic compaction,
+        name=args.name,       # a chaos/SIGKILL target like everything else
+    ).start()
+    if args.port_file:
+        from advanced_scrapper_tpu.storage.fsio import atomic_replace
+
+        atomic_replace(args.port_file, str(srv.port).encode())
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_a: stop.set())
+    signal.signal(signal.SIGINT, lambda *_a: stop.set())
+    try:
+        while not stop.is_set():
+            _time.sleep(0.1)
+    finally:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(serve_main())

@@ -45,7 +45,7 @@ from advanced_scrapper_tpu.index.segment import Segment, write_segment
 from advanced_scrapper_tpu.index.wal import WriteAheadLog, replay_wal
 from advanced_scrapper_tpu.storage.fsio import atomic_replace, default_fs
 
-__all__ = ["PersistentIndex"]
+__all__ = ["PersistentIndex", "resolve_intra_batch"]
 
 MANIFEST = "manifest.json"
 DOCMAP = "docmap.log"
@@ -59,6 +59,45 @@ def _wal_name(seq: int) -> str:
 
 def _seg_name(seq: int) -> str:
     return f"seg-{seq:08d}.seg"
+
+
+def resolve_intra_batch(
+    keys: np.ndarray, doc_ids: np.ndarray, attr: np.ndarray
+) -> np.ndarray:
+    """First-seen-wins resolution WITHIN one batch, in place.
+
+    ``attr`` is the cross-run attribution the index probe produced
+    (``-1`` = no historical match); rows sharing a band key with an
+    earlier still-fresh row of the same batch attribute to that row's doc
+    id.  Kept (fresh) rows only ever become attribution targets — a dup
+    row's id is never posted, so it must never be referenced.
+
+    Shared verbatim by :meth:`PersistentIndex.check_and_add_batch` and
+    the fleet client (``index/fleet.py``): the byte-equality of a sharded
+    fleet against the single-node oracle rests on both running exactly
+    this resolution between the probe and the insert.
+    """
+    B, nb = keys.shape
+    # the pass only touches rows holding a key that occurs in MORE than
+    # one row of the batch — any other row can neither match an earlier
+    # row nor be matched by a later one, so the (ordered, kept-rows-only)
+    # resolution loop runs over the shared minority
+    uniq, counts = np.unique(keys, return_counts=True)
+    kc = counts[np.searchsorted(uniq, keys.ravel())].reshape(B, nb)
+    shared_rows = np.flatnonzero((kc > 1).any(axis=1))
+    kept_keys: dict[int, int] = {}  # key → doc id of the first KEPT row
+    for r in shared_rows.tolist():
+        row = keys[r].tolist()
+        if attr[r] < 0:
+            for k in row:
+                d = kept_keys.get(k)
+                if d is not None:
+                    attr[r] = d
+                    break
+        if attr[r] < 0:
+            for k in row:
+                kept_keys.setdefault(k, int(doc_ids[r]))
+    return attr
 
 
 class PersistentIndex:
@@ -472,26 +511,9 @@ class PersistentIndex:
         B, nb = keys.shape
         if B != doc_ids.size:
             raise ValueError(f"{B} key rows vs {doc_ids.size} doc ids")
-        attr = np.asarray(self.probe_batch(keys))
-        # intra-batch pass only touches rows holding a key that occurs in
-        # MORE than one row of the batch — any other row can neither match
-        # an earlier row nor be matched by a later one, so the (ordered,
-        # kept-rows-only) resolution loop runs over the shared minority
-        uniq, counts = np.unique(keys, return_counts=True)
-        kc = counts[np.searchsorted(uniq, keys.ravel())].reshape(B, nb)
-        shared_rows = np.flatnonzero((kc > 1).any(axis=1))
-        kept_keys: dict[int, int] = {}  # key → doc id of the first KEPT row
-        for r in shared_rows.tolist():
-            row = keys[r].tolist()
-            if attr[r] < 0:
-                for k in row:
-                    d = kept_keys.get(k)
-                    if d is not None:
-                        attr[r] = d
-                        break
-            if attr[r] < 0:
-                for k in row:
-                    kept_keys.setdefault(k, int(doc_ids[r]))
+        attr = resolve_intra_batch(
+            keys, doc_ids, np.asarray(self.probe_batch(keys))
+        )
         fresh = attr < 0
         if fresh.any():
             self.insert_batch(

@@ -45,15 +45,37 @@ def _send_json(sock: socket.socket, lock: threading.Lock, obj: dict) -> None:
         sock.sendall(data)
 
 
-class _LineReader:
-    """Reassemble newline-framed JSON from a stream socket (client ``:146-181``)."""
+class FrameTooLong(ValueError):
+    """A peer exceeded the line-reassembly cap without sending a newline."""
 
-    def __init__(self, sock: socket.socket):
+
+class _LineReader:
+    """Reassemble newline-framed JSON from a stream socket (client ``:146-181``).
+
+    ``max_line`` caps the reassembly buffer: a peer that streams bytes
+    without ever framing them (malice, corruption, or a runaway payload)
+    previously grew ``self.buf`` without bound.  Exceeding the cap counts
+    the drop in telemetry and raises :class:`FrameTooLong` — the caller
+    must close the connection (the stream position is unrecoverable)."""
+
+    def __init__(self, sock: socket.socket, max_line: int = 16 << 20):
         self.sock = sock
         self.buf = b""
+        self.max_line = max_line
 
     def readline(self) -> dict | None:
         while b"\n" not in self.buf:
+            if len(self.buf) > self.max_line:
+                from advanced_scrapper_tpu.obs import telemetry
+
+                telemetry.event_counter(
+                    "astpu_lease_oversize_frames_total",
+                    "connections cut for exceeding the line-frame cap",
+                ).inc()
+                raise FrameTooLong(
+                    f"{len(self.buf)} unframed bytes exceed the "
+                    f"{self.max_line} B line cap"
+                )
             chunk = self.sock.recv(65536)
             if not chunk:
                 return None
@@ -97,6 +119,9 @@ class LeaseServer:
                 self._urls.put(u)
         self._pending = len(seen)
         self._assigned: dict[int, set[str]] = {}
+        self._last_seen: dict[int, float] = {}   # cid → monotonic stamp of
+        #   the last COMPLETE frame (heartbeats count; dribbled bytes don't)
+        self._conns: dict[int, socket.socket] = {}
         self._lock = threading.Lock()
         self.results: list[dict] = []
         self.stats = RateStats()
@@ -140,6 +165,12 @@ class LeaseServer:
         self._m_requeued = telemetry.REGISTRY.counter(
             "astpu_lease_urls_requeued_total",
             "urls returned to the queue by client disconnects",
+            always=always, server=sid,
+        )
+        self._m_ttl_expired = telemetry.REGISTRY.counter(
+            "astpu_lease_ttl_expired_total",
+            "clients whose leases were reclaimed on heartbeat timeout "
+            "(hung-but-connected workers)",
             always=always, server=sid,
         )
         telemetry.gauge_fn(
@@ -218,6 +249,10 @@ class LeaseServer:
         t = threading.Thread(target=self._accept_loop, daemon=True)
         t.start()
         self._threads.append(t)
+        if self.cfg.lease_ttl > 0:
+            r = threading.Thread(target=self._ttl_reaper, daemon=True)
+            r.start()
+            self._threads.append(r)
         from advanced_scrapper_tpu.obs import telemetry
 
         if self._status_port is not None or telemetry.enabled():
@@ -264,6 +299,8 @@ class LeaseServer:
                 cid = self._next_client
                 self._next_client += 1
                 self._assigned[cid] = set()
+                self._last_seen[cid] = time.monotonic()
+                self._conns[cid] = conn
             t = threading.Thread(
                 target=self._handle_client, args=(conn, cid), daemon=True
             )
@@ -273,13 +310,18 @@ class LeaseServer:
     def _lease(self, cid: int, n: int) -> list[str]:
         out = []
         with self._lock:
+            # setdefault: a TTL-expired client that wakes up and keeps
+            # requesting gets a fresh ledger (its old leases were already
+            # requeued; its connection is being torn down, so these new
+            # leases flow back via the normal disconnect return)
+            ledger = self._assigned.setdefault(cid, set())
             for _ in range(n):
                 try:
                     u = self._urls.get_nowait()
                 except queue.Empty:
                     break
                 out.append(u)
-                self._assigned[cid].add(u)
+                ledger.add(u)
         self._m_leased.inc(len(out))
         return out
 
@@ -290,6 +332,8 @@ class LeaseServer:
             for u in self._assigned.pop(cid, ()):
                 self._urls.put(u)
                 returned += 1
+            self._last_seen.pop(cid, None)
+            self._conns.pop(cid, None)
         if returned:
             self._m_requeued.inc(returned)
             from advanced_scrapper_tpu.obs import trace
@@ -298,15 +342,54 @@ class LeaseServer:
                 "event", "lease.requeue", client=cid, urls=returned
             )
 
+    # -- heartbeat / TTL reclaim -------------------------------------------
+
+    def _ttl_reaper(self) -> None:
+        """Requeue leases whose client stopped producing complete frames
+        for ``lease_ttl`` seconds — a wedged worker holds a perfectly
+        healthy TCP connection, so disconnect-based reclaim (the only
+        mechanism before the fleet PR) never fires for it.  Expiry also
+        cuts the connection: late results from the zombie are then
+        rejected by the assignment guard as strays."""
+        ttl = self.cfg.lease_ttl
+        tick = max(0.05, min(1.0, ttl / 4))
+        while not self._stop.wait(tick):
+            now = time.monotonic()
+            expired: list[tuple[int, socket.socket | None]] = []
+            with self._lock:
+                for cid, seen in list(self._last_seen.items()):
+                    if now - seen > ttl:
+                        self._last_seen.pop(cid, None)
+                        expired.append((cid, self._conns.pop(cid, None)))
+            for cid, conn in expired:
+                self._m_ttl_expired.inc()
+                from advanced_scrapper_tpu.obs import trace
+
+                trace.record("event", "lease.ttl_expired", client=cid)
+                self._return_unprocessed(cid)
+                if conn is not None:
+                    try:
+                        conn.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+
     def _handle_client(self, conn: socket.socket, cid: int) -> None:
-        reader = _LineReader(conn)
+        reader = _LineReader(conn, max_line=self.cfg.max_frame_bytes)
         wlock = threading.Lock()
         try:
             while not self._stop.is_set():
                 msg = reader.readline()
                 if msg is None:
                     return
+                with self._lock:
+                    self._last_seen[cid] = time.monotonic()
                 kind = msg.get("type")
+                if kind == "heartbeat":
+                    continue  # liveness only; the stamp above is the point
                 if kind == "request_tasks":
                     self.stats.record_request()
                     urls = self._lease(cid, int(msg.get("num_urls", 1)))
@@ -335,8 +418,8 @@ class LeaseServer:
                 elif kind == "tasks_completed":
                     _send_json(conn, wlock, {"type": "acknowledge_completion"})
                     return
-        except (ConnectionError, json.JSONDecodeError, OSError):
-            pass
+        except (ConnectionError, json.JSONDecodeError, OSError, FrameTooLong):
+            pass  # FrameTooLong: counted in the reader; teardown requeues
         finally:
             self._return_unprocessed(cid)
             conn.close()
@@ -356,7 +439,7 @@ class LeaseServer:
         ``ERROR:``-prefixed payloads (the client's fetch-failure sentinel)
         land in the failed CSV verbatim.
         """
-        from advanced_scrapper_tpu.pipeline.scraper import (
+        from advanced_scrapper_tpu.extractors import (
             FAILED_FIELDS,
             SUCCESS_FIELDS,
         )
@@ -422,19 +505,50 @@ class LeaseClient:
         self._wlock = threading.Lock()
         self._threads: list[threading.Thread] = []
 
+    def _connect_with_backoff(self) -> socket.socket:
+        """Dial the server, retrying refused/injected connect failures
+        with capped exponential backoff + deterministic jitter — a worker
+        that boots a moment before its server (or behind a flaky link)
+        must join the fleet, not die on the first ECONNREFUSED."""
+        from advanced_scrapper_tpu.net.rpc import backoff_delays
+
+        dial = self._connect or (
+            lambda addr: socket.create_connection(addr, timeout=10)
+        )
+        attempts = max(1, self.cfg.connect_retries + 1)
+        delays = backoff_delays(
+            attempts - 1,
+            base=self.cfg.connect_backoff,
+            cap=2.0,
+            seed=f"lease-connect|{self.host}:{self.port}",
+        )
+        last: Exception | None = None
+        for attempt in range(attempts):
+            if attempt:
+                from advanced_scrapper_tpu.obs import telemetry
+
+                telemetry.event_counter(
+                    "astpu_lease_connect_retries_total",
+                    "lease-client connect attempts beyond the first",
+                ).inc()
+                self.sleep(delays[attempt - 1])
+            try:
+                return dial((self.host, self.port))
+            except OSError as e:
+                last = e
+        raise ConnectionError(
+            f"lease server {self.host}:{self.port} unreachable after "
+            f"{attempts} attempts: {last}"
+        ) from last
+
     def run(self, *, max_seconds: float = 60.0) -> int:
         """Connect, pull leases, fetch, stream results; returns #fetched.
 
         Stops when the server's queue is drained (an empty ``task_batch``)
         and all local work is done, or after ``max_seconds``.
         """
-        if self._connect is not None:
-            self._sock = self._connect((self.host, self.port))
-        else:
-            self._sock = socket.create_connection(
-                (self.host, self.port), timeout=10
-            )
-        reader = _LineReader(self._sock)
+        self._sock = self._connect_with_backoff()
+        reader = _LineReader(self._sock, max_line=self.cfg.max_frame_bytes)
         fetched = 0
 
         def receiver():
@@ -452,7 +566,9 @@ class LeaseClient:
                             self._tasks.put(u)
                     elif msg.get("type") == "acknowledge_completion":
                         return
-            except (ConnectionError, OSError, json.JSONDecodeError):
+            except (
+                ConnectionError, OSError, json.JSONDecodeError, FrameTooLong
+            ):
                 return
 
         def worker():
@@ -508,6 +624,10 @@ class LeaseClient:
 
         # monitor loop: low-water refill, rate-capped (client1.py:209-234)
         interval = 1.0 / self.cfg.client_rate
+        hb_interval = self.cfg.heartbeat_interval or (
+            min(1.0, self.cfg.lease_ttl / 4) if self.cfg.lease_ttl > 0 else 0
+        )
+        last_frame = time.monotonic()
         deadline = time.monotonic() + max_seconds
         try:
             while time.monotonic() < deadline:
@@ -530,6 +650,22 @@ class LeaseClient:
                                 "num_urls": self.cfg.batch_size,
                             },
                         )
+                        last_frame = time.monotonic()
+                    except (ConnectionError, OSError):
+                        break
+                elif (
+                    hb_interval
+                    and time.monotonic() - last_frame >= hb_interval
+                ):
+                    # liveness while busy: a full local queue means no
+                    # request frames, and slow fetches mean no result
+                    # frames — without this the server's TTL reaper
+                    # would reclaim leases we are actively working
+                    try:
+                        _send_json(
+                            self._sock, self._wlock, {"type": "heartbeat"}
+                        )
+                        last_frame = time.monotonic()
                     except (ConnectionError, OSError):
                         break
                 self.sleep(interval)

@@ -640,12 +640,35 @@ class NearDupEngine:
         reps = self.dedup_reps(texts)
         return reps == np.arange(len(reps))
 
+    def open_stream_index(self, index_dir: str):
+        """Open the durable stream index this engine's config names: a
+        local :class:`~advanced_scrapper_tpu.index.store.PersistentIndex`
+        under ``index_dir``, or — when ``cfg.index_fleet`` is set — a
+        :class:`~advanced_scrapper_tpu.index.fleet.ShardedIndexClient`
+        over the remote shard fleet (``index_dir`` then holds only the
+        degraded-mode spill journals).  Either return value is a valid
+        ``index`` argument to :meth:`dedup_against_index` — the fleet is
+        a config string, not a call-site change."""
+        if self.cfg.index_fleet:
+            from advanced_scrapper_tpu.index.fleet import open_fleet_index
+
+            return open_fleet_index(self.cfg, index_dir, space="bands")
+        from advanced_scrapper_tpu.index import PersistentIndex
+
+        return PersistentIndex(
+            index_dir,
+            cut_postings=self.cfg.index_cut_postings,
+            compact_segments=self.cfg.index_compact_segments,
+        )
+
     def dedup_against_index(
         self, texts: Sequence[str | bytes], index, doc_ids=None
     ) -> np.ndarray:
         """``int64[N]`` attribution of a corpus against a persistent index
-        (``index.store.PersistentIndex``): device signatures → wide uint64
-        band keys → ``check_and_add_batch``.  A row whose result is ≥ 0 is
+        (``index.store.PersistentIndex`` — or its fleet drop-in,
+        ``index.fleet.ShardedIndexClient``; ``open_stream_index`` picks by
+        config): device signatures → wide uint64 band keys →
+        ``check_and_add_batch``.  A row whose result is ≥ 0 is
         a near-dup of that (possibly restarts-old) doc id; fresh rows post
         their keys under ``doc_ids`` (allocated from the index when not
         given) and return -1.  Sub-shingle rows are never probed or posted

@@ -43,17 +43,13 @@ from advanced_scrapper_tpu.obs.console import ConsoleMux
 from advanced_scrapper_tpu.obs.stats import StatsTracker
 from advanced_scrapper_tpu.storage.csvio import AppendCsv, count_rows, scraped_url_set
 
-SUCCESS_FIELDS = [
-    "url",
-    "datetime",
-    "ticker_symbols",
-    "author",
-    "source",
-    "source_url",
-    "title",
-    "article",
-]  # ref constant_rate_scrapper.py:320-329
-FAILED_FIELDS = ["url", "error"]  # ref :330
+# canonical home is the extractor boundary (the schema is the plugin
+# contract's output, and net/ consumes it too); re-exported here because
+# this module has always been its import site
+from advanced_scrapper_tpu.extractors import (  # noqa: F401
+    FAILED_FIELDS,
+    SUCCESS_FIELDS,
+)
 
 _RATE_LIMIT_FINGERPRINTS = (
     "contentEncodingError",  # Firefox/geckodriver (ref :190)
@@ -504,6 +500,14 @@ def run_scraper(
             ),
             index_dir=index_dir,
         )
+        if dedup_cfg.stream_index == "persist" and dedup_cfg.index_fleet:
+            # remote fleet: announce the topology (the per-shard health is
+            # live on /metrics, astpu_fleet_*; spill journals land under
+            # the local index dir)
+            print(
+                f"Stream index: remote fleet "
+                f"[{dedup_cfg.index_fleet}], spill at {index_dir}/spill"
+            )
         # the fifth resume artifact: without the stream index a restarted
         # run re-admits near-dups of everything already annotated; a torn
         # checkpoint (pre-hardening crash) is quarantined and ignored.  In

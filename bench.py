@@ -364,6 +364,83 @@ def _bench_matcher(n_articles: int) -> float:
     return n_articles / dt
 
 
+def _bench_fleet(n_docs: int, nb: int = 17) -> dict:
+    """The sharded index fleet (``index/fleet.py``): the SAME
+    check_and_add workload as the ``index`` regime, but through a 2-shard
+    × 2-replica fleet of in-process ``IndexShardServer``s over real TCP —
+    so the figure pays consistent-hash partitioning, RPC framing, the
+    synchronous replica write, and the parallel fan-out.  Read next to
+    ``index_insert_rows_per_sec`` it IS the fleet tax (or win, once
+    shards live on separate hosts)."""
+    import shutil
+    import tempfile
+
+    from advanced_scrapper_tpu.index.fleet import ShardedIndexClient
+    from advanced_scrapper_tpu.index.remote import IndexShardServer
+
+    rng = np.random.RandomState(13)
+    B = 2048
+    n_batches = max(1, n_docs // B)
+    base = tempfile.mkdtemp(prefix="astpu-bench-fleet-")
+    servers = []
+    client = None
+    try:
+        cut = max(1 << 14, (n_docs * nb) // 10)
+        parts = []
+        for s in range(2):
+            nodes = []
+            for r in range(2):
+                srv = IndexShardServer(
+                    os.path.join(base, f"s{s}n{r}"),
+                    spaces=("bands",),
+                    cut_postings=cut,
+                    compact_segments=6,
+                    compact_inline=True,
+                    name=f"s{s}n{r}",
+                ).start()
+                servers.append(srv)
+                nodes.append(f"127.0.0.1:{srv.port}")
+            parts.append("|".join(nodes))
+        client = ShardedIndexClient(
+            ";".join(parts),
+            space="bands",
+            spill_dir=os.path.join(base, "spill"),
+            timeout=30.0,
+        )
+        t_ins = 0.0
+        probe_keys = []
+        kept_rows: list[np.ndarray] = []
+        for _ in range(n_batches):
+            keys = rng.randint(0, 1 << 62, size=(B, nb)).astype(np.uint64)
+            if kept_rows:
+                src = kept_rows[rng.randint(len(kept_rows))]
+                n_dup = B // 5
+                keys[:n_dup] = src[rng.randint(0, src.shape[0], size=n_dup)]
+            ids = client.allocate_doc_ids(B)
+            t0 = time.perf_counter()
+            attr = client.check_and_add_batch(keys, ids)
+            t_ins += time.perf_counter() - t0
+            kept_rows.append(keys[np.asarray(attr) < 0])
+            probe_keys.append(keys)
+        t0 = time.perf_counter()
+        for keys in probe_keys:
+            client.probe_batch(keys)
+        t_probe = time.perf_counter() - t0
+        total = B * n_batches
+        return {
+            "fleet_insert_rows_per_sec": round(total / t_ins, 1),
+            "fleet_probe_rows_per_sec": round(total / t_probe, 1),
+            "fleet_shards": 2,
+            "fleet_replicas": 2,
+        }
+    finally:
+        if client is not None:
+            client.close()
+        for srv in servers:
+            srv.stop()
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def _bench_index(n_docs: int, nb: int = 17) -> dict:
     """The persistent corpus index (``index/`` subsystem): probe+insert
     throughput through ``check_and_add_batch`` (WAL append + memtable +
@@ -573,7 +650,10 @@ def _jax_or_cpu_fallback(timeout_s: float = 240.0):
     _reexec_cpu_fallback()
 
 
-REGIMES = ("uniform", "ragged", "stream", "recall", "exact", "matcher", "index")
+REGIMES = (
+    "uniform", "ragged", "stream", "recall", "exact", "matcher", "index",
+    "fleet",
+)
 
 
 def _parse_args(argv=None):
@@ -713,6 +793,14 @@ def main(argv=None) -> None:
                     f"reopen {idx['index_reopen_ms']:.1f}ms"
                 )
                 out.update(idx)
+            if "fleet" in want:
+                flt = _bench_fleet(8192 if quick else 32768)
+                note(
+                    f"fleet done: insert {flt['fleet_insert_rows_per_sec']:.0f}"
+                    f"/s probe {flt['fleet_probe_rows_per_sec']:.0f}/s "
+                    f"(2 shards × 2 replicas over loopback RPC)"
+                )
+                out.update(flt)
     except Exception as e:
         # A tunnel that came up can still die between dispatches (it has).
         # Better one labeled cpu-fallback line than no round record at all.

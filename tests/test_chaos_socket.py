@@ -240,6 +240,149 @@ def test_slow_loris_client_does_not_starve_others():
         t.join(timeout=5)
 
 
+# -- the RPC plane under the same chaos (net/rpc.py) ------------------------
+
+
+def _rpc_echo_server(**kw):
+    from advanced_scrapper_tpu.net.rpc import RpcServer
+
+    executions = {"n": 0}
+
+    def count(header, arrays):
+        executions["n"] += 1
+        return {"n": executions["n"], "x": header.get("x")}, list(arrays)
+
+    srv = RpcServer({"count": count}, **kw)
+    srv._test_executions = executions
+    return srv.start()
+
+
+def test_rpc_mid_frame_cut_retries_once_only():
+    """ChaosSocket cuts an RPC request frame mid-wire: the client must
+    reconnect and retry under the same request id, and the handler must
+    run EXACTLY once across the cut — the no-double-insert contract the
+    index fleet's writes ride on."""
+    import numpy as np
+
+    from advanced_scrapper_tpu.net.rpc import RpcClient
+
+    srv = _rpc_echo_server()
+    try:
+        # per-dial seeds: ChaosSocket decisions key on (seed, frame
+        # digest, occurrence) and a retry is the SAME bytes on a FRESH
+        # socket — a fixed seed would cut the identical frame on every
+        # reconnect forever, which no real network does
+        sockets = []
+        dials = {"n": 0}
+
+        def connect(addr):
+            dials["n"] += 1
+            s = ChaosSocket(
+                socket.create_connection(addr, timeout=5),
+                seed=dials["n"],
+                cut_rate=0.35,
+            )
+            sockets.append(s)
+            return s
+
+        cli = RpcClient(
+            ("127.0.0.1", srv.port),
+            timeout=5.0,
+            retries=7,
+            backoff_base=0.005,
+            connect=connect,
+        )
+        results = []
+        for i in range(12):
+            h, arrs = cli.call(
+                "count", {"x": i}, [np.full(64, i, np.uint64)]
+            )
+            assert h["x"] == i
+            assert (arrs[0] == i).all()
+            results.append(h["n"])
+        assert sum(s.injected["cut"] for s in sockets) >= 1, (
+            "chaos must actually fire"
+        )
+        # every call executed exactly once, in order: no retry ever
+        # re-executed (replays come from the idempotency cache)
+        assert results == list(range(1, 13))
+        assert srv._test_executions["n"] == 12
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_rpc_slow_loris_is_cut_without_starving_others():
+    """A peer dribbling a frame byte-by-byte hits the server's per-frame
+    deadline and is dropped; a healthy client on another connection keeps
+    getting answers the whole time."""
+    from advanced_scrapper_tpu.net.rpc import RpcClient, send_frame
+
+    srv = _rpc_echo_server(frame_deadline=0.5)
+    loris_stop = threading.Event()
+
+    def loris():
+        try:
+            s = socket.create_connection(("127.0.0.1", srv.port), timeout=5)
+            import io
+
+            buf = io.BytesIO()
+
+            class Cap:
+                def sendall(self, b):
+                    buf.write(b)
+
+            send_frame(Cap(), {"id": "x", "method": "count"})
+            frame = buf.getvalue()
+            for ch in frame[:-1]:  # never completes
+                if loris_stop.is_set():
+                    break
+                s.sendall(bytes([ch]))
+                time.sleep(0.05)
+            s.close()
+        except OSError:
+            pass
+
+    t = threading.Thread(target=loris, daemon=True)
+    t.start()
+    try:
+        cli = RpcClient(("127.0.0.1", srv.port), timeout=2.0)
+        for i in range(5):
+            h, _ = cli.call("count", {"x": i})
+            assert h["x"] == i
+        cli.close()
+    finally:
+        loris_stop.set()
+        srv.stop()
+        t.join(timeout=5)
+
+
+def test_rpc_fragmented_and_trickled_frames_reassemble():
+    """Few-byte reads and dribbled sends: binary length-framing must not
+    depend on frame-per-recv delivery any more than NDJSON does."""
+    import numpy as np
+
+    from advanced_scrapper_tpu.net.rpc import RpcClient
+
+    srv = _rpc_echo_server()
+    try:
+        connect, sockets = chaos_connector(
+            seed=13, trickle_rate=1.0, trickle_chunk=7, trickle_delay=0.0005,
+            fragment_rate=0.6, fragment_bytes=9,
+        )
+        cli = RpcClient(
+            ("127.0.0.1", srv.port), timeout=10.0, connect=connect
+        )
+        payload = np.arange(500, dtype=np.uint64)
+        for i in range(4):
+            h, arrs = cli.call("count", {"x": i}, [payload])
+            assert h["x"] == i and (arrs[0] == payload).all()
+        assert sum(sockets[0].injected.values()) > 0
+        cli.close()
+    finally:
+        srv.stop()
+
+
 def test_chaos_client_then_clean_resume_converges(tmp_path):
     """A chaos client whose frames die mid-wire, then a clean client:
     every url ends resulted exactly once and the central parse writes no

@@ -134,3 +134,35 @@ def test_crashsweep_pindex_converges(tmp_path):
         chaos_only=crashsweep.PINDEX_CHAOS_TARGETS,
     )
     _assert_sweep(report, min_kills=4)
+
+
+def test_crashsweep_fleet_converges(tmp_path):
+    """The fleet acceptance, tier-1 slice: one seeded case per kill
+    mechanism — SIGKILL a shard primary before an insert-heavy batch,
+    before a probe, together with its replica (spill → journaled local
+    WAL → promotion-window recovery → replay), and chaos-exit INSIDE a
+    WAL append.  Every case must end with dedup annotations BYTE-equal to
+    the single-node oracle, per-shard posting min-maps equal to the
+    oracle's ring slice, zero duplicated postings on any node, an empty
+    spill backlog, and the mode's failover/promotion/spill counters
+    moved.  (The full ≥20-instant sweep is the `slow` twin below and the
+    default `tools/crashsweep.py` battery.)"""
+    report = crashsweep.sweep_fleet(
+        str(tmp_path), kills=len(crashsweep.FLEET_KILL_MODES), seed=0
+    )
+    assert not report["problems"], report["problems"]
+    assert report["kills"] >= len(crashsweep.FLEET_KILL_MODES) - 1, report
+
+
+import pytest  # noqa: E402
+
+
+@pytest.mark.slow
+def test_crashsweep_fleet_twenty_instants(tmp_path):
+    """The full acceptance bar: ≥20 seeded kill instants across the four
+    fleet mechanisms, every one byte-convergent with the oracle."""
+    report = crashsweep.sweep_fleet(str(tmp_path), kills=20, seed=1)
+    assert not report["problems"], report["problems"]
+    assert report["kills"] >= 20 - 2, (
+        f"only {report['kills']} of 20 kill instants landed"
+    )

@@ -205,3 +205,182 @@ def test_lease_central_parse_feeds_tpu_dedup(tmp_path):
     } == {None, urls[5]}, (a, b)
     for u in (urls[1], urls[2], urls[3], urls[4]):
         assert link_of(by_url[u]) is None, f"distinct body {u} wrongly linked"
+
+
+# -- heartbeat / TTL lease expiry (the fleet-PR satellites) ------------------
+
+
+def test_ttl_expiry_requeues_wedged_client():
+    """A hung-but-CONNECTED client: before the TTL reaper, its leases
+    were stranded until the TCP connection dropped (which for a wedged
+    process is never).  Now: no complete frame for ``lease_ttl`` seconds
+    ⇒ leases requeued, connection cut, late results rejected as strays —
+    and a healthy client finishes the job."""
+    from advanced_scrapper_tpu.obs import telemetry
+
+    telemetry.set_enabled(True)
+    urls = [f"https://x/{i}.html" for i in range(6)]
+    cfg = _cfg(lease_ttl=0.4)
+    server = LeaseServer(cfg, urls).start()
+    try:
+        wedged = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        wedged.sendall(b'{"type": "request_tasks", "num_urls": 4}\n')
+        reader = _LineReader(wedged)
+        batch = reader.readline()
+        assert len(batch["urls"]) == 4
+        # ... and then the worker wedges: the socket stays open, no
+        # frames flow.  The reaper must reclaim within ~TTL + one tick.
+        time.sleep(1.0)
+        assert server._m_ttl_expired.value >= 1
+        from advanced_scrapper_tpu.net.transport import MockTransport as MT
+
+        healthy = LeaseClient(
+            cfg, lambda: MT(lambda u: "<html><body>doc</body></html>"),
+            port=server.port,
+        )
+        assert healthy.run(max_seconds=20) == 6
+        assert server.wait_done(10), "TTL reaper never returned the leases"
+        # the zombie's late result must not double-complete the url
+        try:
+            wedged.sendall(
+                (json.dumps({"type": "result", "url": batch["urls"][0],
+                             "html_content": "late"}) + "\n").encode()
+            )
+        except OSError:
+            pass  # connection already torn down server-side — also fine
+    finally:
+        telemetry.set_enabled(None)
+        server.stop()
+    got = [r["url"] for r in server.results]
+    assert sorted(got) == sorted(urls)
+    assert len(got) == len(set(got))
+
+
+def test_heartbeats_keep_busy_client_alive_past_ttl():
+    """A client whose fetches outlast the TTL while its local queue sits
+    at the low-water mark sends heartbeat frames instead of requests —
+    the server must NOT reclaim its leases mid-fetch."""
+    from advanced_scrapper_tpu.net.transport import MockTransport
+
+    urls = [f"https://x/{i}.html" for i in range(2)]
+    # one worker thread, ~1 s per fetch, TTL 0.7 s: the first fetch alone
+    # is a complete-frame gap LONGER than the TTL while the local queue
+    # sits at the low-water mark (so no request frames either) — without
+    # heartbeats the reaper reclaims the leases mid-fetch and this one
+    # client could never finish the run
+    cfg = _cfg(
+        lease_ttl=0.7,
+        client_threads=1,
+        batch_size=8,
+        min_queue_length=1,
+    )
+    server = LeaseServer(cfg, urls).start()
+    try:
+        client = LeaseClient(
+            cfg,
+            lambda: MockTransport(
+                lambda u: "<html><body>doc</body></html>", latency=1.0
+            ),
+            port=server.port,
+        )
+        fetched = client.run(max_seconds=20)
+        assert fetched == 2, "TTL must not have cut the heartbeating client"
+        assert server.wait_done(5)
+    finally:
+        server.stop()
+    got = [r["url"] for r in server.results]
+    assert sorted(got) == sorted(urls)
+    assert len(got) == len(set(got))
+
+
+def test_oversize_unframed_line_cuts_client_and_requeues():
+    """A peer streaming bytes with no newline used to grow the reader
+    buffer without bound; now it is cut at ``max_frame_bytes`` (counted),
+    its leases requeued, and the run still converges."""
+    from advanced_scrapper_tpu.net.transport import MockTransport
+
+    urls = [f"https://x/{i}.html" for i in range(4)]
+    cfg = _cfg(max_frame_bytes=4096, lease_ttl=0.0)
+    server = LeaseServer(cfg, urls).start()
+    try:
+        evil = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        evil.sendall(b'{"type": "request_tasks", "num_urls": 2}\n')
+        reader = _LineReader(evil)
+        assert len(reader.readline()["urls"]) == 2
+        try:
+            evil.sendall(b"A" * (1 << 20))  # 1 MiB, never a newline
+            time.sleep(0.5)
+            evil.sendall(b"B" * 16)  # detect the server-side close
+        except OSError:
+            pass
+        time.sleep(0.5)
+        healthy = LeaseClient(
+            cfg, lambda: MockTransport(lambda u: "<html><body>doc</body></html>"),
+            port=server.port,
+        )
+        assert healthy.run(max_seconds=20) == 4
+        assert server.wait_done(10), "oversize cut must requeue the leases"
+    finally:
+        server.stop()
+    got = [r["url"] for r in server.results]
+    assert sorted(got) == sorted(urls)
+
+
+def test_line_reader_cap_raises_frame_too_long():
+    from advanced_scrapper_tpu.net.lease import FrameTooLong
+
+    a, b = socket.socketpair()
+    try:
+        reader = _LineReader(b, max_line=64)
+        a.sendall(b"x" * 256)
+        with pytest.raises(FrameTooLong):
+            reader.readline()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_client_initial_connect_backs_off_until_server_up():
+    """ECONNREFUSED on the first dials must not kill the worker: the
+    injected dialer fails twice, then the real server is there."""
+    from advanced_scrapper_tpu.net.transport import MockTransport
+
+    urls = ["https://x/a.html", "https://x/b.html"]
+    cfg = _cfg(connect_retries=4, connect_backoff=0.01)
+    server = LeaseServer(cfg, urls).start()
+    attempts = {"n": 0}
+
+    def flaky_connect(addr):
+        attempts["n"] += 1
+        if attempts["n"] <= 2:
+            raise ConnectionRefusedError("injected: server not up yet")
+        return socket.create_connection(addr, timeout=5)
+
+    try:
+        client = LeaseClient(
+            cfg,
+            lambda: MockTransport(lambda u: "<html><body>doc</body></html>"),
+            port=server.port,
+            connect=flaky_connect,
+        )
+        assert client.run(max_seconds=20) == 2
+        assert attempts["n"] == 3, "exactly two refused dials, then success"
+        assert server.wait_done(5)
+    finally:
+        server.stop()
+
+
+def test_client_connect_exhaustion_raises_connection_error():
+    cfg = _cfg(connect_retries=2, connect_backoff=0.001)
+    slept = []
+    client = LeaseClient(
+        cfg,
+        lambda: None,
+        host="127.0.0.1",
+        port=1,  # reserved port: refused immediately
+        sleep=slept.append,
+        connect=None,
+    )
+    with pytest.raises(ConnectionError):
+        client.run(max_seconds=1)
+    assert len(slept) == 2, "every retry must back off before redialing"

@@ -1,0 +1,257 @@
+"""The length-framed RPC plane (``net/rpc.py``): framing, deadlines,
+retry idempotency, backoff discipline.
+
+This is the transport the index fleet rides; the contracts proven here —
+a retried request never double-executes, an oversized or dribbled frame
+kills one connection and nothing else, backoff is capped and
+deterministic — are what the fleet's chaos certification builds on.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from advanced_scrapper_tpu.net.rpc import (
+    FrameTooLarge,
+    RpcClient,
+    RpcRemoteError,
+    RpcServer,
+    RpcUnavailable,
+    backoff_delays,
+    recv_frame,
+    send_frame,
+)
+
+
+def _echo_server(**kw) -> RpcServer:
+    calls = {"n": 0}
+
+    def echo(header, arrays):
+        calls["n"] += 1
+        return {"echo": header.get("x"), "calls": calls["n"]}, list(arrays)
+
+    def boom(header, arrays):
+        raise ValueError("deliberate")
+
+    srv = RpcServer({"echo": echo, "boom": boom}, **kw)
+    srv._test_calls = calls
+    return srv.start()
+
+
+def test_frame_roundtrip_arrays_and_header():
+    a, b = socket.socketpair()
+    try:
+        keys = np.arange(7, dtype=np.uint64)
+        mat = np.arange(12, dtype=np.int64).reshape(3, 4)
+        send_frame(a, {"m": "x", "n": 3}, [keys, mat])
+        h, arrs = recv_frame(b)
+        assert h == {"m": "x", "n": 3}
+        assert (arrs[0] == keys).all() and arrs[0].dtype == np.uint64
+        assert (arrs[1] == mat).all() and arrs[1].shape == (3, 4)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_oversized_frame_is_refused_not_buffered():
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, {"m": "big"}, [np.zeros(4096, np.uint64)])
+        with pytest.raises(FrameTooLarge):
+            recv_frame(b, max_frame=1024)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_call_roundtrip_and_remote_error():
+    srv = _echo_server()
+    try:
+        cli = RpcClient(("127.0.0.1", srv.port), timeout=5.0)
+        h, arrs = cli.call("echo", {"x": 42}, [np.arange(3, dtype=np.uint64)])
+        assert h["echo"] == 42 and (arrs[0] == np.arange(3)).all()
+        # handler exception → RpcRemoteError, never retried
+        with pytest.raises(RpcRemoteError) as ei:
+            cli.call("boom")
+        assert "deliberate" in str(ei.value)
+        assert srv._test_calls["n"] == 1, "remote errors must not retry"
+        with pytest.raises(RpcRemoteError):
+            cli.call("no_such_method")
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_duplicate_request_id_replays_without_reexecution():
+    """The transport idempotency net: same request id ⇒ the cached
+    response is replayed, the handler does NOT run again."""
+    srv = _echo_server()
+    try:
+        cli = RpcClient(("127.0.0.1", srv.port), timeout=5.0)
+        h1, _ = cli.call("echo", {"x": 1}, request_id="fixed-id")
+        h2, _ = cli.call("echo", {"x": 1}, request_id="fixed-id")
+        assert h1["calls"] == h2["calls"] == 1
+        assert srv._test_calls["n"] == 1
+        assert srv.replays >= 1
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_retry_after_connection_cut_is_single_execution():
+    """Kill the connection between send and response on attempt 1: the
+    client reconnects and retries under the SAME id; the server must
+    execute once (either the first delivery or the retry — never both)."""
+    srv = _echo_server()
+    try:
+        real_connect = socket.create_connection
+        cut_once = {"done": False}
+
+        class CutFirstSend:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def sendall(self, data):
+                if not cut_once["done"]:
+                    cut_once["done"] = True
+                    self._inner.sendall(data[: max(1, len(data) // 2)])
+                    self._inner.close()
+                    raise ConnectionResetError("injected mid-frame cut")
+                return self._inner.sendall(data)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        cli = RpcClient(
+            ("127.0.0.1", srv.port),
+            timeout=5.0,
+            retries=3,
+            backoff_base=0.001,
+            connect=lambda addr: CutFirstSend(real_connect(addr, timeout=5)),
+        )
+        h, _ = cli.call("echo", {"x": 9})
+        assert h["echo"] == 9
+        assert srv._test_calls["n"] == 1, "cut+retry must not double-execute"
+        cli.close()
+    finally:
+        srv.stop()
+
+
+def test_deadline_miss_then_unavailable():
+    """A server that accepts but never answers: the call must respect its
+    per-call budget and surface RpcUnavailable, not hang."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+    stop = threading.Event()
+
+    def black_hole():
+        while not stop.is_set():
+            lsock.settimeout(0.2)
+            try:
+                conn, _ = lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.settimeout(5)  # read and discard forever
+
+    t = threading.Thread(target=black_hole, daemon=True)
+    t.start()
+    try:
+        cli = RpcClient(
+            ("127.0.0.1", port), timeout=0.3, retries=1, backoff_base=0.001
+        )
+        t0 = time.monotonic()
+        with pytest.raises(RpcUnavailable):
+            cli.call("echo", {"x": 1})
+        assert time.monotonic() - t0 < 5.0, "deadline must bound the call"
+        cli.close()
+    finally:
+        stop.set()
+        lsock.close()
+        t.join(timeout=2)
+
+
+def test_refused_connect_retries_then_unavailable():
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    dead_port = lsock.getsockname()[1]
+    lsock.close()  # nothing listens here
+    slept = []
+    cli = RpcClient(
+        ("127.0.0.1", dead_port),
+        timeout=0.5,
+        retries=2,
+        backoff_base=0.01,
+        sleep=slept.append,
+    )
+    with pytest.raises(RpcUnavailable):
+        cli.call("echo")
+    assert len(slept) == 2, "each retry must back off"
+
+
+def test_backoff_is_capped_exponential_and_deterministic():
+    d1 = backoff_delays(6, base=0.05, cap=1.0, seed="s")
+    d2 = backoff_delays(6, base=0.05, cap=1.0, seed="s")
+    d3 = backoff_delays(6, base=0.05, cap=1.0, seed="t")
+    assert d1 == d2 != d3
+    assert all(0 < d <= 1.0 for d in d1), "cap must bound every delay"
+    # the jitter envelope grows with the attempt index until the cap
+    assert all(d <= min(1.0, 0.05 * 2**i) for i, d in enumerate(d1))
+
+
+def test_ping_health_probe():
+    srv = _echo_server()
+    try:
+        cli = RpcClient(("127.0.0.1", srv.port), timeout=2.0)
+        assert cli.ping() is True
+        cli.close()
+    finally:
+        srv.stop()
+    assert cli.ping() is False, "a stopped server must fail the probe"
+
+
+def test_duplicate_request_during_inflight_execution_runs_once():
+    """The check-then-execute race: a retry arriving while the FIRST
+    execution is still running must wait for that result and replay it —
+    never execute the handler a second time."""
+    import threading as _threading
+
+    gate = _threading.Event()
+    calls = {"n": 0}
+
+    def slow(header, arrays):
+        calls["n"] += 1
+        gate.wait(5)
+        return {"n": calls["n"]}
+
+    srv = RpcServer({"slow": slow}, frame_deadline=10.0).start()
+    try:
+        results = []
+
+        def call():
+            cli = RpcClient(("127.0.0.1", srv.port), timeout=8.0)
+            h, _ = cli.call("slow", request_id="dup-1")
+            results.append(h["n"])
+            cli.close()
+
+        t1 = _threading.Thread(target=call)
+        t2 = _threading.Thread(target=call)
+        t1.start()
+        time.sleep(0.2)  # first call is parked inside the handler
+        t2.start()
+        time.sleep(0.2)
+        gate.set()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert results == [1, 1], results
+        assert calls["n"] == 1, "in-flight duplicate must not re-execute"
+    finally:
+        srv.stop()

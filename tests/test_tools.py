@@ -233,7 +233,8 @@ def test_bench_regime_selection_args():
     assert bench._parse_args([]).regime == "all"
     assert bench._parse_args(["--regime", "ragged"]).regime == "ragged"
     assert set(bench.REGIMES) == {
-        "uniform", "ragged", "stream", "recall", "exact", "matcher", "index"
+        "uniform", "ragged", "stream", "recall", "exact", "matcher", "index",
+        "fleet",
     }
     try:
         bench._parse_args(["--regime", "nope"])
@@ -256,9 +257,22 @@ def test_bench_index_regime_reports_throughput_and_reopen():
     assert out["index_resident_bytes"] < out["index_segment_bytes"]
 
 
+def test_bench_fleet_regime_reports_throughput():
+    """``bench.py --regime fleet``: the same check_and_add workload as
+    the index regime, through a real 2×2 loopback fleet."""
+    import bench
+
+    out = bench._bench_fleet(2048, nb=9)
+    assert out["fleet_insert_rows_per_sec"] > 0
+    assert out["fleet_probe_rows_per_sec"] > 0
+    assert out["fleet_shards"] == 2 and out["fleet_replicas"] == 2
+
+
 def test_lint_imports_clean_tree():
     """Tier-1 layering gate: core/ops/utils must not import pipeline/net/
-    obs, index/ must not import pipeline — over the REAL tree."""
+    obs, index/ must not import pipeline or net (net.rpc excepted — the
+    fleet's transport), net/ must not import pipeline — over the REAL
+    tree."""
     import lint_imports
 
     problems = lint_imports.lint()
@@ -284,9 +298,23 @@ def test_lint_imports_catches_violations(tmp_path):
     )
     (pkg / "index" / "ok.py").write_text(
         "from advanced_scrapper_tpu.obs import telemetry\n"  # allowed here
+        # the ONE transport exemption: the fleet may ride net/rpc...
+        "import advanced_scrapper_tpu.net.rpc as rpc\n"
+    )
+    (pkg / "index" / "bad_net.py").write_text(
+        # ...but no other net/ module (protocol, not transport)
+        "from advanced_scrapper_tpu.net.lease import LeaseServer\n"
+    )
+    (pkg / "net").mkdir()
+    (pkg / "net" / "bad.py").write_text(
+        "def h():\n"
+        "    from advanced_scrapper_tpu.pipeline.scraper import SUCCESS_FIELDS\n"
     )
     problems = lint_imports.lint(str(tmp_path))
-    assert len(problems) == 3, problems
+    assert len(problems) == 5, problems
     assert any("core/ must not import obs/" in p for p in problems)
     assert any("core/ must not import pipeline/" in p for p in problems)
     assert any("index/ must not import pipeline/" in p for p in problems)
+    assert any("index/ must not import net/" in p for p in problems)
+    assert any("net/ must not import pipeline/" in p for p in problems)
+    assert not any("ok.py" in p for p in problems), "net.rpc is exempt"

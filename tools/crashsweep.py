@@ -51,6 +51,16 @@ STREAM_DOCS = 40
 PINDEX_DOCS = 64
 PINDEX_BANDS = 8
 
+FLEET_DOCS = 64
+FLEET_BATCH = 8
+FLEET_SHARDS = 2
+FLEET_REPLICAS = 2
+#: seeded kill mechanisms for the fleet sweep, cycled per case: SIGKILL a
+#: shard primary right before an insert-heavy batch / before a probe /
+#: with the replica too (forcing spill + promotion-window recovery), or
+#: chaos-exit the primary INSIDE a WAL append syscall
+FLEET_KILL_MODES = ("insert", "probe", "promotion", "wal")
+
 
 # -- deterministic synthetic data -------------------------------------------
 
@@ -264,11 +274,255 @@ def child_pindex(case_dir: str, seed: int) -> int:
     return 0
 
 
+def _fleet_doc_keys(i: int):
+    """Band keys for fleet doc ``i`` — same planted-near-dup scheme as the
+    pindex workload (``i % 7 == 3`` shares keys with ``i - 3``), under a
+    distinct salt so fleet and pindex cases never alias."""
+    import numpy as np
+
+    src = i - 3 if (i % 7 == 3 and i >= 3) else i
+    x = (np.arange(PINDEX_BANDS, dtype=np.uint64)
+         + np.uint64(src * 1000 + 7)) * np.uint64(0xD1B54A32D192ED03)
+    x ^= x >> np.uint64(31)
+    return x
+
+
+_FLEET_ORACLE_CACHE: list = []
+
+
+def fleet_oracle_annotations():
+    """The never-killed single-node truth the fleet must byte-match:
+    batches of FLEET_BATCH docs through ONE PersistentIndex in a temp dir
+    (allocate → check_and_add), annotations as int64 per doc.  Memoized —
+    a pure function of module constants, and the 20-case sweep verifies
+    against it per case."""
+    if _FLEET_ORACLE_CACHE:
+        return _FLEET_ORACLE_CACHE[0]
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from advanced_scrapper_tpu.index import PersistentIndex
+
+    base = tempfile.mkdtemp(prefix="fleet-oracle-")
+    idx = PersistentIndex(
+        os.path.join(base, "oracle"),
+        cut_postings=6 * PINDEX_BANDS,
+        compact_segments=4,
+        compact_inline=True,
+    )
+    ann: list[int] = []
+    try:
+        for start in range(0, FLEET_DOCS, FLEET_BATCH):
+            rows = range(start, min(start + FLEET_BATCH, FLEET_DOCS))
+            keys = np.stack([_fleet_doc_keys(i) for i in rows])
+            ids = idx.allocate_doc_ids(len(keys))
+            ann += np.asarray(idx.check_and_add_batch(keys, ids)).tolist()
+        keys_all, docs_all = idx.dump_postings()
+        minmap: dict[int, int] = {}
+        for k, d in zip(keys_all.tolist(), docs_all.tolist()):
+            if k not in minmap or d < minmap[k]:
+                minmap[k] = d
+    finally:
+        idx.close()
+        shutil.rmtree(base, ignore_errors=True)
+    _FLEET_ORACLE_CACHE.append((ann, minmap))
+    return ann, minmap
+
+
+def _fleet_pick_ports(n: int) -> list[int]:
+    """Reserve ``n`` distinct free ports up front: a killed node must be
+    respawnable at the SAME address, so the client's failover/rejoin path
+    is exercised without re-wiring the topology."""
+    import socket
+
+    socks = []
+    try:
+        for _ in range(n):
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _fleet_spawn_server(
+    case_dir: str, sid: int, rep: int, chaos: str | None, port: int
+):
+    """Fork one IndexShardServer over its (possibly crash-scarred) dir;
+    PDEATHSIG ties it to the orchestrating child so a killed orchestrator
+    can never leak a listening server into the next case."""
+    import ctypes
+
+    sdir = os.path.join(case_dir, f"s{sid}n{rep}")
+    pf = os.path.join(case_dir, f"s{sid}n{rep}.port")
+    if os.path.exists(pf):
+        os.unlink(pf)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", ASTPU_TELEMETRY="0")
+    env.pop("ASTPU_CHAOS_FS", None)
+    if chaos:
+        env["ASTPU_CHAOS_FS"] = chaos
+
+    def _pdeathsig():
+        ctypes.CDLL(None).prctl(1, signal.SIGKILL)  # PR_SET_PDEATHSIG
+
+    log = open(os.path.join(case_dir, f"s{sid}n{rep}.log"), "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "advanced_scrapper_tpu.index.remote",
+            "--dir", sdir, "--port", str(port), "--port-file", pf,
+            "--spaces", "bands",
+            "--cut-postings", str(6 * PINDEX_BANDS),
+            "--compact-segments", "4",
+            "--name", f"s{sid}n{rep}",
+        ],
+        env=env, cwd=REPO, stdout=log, stderr=log, preexec_fn=_pdeathsig,
+    )
+    log.close()
+    deadline = time.monotonic() + 30
+    while not os.path.exists(pf):
+        if proc.poll() is not None or time.monotonic() > deadline:
+            raise RuntimeError(f"shard server s{sid}n{rep} never bound")
+        time.sleep(0.01)
+    return proc
+
+
+def child_fleet(case_dir: str, seed: int) -> int:
+    """Fleet ingest under seeded shard-primary kills.
+
+    Spawns FLEET_SHARDS×FLEET_REPLICAS real shard-server processes, runs
+    the planted-dup batch stream through ShardedIndexClient, and at a
+    seeded batch SIGKILLs a seeded shard's primary (mode-dependent:
+    before an insert-heavy batch, before a probe, together with its
+    replica — forcing journaled spill until the replica restarts — or via
+    chaos-exit INSIDE a WAL append).  The client must carry the stream to
+    completion through failover/promotion/spill-replay; annotations are
+    written for the verifier to byte-compare against the single-node
+    oracle, alongside the client's fault counters."""
+    os.environ["ASTPU_TELEMETRY"] = "1"  # counters must be real in here
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    from advanced_scrapper_tpu.index.fleet import FleetSpec, ShardedIndexClient
+
+    rng = random.Random(f"fleet-child|{seed}")
+    mode = FLEET_KILL_MODES[seed % len(FLEET_KILL_MODES)]
+    kill_shard = rng.randrange(FLEET_SHARDS)
+    n_batches = (FLEET_DOCS + FLEET_BATCH - 1) // FLEET_BATCH
+    kill_batch = rng.randrange(2, n_batches - 2)
+    revive_batch = min(n_batches - 1, kill_batch + 2)
+
+    port_list = _fleet_pick_ports(FLEET_SHARDS * FLEET_REPLICAS)
+    ports = {
+        (sid, rep): port_list[sid * FLEET_REPLICAS + rep]
+        for sid in range(FLEET_SHARDS)
+        for rep in range(FLEET_REPLICAS)
+    }
+    procs: dict[tuple[int, int], subprocess.Popen] = {}
+    try:
+        for sid in range(FLEET_SHARDS):
+            for rep in range(FLEET_REPLICAS):
+                chaos = None
+                if mode == "wal" and sid == kill_shard and rep == 0:
+                    # hard-exit INSIDE a WAL append write, seeded offset
+                    chaos = (
+                        f"seed={seed},crash=0.35,exit=1,only=wal-"
+                    )
+                procs[(sid, rep)] = _fleet_spawn_server(
+                    case_dir, sid, rep, chaos, ports[(sid, rep)]
+                )
+        spec = FleetSpec(
+            shards=tuple(
+                tuple(
+                    ("127.0.0.1", ports[(sid, rep)])
+                    for rep in range(FLEET_REPLICAS)
+                )
+                for sid in range(FLEET_SHARDS)
+            )
+        )
+        client = ShardedIndexClient(
+            spec,
+            space="bands",
+            spill_dir=os.path.join(case_dir, "spill"),
+            timeout=1.0,
+            retries=1,
+            health_checks=2,
+            health_timeout=0.3,
+        )
+        _touch_marker(case_dir)
+        ann: list[int] = []
+        for b in range(n_batches):
+            if b == kill_batch and mode in ("insert", "probe", "promotion"):
+                os.kill(procs[(kill_shard, 0)].pid, signal.SIGKILL)
+                procs[(kill_shard, 0)].wait()
+                if mode == "promotion":
+                    # the candidate dies too, INSIDE the promotion the
+                    # client is about to attempt: the shard goes fully
+                    # dark and this window's writes must spill
+                    os.kill(procs[(kill_shard, 1)].pid, signal.SIGKILL)
+                    procs[(kill_shard, 1)].wait()
+                if mode == "probe":
+                    # land the discovery inside a probe, not an insert
+                    client.probe_batch(
+                        np.stack([_fleet_doc_keys(0), _fleet_doc_keys(1)])
+                    )
+            if b == revive_batch and mode == "promotion":
+                # the restarted node recovers its index from disk at the
+                # SAME address; the client's next touches revive it,
+                # promote it, and replay the spill journal into it
+                procs[(kill_shard, 1)] = _fleet_spawn_server(
+                    case_dir, kill_shard, 1, None, ports[(kill_shard, 1)]
+                )
+            rows = range(
+                b * FLEET_BATCH, min((b + 1) * FLEET_BATCH, FLEET_DOCS)
+            )
+            keys = np.stack([_fleet_doc_keys(i) for i in rows])
+            ids = client.allocate_doc_ids(len(keys))
+            ann += np.asarray(client.check_and_add_batch(keys, ids)).tolist()
+        client.checkpoint()  # recovery probe: drains any remaining spill
+        report = {
+            "mode": mode,
+            "kill_shard": kill_shard,
+            "kill_batch": kill_batch,
+            "annotations": ann,
+            "failovers": client._m_failovers.value,
+            "promotions": client._m_promotions.value,
+            "spilled": client._m_spilled.value,
+            "replayed": client._m_replayed.value,
+            "degraded": client._m_degraded.value,
+            "spill_pending": sum(
+                int(k.size) for sh in client._shards for (_r, k, _d) in sh.pending
+            ),
+        }
+        client.close()
+        from advanced_scrapper_tpu.storage.fsio import atomic_replace
+
+        atomic_replace(
+            os.path.join(case_dir, "fleet_report.json"),
+            json.dumps(report).encode(),
+        )
+        return 0
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 CHILDREN = {
     "harvest": child_harvest,
     "scrape": child_scrape,
     "stream": child_stream,
     "pindex": child_pindex,
+    "fleet": child_fleet,
 }
 
 
@@ -432,6 +686,105 @@ def verify_pindex(case_dir: str) -> list[str]:
     return problems
 
 
+def verify_fleet(case_dir: str) -> list[str]:
+    """Fleet convergence against the single-node oracle:
+
+    - the child's dedup annotations are BYTE-identical to the oracle's;
+    - per shard, the union of its node indexes holds exactly the oracle's
+      posting keys for that shard's ring slice, with identical min doc
+      ids — zero lost, zero duplicated (each node checked individually
+      for duplicate keys: a duplicate is a double-applied retry);
+    - the SIGKILLed primary's directory — frozen at its kill point —
+      still opens read-only (manifest whole-or-previous, WAL torn tail
+      dropped);
+    - the spill journal fully replayed (``spill_pending == 0``) and the
+      mode's failover/promotion/spill counters actually moved.
+    """
+    import numpy as np
+
+    from advanced_scrapper_tpu.index import PersistentIndex
+    from advanced_scrapper_tpu.index.fleet import ring_assign
+
+    problems: list[str] = []
+    report_path = os.path.join(case_dir, "fleet_report.json")
+    if not os.path.exists(report_path):
+        return ["fleet child never wrote its report (ingest died)"]
+    with open(report_path) as f:
+        report = json.load(f)
+
+    oracle_ann, oracle_minmap = fleet_oracle_annotations()
+    if report["annotations"] != oracle_ann:
+        diff = [
+            i for i, (a, b) in enumerate(zip(report["annotations"], oracle_ann))
+            if a != b
+        ]
+        problems.append(
+            f"annotations diverge from the single-node oracle at docs "
+            f"{diff[:5]} (of {len(diff)})"
+        )
+
+    all_keys = np.array(sorted(oracle_minmap), dtype=np.uint64)
+    shard_of = ring_assign(all_keys, FLEET_SHARDS)
+    for sid in range(FLEET_SHARDS):
+        expect = {
+            int(k): oracle_minmap[int(k)]
+            for k in all_keys[shard_of == sid].tolist()
+        }
+        union: dict[int, int] = {}
+        for rep in range(FLEET_REPLICAS):
+            sdir = os.path.join(case_dir, f"s{sid}n{rep}", "bands")
+            if not os.path.isdir(sdir):
+                continue
+            try:
+                idx = PersistentIndex(sdir, read_only=True)
+            except Exception as e:
+                problems.append(f"shard s{sid}n{rep} unopenable: {e}")
+                continue
+            try:
+                keys, docs = idx.dump_postings()
+            finally:
+                idx.close()
+            if len(keys) != len(set(keys.tolist())):
+                problems.append(
+                    f"duplicated postings on s{sid}n{rep} (double-applied retry)"
+                )
+            for k, d in zip(keys.tolist(), docs.tolist()):
+                if k in union and union[k] != d:
+                    problems.append(
+                        f"shard {sid} replicas disagree on key {k}: "
+                        f"{union[k]} vs {d}"
+                    )
+                union[k] = min(union.get(k, d), d)
+        if union != expect:
+            missing = set(expect) - set(union)
+            extra = set(union) - set(expect)
+            wrong = {
+                k for k in set(expect) & set(union) if expect[k] != union[k]
+            }
+            problems.append(
+                f"shard {sid} postings lost/invented: missing={len(missing)} "
+                f"extra={len(extra)} wrong_doc={len(wrong)}"
+            )
+
+    if report.get("spill_pending"):
+        problems.append(
+            f"{report['spill_pending']} spilled postings never replayed"
+        )
+    mode = report.get("mode")
+    if mode in ("insert", "probe", "promotion") and not report.get("failovers"):
+        problems.append(f"mode {mode}: the kill never caused a failover")
+    if mode == "promotion":
+        if not report.get("promotions"):
+            problems.append("promotion mode: no promotion happened")
+        if not report.get("spilled") or not report.get("replayed"):
+            problems.append(
+                "promotion mode: spill/replay counters never moved "
+                f"(spilled={report.get('spilled')}, "
+                f"replayed={report.get('replayed')})"
+            )
+    return problems
+
+
 SAFETY_CHECKS = {
     "harvest": check_harvest_safety,
     "stream": check_stream_safety,
@@ -442,6 +795,7 @@ VERIFIERS = {
     "scrape": verify_scrape,
     "stream": verify_stream,
     "pindex": verify_pindex,
+    "fleet": verify_fleet,
 }
 
 #: chaos specs that land the pindex kill-points INSIDE each durability
@@ -610,12 +964,65 @@ def sweep_workload(
     }
 
 
+def sweep_fleet(base_dir: str, *, kills: int, seed: int = 0) -> dict:
+    """Seeded fleet sweep: each case runs the fleet child ONCE (the
+    client survives its shard-primary kills and carries the stream to
+    completion — restart-and-resume is the SHARD's story, exercised by
+    the respawn inside the case), then verifies byte-convergence against
+    the single-node oracle.  The kill mechanism cycles through
+    ``FLEET_KILL_MODES`` via the case seed."""
+    cases = []
+    for i in range(kills):
+        case_seed = seed * 1000 + i
+        case_dir = os.path.join(base_dir, f"fleet-k{i}")
+        os.makedirs(case_dir, exist_ok=True)
+        rec: dict = {
+            "workload": "fleet",
+            "seed": case_seed,
+            "mode": FLEET_KILL_MODES[case_seed % len(FLEET_KILL_MODES)],
+        }
+        proc = _spawn("fleet", case_dir, case_seed, None)
+        try:
+            proc.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            rec["problems"] = ["fleet child hung past 240 s"]
+            cases.append(rec)
+            continue
+        problems = []
+        if proc.returncode != 0:
+            problems.append(f"fleet child exited {proc.returncode}")
+        problems += verify_fleet(case_dir)
+        report_path = os.path.join(case_dir, "fleet_report.json")
+        killed = False
+        if os.path.exists(report_path):
+            with open(report_path) as f:
+                r = json.load(f)
+            # a kill "landed" iff the client actually watched a node die
+            killed = bool(r.get("failovers") or r.get("degraded"))
+            rec["counters"] = {
+                k: r.get(k)
+                for k in ("failovers", "promotions", "spilled", "replayed",
+                          "degraded")
+            }
+        rec["killed"] = killed
+        rec["problems"] = problems
+        cases.append(rec)
+    return {
+        "workload": "fleet",
+        "cases": cases,
+        "kills": sum(1 for c in cases if c.get("killed")),
+        "problems": [p for c in cases for p in c.get("problems", [])],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--child", choices=sorted(CHILDREN), default=None)
     ap.add_argument("--dir", default=None)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--kills", type=int, default=21, help="total kill instants")
+    ap.add_argument("--kills", type=int, default=26, help="total kill instants")
     ap.add_argument("--out", default=None, help="write the JSON report here")
     args = ap.parse_args(argv)
 
@@ -625,7 +1032,7 @@ def main(argv=None) -> int:
     import tempfile
 
     base = args.dir or tempfile.mkdtemp(prefix="crashsweep-")
-    per = max(1, args.kills // 4)
+    per = max(1, args.kills // 5)
     report = {
         "seed": args.seed,
         "workloads": [
@@ -643,10 +1050,11 @@ def main(argv=None) -> int:
                 seed=args.seed,
                 chaos_only=PINDEX_CHAOS_TARGETS,
             ),
+            sweep_fleet(base, kills=per, seed=args.seed),
             sweep_workload(
                 "stream",
                 base,
-                sigkills=args.kills - 3 * per - 1,
+                sigkills=args.kills - 4 * per - 1,
                 chaos_kills=1,
                 seed=args.seed,
                 kill_window=(0.05, 1.2),

@@ -12,7 +12,11 @@ runtime.
 Rules (banned prefixes per source layer)::
 
     core/, ops/, utils/  must not import  pipeline/, net/, obs/
-    index/               must not import  pipeline/
+    index/               must not import  pipeline/, net/  (EXCEPT net.rpc:
+                         the fleet rides the RPC transport, and ONLY the
+                         transport — protocol modules like net.lease stay
+                         out of the index layer)
+    net/                 must not import  pipeline/
 
 Every ``import``/``from`` statement is found by walking the AST — including
 function-local imports, which the hot paths use deliberately — so a lazy
@@ -36,7 +40,18 @@ RULES: dict[str, tuple[str, ...]] = {
     "core": ("pipeline", "net", "obs"),
     "ops": ("pipeline", "net", "obs"),
     "utils": ("pipeline", "net", "obs"),
-    "index": ("pipeline",),
+    "index": ("pipeline", "net"),
+    "net": ("pipeline",),
+}
+
+#: source layer → module names exempt from that layer's bans (exact module
+#: or a prefix of it).  Keep this list SHORT and transport-shaped: an
+#: exemption is an architectural decision, not an escape hatch.
+ALLOW: dict[str, tuple[str, ...]] = {
+    # the index fleet uses net/rpc as a dumb byte transport; importing any
+    # other net/ module (lease protocol, webdriver, transports) from
+    # index/ would invert the tree
+    "index": (f"{PACKAGE}.net.rpc",),
 }
 
 
@@ -59,7 +74,10 @@ def check_file(path: str, layer: str, banned: tuple[str, ...]) -> list[str]:
         except SyntaxError as e:
             return [f"{path}: unparseable ({e})"]
     problems = []
+    allowed = ALLOW.get(layer, ())
     for lineno, mod in _imported_modules(tree):
+        if any(mod == a or mod.startswith(a + ".") for a in allowed):
+            continue
         for target in banned:
             prefix = f"{PACKAGE}.{target}"
             if mod == prefix or mod.startswith(prefix + "."):
