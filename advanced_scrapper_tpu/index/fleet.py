@@ -52,12 +52,12 @@ import hashlib
 import os
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from advanced_scrapper_tpu.index.store import NO_DOC, resolve_intra_batch
+from advanced_scrapper_tpu.runtime import FanoutPool
 from advanced_scrapper_tpu.index.wal import WriteAheadLog, replay_wal
 from advanced_scrapper_tpu.net.rpc import RpcClient, RpcUnavailable
 
@@ -287,9 +287,10 @@ class ShardedIndexClient:
                     ],
                 )
             )
-        self._pool = ThreadPoolExecutor(
-            max_workers=min(16, 2 * len(self._shards)),
-            thread_name_prefix=f"astpu-fleet-{space}",
+        # per-shard RPC fan-out rides the runtime's Edge-fed pool: remote
+        # hops get the same queue telemetry/snapshot as local stages
+        self._pool = FanoutPool(
+            min(16, 2 * len(self._shards)), name=f"fleet-{space}"
         )
         self._instrument()
         if spill_dir:

@@ -37,6 +37,7 @@ from bs4 import BeautifulSoup
 
 from advanced_scrapper_tpu.config import FeedConfig
 from advanced_scrapper_tpu.obs.stats import RateStats
+from advanced_scrapper_tpu.runtime import Edge
 
 
 def _send_json(sock: socket.socket, lock: threading.Lock, obj: dict) -> None:
@@ -107,7 +108,10 @@ class LeaseServer:
         self.port = port if port is not None else cfg.port
         self._status_port = status_port
         self.status_server = None
-        self._urls: queue.SimpleQueue[str] = queue.SimpleQueue()
+        # the work queue is a runtime Edge: the scheduler's depth/stall
+        # telemetry (astpu_edge_*{graph="lease"}) and the crash snapshot
+        # see the lease plane's backlog exactly like a local stage's
+        self._urls: Edge = Edge("urls", graph="lease")
         # dedup on ingest: a url is one unit of work (the per-client
         # assigned sets — and the stray-result guard built on them — are
         # keyed by url, so a duplicated input row would leave a pending
@@ -495,8 +499,10 @@ class LeaseClient:
         # chaos harness uses to put a ChaosSocket under the whole client
         # without touching protocol code (net/chaos.py)
         self._connect = connect
-        self._tasks: queue.Queue[str] = queue.Queue()
-        self._results: queue.Queue[tuple[str, str]] = queue.Queue()
+        # leased-work and result queues as runtime Edges (queue-compat
+        # surface): fleet hops ride the same abstraction as local stages
+        self._tasks: Edge = Edge("tasks", graph="lease_client")
+        self._results: Edge = Edge("results", graph="lease_client")
         self._inflight = 0              # urls popped but not yet resulted
         self._inflight_lock = threading.Lock()
         self._stop = threading.Event()

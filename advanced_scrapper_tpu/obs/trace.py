@@ -44,8 +44,22 @@ __all__ = [
     "new_trace_id",
     "dump",
     "dump_on_fault",
+    "add_fault_hook",
     "install_excepthook",
 ]
+
+#: callbacks run (once, with the recorder) inside :meth:`dump_on_fault`
+#: BEFORE the sidecar is written — how the stage-graph runtime lands a
+#: whole-graph drain snapshot in the ring at the kill point without this
+#: module importing the runtime (the hook is registered BY the runtime).
+_FAULT_HOOKS: list = []
+
+
+def add_fault_hook(fn) -> None:
+    """Register ``fn(recorder)`` to run on the crash path.  Hooks must be
+    fast and must never raise (they run while the process is dying)."""
+    if fn not in _FAULT_HOOKS:
+        _FAULT_HOOKS.append(fn)
 
 _TRUTHY = ("1", "true", "yes", "on")
 
@@ -178,6 +192,15 @@ class FlightRecorder:
                 if self._dumped:
                     return None
                 self._dumped = True
+            # fault hooks land their state (e.g. the stage-graph runtime's
+            # whole-graph drain snapshot) in the ring BEFORE the dump —
+            # each individually guarded so one bad hook cannot cost the
+            # sidecar its remaining events
+            for fn in list(_FAULT_HOOKS):
+                try:
+                    fn(self)
+                except Exception:
+                    pass
             return self.dump(reason=reason)
         except Exception:
             return None

@@ -12,6 +12,7 @@
 
 from __future__ import annotations
 
+import threading
 from typing import Sequence
 
 import numpy as np
@@ -34,6 +35,18 @@ from advanced_scrapper_tpu.ops.lsh import (
     resolve_rep_bands_from_ok,
 )
 from advanced_scrapper_tpu.ops.minhash import resolve_signature_fn
+
+
+#: the DECLARED hook edge for ROADMAP item 2's candidate-verification
+#: (rerank) tier: in the dedup stage graph (encode → h2d → kernel →
+#: candidates → resolve) this names the edge between candidate generation
+#: and union-find resolution.  A :attr:`NearDupEngine.rerank_hook`
+#: callable ``(raw, sigs, rep_bands, valid) -> rep_bands`` slots in here —
+#: BOTH resolution paths (async/estimator-only and the certified one-shot)
+#: route their candidate matrix through it before resolving, so a
+#: device-batched exact-Jaccard rerank tier becomes a graph edge, not a
+#: bespoke rewrite of either path.
+RERANK_HOOK_EDGE = "dedup.candidates->dedup.resolve"
 
 
 def _jump_rounds(n: int) -> int:
@@ -74,6 +87,10 @@ class NearDupEngine:
         # compiled fused-step cache for dedup_reps_sharded, keyed on
         # (mesh, article bucket, block_len) — meshes are hashable
         self._sharded_steps: dict = {}
+        #: the rerank tier's slot on :data:`RERANK_HOOK_EDGE` — when set,
+        #: every resolution path passes its candidate matrix through it
+        #: before union-find (None = pass-through)
+        self.rerank_hook = None
         self._instrument()
 
     def _instrument(self) -> None:
@@ -337,25 +354,37 @@ class NearDupEngine:
         running = jnp.full((n_bucket, params.num_perm), U32_MAX, jnp.uint32)
         dispatched = 0
         if put_workers > 1:
-            from collections import deque
-            from concurrent.futures import ThreadPoolExecutor
+            # encode→h2d as a stage graph: pull workers draw width-group
+            # batches off the (locked) encode generator and device_put
+            # them concurrently; the capacity-1 ``staged`` edge bounds
+            # resident tiles at put_workers (executing) + 1 (buffered)
+            # + 1 (being accumulated) — the SAME window the hand-rolled
+            # executor+deque enforced, now via the runtime's
+            # backpressure.  The min-combine is order-independent, so
+            # out-of-order staging never matters.
+            from advanced_scrapper_tpu.runtime import DONE, StageGraph
+
+            gen = host_batches()
+            gen_lock = threading.Lock()
+
+            def pull():
+                with gen_lock:
+                    return next(gen, DONE)
 
             def put(batch):
                 t, l, o = batch
                 with stages.timed("h2d"):
                     return jax.device_put(t), jax.device_put(l), jax.device_put(o)
 
-            # bounded in-flight: at most put_workers+1 batches encoded /
-            # resident beyond the accumulate chain — Executor.map would
-            # drain the generator (and transfer the whole corpus) up front
-            with ThreadPoolExecutor(put_workers) as ex:
-                gen = host_batches()
-                pending: deque = deque()
-                for batch in gen:
-                    pending.append(ex.submit(put, batch))
-                    if len(pending) <= put_workers:
-                        continue
-                    t, l, o = pending.popleft().result()
+            g = StageGraph("dedup.h2d")
+            staged = g.edge("staged", capacity=1)
+            g.stage(
+                "h2d", source=pull, fn=put, out_edge=staged,
+                workers=put_workers,
+            )
+            g.start()
+            try:
+                for t, l, o in staged:
                     dispatched += 1
                     with stages.timed("kernel"), self.step_timer.step(
                         int(t.shape[0])
@@ -364,16 +393,11 @@ class NearDupEngine:
                             running, block_fn(t, l, params), o,
                             num_articles=n_bucket,
                         )
-                while pending:
-                    t, l, o = pending.popleft().result()
-                    dispatched += 1
-                    with stages.timed("kernel"), self.step_timer.step(
-                        int(t.shape[0])
-                    ):
-                        running = accumulate_block_signatures(
-                            running, block_fn(t, l, params), o,
-                            num_articles=n_bucket,
-                        )
+                if g.error is not None:
+                    raise g.error  # the original worker exception, unwrapped
+            finally:
+                g.stop()
+                g.join(timeout=30, raise_error=False)
         else:
             for t, l, o in host_batches():
                 with stages.timed("h2d"):
@@ -419,6 +443,11 @@ class NearDupEngine:
                 sigs, self.params.band_salt, self.cfg.cand_subbands
             )
             rep_bands = duplicate_rep_bands(keys, valid)
+        if self.rerank_hook is not None:
+            # the declared RERANK_HOOK_EDGE: candidates flow through the
+            # rerank tier before EITHER resolution path sees them
+            with trace.span("dedup.rerank", trace=tid, docs=n):
+                rep_bands = self.rerank_hook(raw, sigs, rep_bands, valid)
         return raw, sigs, keys, valid, rep_bands, n_bucket, tid
 
     def dedup_reps_async(self, texts: Sequence[str | bytes], *, _regime: str = "async"):

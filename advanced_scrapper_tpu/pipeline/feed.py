@@ -2,11 +2,18 @@
 
 The async feeding architecture SURVEY.md §7 calls the hard part ("the host
 must tokenize+batch faster than the device consumes"): producers push raw
-documents into the C++ :class:`cpu.hostbatch.HostBatcher`; a feed thread
+documents into the C++ :class:`cpu.hostbatch.HostBatcher`; a staging stage
 pops fixed-shape tiles and ``jax.device_put``\\ s them ahead of use (depth-2
 double buffering), so batch assembly, H2D transfer, and device compute
 overlap.  Tags (uint64, caller-chosen) ride along so results map back to
 records without the host ever re-ordering documents.
+
+Since the stage-graph runtime landed, the staging path IS a graph: the
+``stage`` stage (N pull workers) feeds a runtime-owned ``staged`` edge
+whose capacity is the prefetch depth — backpressure, close propagation,
+error fan-out, depth/stall telemetry and the crash drain-snapshot all come
+from ``advanced_scrapper_tpu.runtime`` instead of a hand-rolled
+thread/queue/sentinel protocol (MIGRATION.md maps the retired knobs).
 
 This is the firehose path: documents truncate at the feed block length
 (matching the queue's fixed row shape).  For full blockwise coverage of
@@ -16,8 +23,8 @@ very long texts use :class:`pipeline.dedup.NearDupEngine` directly.
 from __future__ import annotations
 
 import os
-import queue
 import threading
+import time
 from typing import Iterable, Iterator
 
 import numpy as np
@@ -27,6 +34,7 @@ from advanced_scrapper_tpu.core.hashing import make_params
 from advanced_scrapper_tpu.cpu.hostbatch import HostBatcher
 from advanced_scrapper_tpu.ops.lsh import band_keys
 from advanced_scrapper_tpu.ops.minhash import minhash_signatures
+from advanced_scrapper_tpu.runtime import DONE, RETRY, StageGraph
 
 
 def resolve_prefetch_depth(depth: int | None) -> int:
@@ -34,7 +42,7 @@ def resolve_prefetch_depth(depth: int | None) -> int:
     explicit ``depth`` wins, else ``ASTPU_FEED_PREFETCH``, else 2 (double
     buffering: one tile on device computing, one staging behind it)."""
     # <= 0 (explicit or via env, incl. "0") means "the default" — a
-    # non-positive depth would make the staging queue UNBOUNDED
+    # non-positive depth would make the staging edge UNBOUNDED
     if depth is not None and depth > 0:
         return depth
     env = int(os.environ.get("ASTPU_FEED_PREFETCH") or 0)
@@ -42,12 +50,12 @@ def resolve_prefetch_depth(depth: int | None) -> int:
 
 
 class DeviceFeed:
-    """Prefetching consumer of a :class:`HostBatcher`.
+    """Prefetching consumer of a :class:`HostBatcher`, run as a stage graph.
 
-    A daemon thread pops host tiles and places them on device, keeping up to
-    ``depth`` batches in flight.  Iterate to receive
-    ``(n, tokens_dev, lengths_dev, tags)`` tuples; iteration ends when the
-    batcher is closed and drained.
+    The ``stage`` stage's workers pop host tiles and place them on device;
+    the runtime's ``staged`` edge keeps up to ``depth`` batches in flight.
+    Iterate to receive ``(n, tokens_dev, lengths_dev, tags)`` tuples;
+    iteration ends when the batcher is closed and drained.
 
     Staging discipline: pops wait (up to ``poll_timeout_ms``) until a FULL
     tile's worth of documents is queued (``min_fill=batch_size``).  Without
@@ -71,7 +79,7 @@ class DeviceFeed:
         workers: int | None = None,
         min_fill: int | None = None,
     ):
-        """``workers > 1`` runs several pop→device_put threads: on a
+        """``workers > 1`` runs several pull workers (pop→device_put): on a
         transport whose per-put round trip serializes (the tunneled dev
         chip), concurrent puts overlap that latency.  Batches may then
         arrive out of submission order — safe for the dedup path, where
@@ -90,19 +98,27 @@ class DeviceFeed:
         self.sharding = sharding
         self.poll_timeout_ms = poll_timeout_ms
         self.min_fill = batch_size if min_fill is None else min_fill
-        depth = resolve_prefetch_depth(depth)
-        self._out: "queue.Queue" = queue.Queue(maxsize=depth)
-        self._instrument()
-        self._error: BaseException | None = None
         self._jax = jax
-        self._exit_lock = threading.Lock()
-        self._remaining = max(1, workers)
-        self._threads = [
-            threading.Thread(target=self._run, daemon=True)
-            for _ in range(max(1, workers))
-        ]
-        for t in self._threads:
-            t.start()
+        # hot-loop setup hoisted out of _pull (it runs once per tile AND
+        # once per empty poll): sharding specs and the obs module refs
+        self._tok_spec = self._len_spec = None
+        if sharding is not None:
+            self._tok_spec, self._len_spec = sharding
+        from advanced_scrapper_tpu.obs import stages as _stages
+        from advanced_scrapper_tpu.obs import trace as _trace
+
+        self._stages = _stages
+        self._trace = _trace
+        self._graph = StageGraph("feed")
+        self._out = self._graph.edge("staged", resolve_prefetch_depth(depth))
+        self._instrument()
+        self._graph.stage(
+            "stage",
+            source=self._pull,
+            out_edge=self._out,
+            workers=max(1, workers),
+        )
+        self._graph.start()
 
     _seq_lock = threading.Lock()
     _seq = 0
@@ -111,9 +127,11 @@ class DeviceFeed:
         """Telemetry handles, fetched once (no-ops when disabled).  Queue
         depth / arena occupancy / rejected pushes export as CALLBACK gauges
         read at scrape time — the feed loop itself never samples them —
-        and the per-batch step loop owns the once-orphaned ``StepTimer``
+        and the per-batch pull loop owns the once-orphaned ``StepTimer``
         so ``summary()`` is reachable from production code (and mirrors
-        into ``astpu_feed_step_seconds``)."""
+        into ``astpu_feed_step_seconds``).  The staged edge additionally
+        exports the runtime's own depth/stall series
+        (``astpu_edge_*{graph="feed"}``)."""
         from advanced_scrapper_tpu.obs import telemetry
         from advanced_scrapper_tpu.obs.profiler import StepTimer
 
@@ -176,90 +194,69 @@ class DeviceFeed:
             return self._jax.device_put(arr, spec)
         return self._jax.device_put(arr)
 
-    def _run(self) -> None:
-        tok_spec = len_spec = None
-        if self.sharding is not None:
-            tok_spec, len_spec = self.sharding
-        import time as _time
+    def _pull(self):
+        """One pop→device_put cycle: the ``stage`` stage's source.  Shared
+        by every pull worker (the C++ batcher is MPMC-safe); returns a
+        staged tuple, :data:`RETRY` on an empty poll, or :data:`DONE` once
+        the batcher is closed and drained."""
+        tok_spec, len_spec = self._tok_spec, self._len_spec
+        stages, trace = self._stages, self._trace
 
-        from advanced_scrapper_tpu.obs import stages, trace
-
-        try:
-            while self._error is None:  # a peer's death stops this worker too
-                t0 = _time.perf_counter()
-                # host tile assembly (pop+memcpy); a slow producer's waits
-                # land here too — "the host couldn't feed the device" is
-                # exactly what this stage exists to expose
-                with stages.timed("encode"):
-                    n, tok, lens, tags = self.batcher.pop_batch(
-                        self.batch_size,
-                        timeout_ms=self.poll_timeout_ms,
-                        min_fill=self.min_fill,
-                    )
-                if n == 0:
-                    # 0 rows = timeout (retry) or closed-and-drained (done);
-                    # close() is one-way so this check is race-free.
-                    if self.batcher.closed() and self.batcher.size() == 0:
-                        break
-                    continue
-                with stages.timed("h2d"):
-                    t_dev = self._put_device(tok, tok_spec)
-                    l_dev = self._put_device(lens, len_spec)
-                self._out.put((n, t_dev, l_dev, tags))
-                self.timer.add(_time.perf_counter() - t0, n)
-                self._m_batches.inc()
-                self._m_docs.inc(n)
-                self._m_fill.set(n / self.batch_size)
-                if n < self.batch_size:
-                    self._m_partial.inc()
-                if trace.RECORDER.active:
-                    # the ingest end of the span chain: the first tag names
-                    # the batch, so a dump ties "what was staging" to the
-                    # kernel/resolve spans downstream
-                    trace.record(
-                        "span",
-                        "feed.stage",
-                        batch=int(tags[0]),
-                        rows=n,
-                        dur_ms=round((_time.perf_counter() - t0) * 1e3, 3),
-                    )
-        except BaseException as e:  # a dying feed thread must not hang the
-            with self._exit_lock:    # consumer: deliver the FIRST error,
-                if self._error is None:  # then the sentinel, and re-raise
-                    self._error = e      # at the iterator once all workers
-        finally:                         # exit
-            with self._exit_lock:
-                self._remaining -= 1
-                last = self._remaining == 0
-            if last:
-                self._out.put(None)
+        t0 = time.perf_counter()
+        # host tile assembly (pop+memcpy); a slow producer's waits land
+        # here too — "the host couldn't feed the device" is exactly what
+        # this stage exists to expose
+        with stages.timed("encode"):
+            n, tok, lens, tags = self.batcher.pop_batch(
+                self.batch_size,
+                timeout_ms=self.poll_timeout_ms,
+                min_fill=self.min_fill,
+            )
+        if n == 0:
+            # 0 rows = timeout (retry) or closed-and-drained (done);
+            # close() is one-way so this check is race-free.
+            if self.batcher.closed() and self.batcher.size() == 0:
+                return DONE
+            return RETRY
+        with stages.timed("h2d"):
+            t_dev = self._put_device(tok, tok_spec)
+            l_dev = self._put_device(lens, len_spec)
+        self.timer.add(time.perf_counter() - t0, n)
+        self._m_batches.inc()
+        self._m_docs.inc(n)
+        self._m_fill.set(n / self.batch_size)
+        if n < self.batch_size:
+            self._m_partial.inc()
+        if trace.RECORDER.active:
+            # the ingest end of the span chain: the first tag names the
+            # batch, so a dump ties "what was staging" to the
+            # kernel/resolve spans downstream
+            trace.record(
+                "span",
+                "feed.stage",
+                batch=int(tags[0]),
+                rows=n,
+                dur_ms=round((time.perf_counter() - t0) * 1e3, 3),
+            )
+        return (n, t_dev, l_dev, tags)
 
     def __iter__(self) -> Iterator[tuple[int, object, object, np.ndarray]]:
         while True:
-            item = self._out.get()
-            if item is None:
-                # re-plant the sentinel so termination is idempotent — a
-                # caller that catches the error (or re-iterates an
-                # exhausted feed) must terminate again, not block forever
-                self._out.put(None)
-                if self._error is not None:
+            item = self._out.pop()
+            if item is DONE:
+                # the closed edge makes termination idempotent — a caller
+                # that catches the error (or re-iterates an exhausted
+                # feed) terminates again instead of blocking forever
+                if self._graph.error is not None:
                     raise RuntimeError(
                         "DeviceFeed worker died mid-stream"
-                    ) from self._error
+                    ) from self._graph.error
                 return
             yield item
 
     def join(self, timeout: float | None = 30.0) -> None:
-        """Wait for every worker; ``timeout`` bounds the TOTAL wait."""
-        import time
-
-        deadline = None if timeout is None else time.monotonic() + timeout
-        for t in self._threads:
-            t.join(
-                timeout=None
-                if deadline is None
-                else max(0.0, deadline - time.monotonic())
-            )
+        """Wait for every stage worker; ``timeout`` bounds the TOTAL wait."""
+        self._graph.join(timeout, raise_error=False)
 
 
 def stream_signatures(
@@ -274,7 +271,7 @@ def stream_signatures(
 ) -> Iterator[tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """Stream ``(tags, signatures, band_keys)`` batches for a document feed.
 
-    A producer thread pushes ``docs`` through the native batcher while the
+    A producer stage pushes ``docs`` through the native batcher while the
     main thread runs the device kernels on prefetched tiles — steady-state
     throughput is the device rate, not the Python iteration rate.
 
@@ -305,13 +302,18 @@ def stream_signatures(
     batcher = HostBatcher(block, prefer_native=prefer_native)
     feed = DeviceFeed(batcher, batch_size, workers=feed_workers)
 
-    def produce():
+    # the producer pump is a one-stage graph of its own: feed() runs once
+    # inside the source, the batcher close rides its finally, and a pump
+    # death is visible on producer.error instead of vanishing with a thread
+    def produce_once():
         try:
             batcher.feed(docs)
         finally:
             batcher.close()
+        return DONE
 
-    producer = threading.Thread(target=produce, daemon=True)
+    producer = StageGraph("stream_signatures")
+    producer.stage("produce", source=produce_once)
     producer.start()
 
     import jax.numpy as jnp
@@ -339,11 +341,19 @@ def stream_signatures(
         if pending is not None:
             ptags, pn, psig, pkeys = pending
             yield ptags[:pn], np.asarray(psig)[:pn], np.asarray(pkeys)[:pn]
+        # a dead pump means the stream above was silently TRUNCATED (the
+        # closed batcher ends the feed cleanly) — the consumer must hear
+        # about it, not discover a short corpus downstream
+        producer.join(timeout=30, raise_error=False)
+        if producer.error is not None:
+            raise RuntimeError(
+                "stream_signatures producer died mid-corpus"
+            ) from producer.error
     finally:
         # on any exit — exhaustion, a dead feed worker, or the consumer
         # abandoning the generator — stop the producer promptly: a closed
         # batcher rejects further pushes, so feed() returns instead of
         # buffering the rest of `docs` into an undrained arena
         batcher.close()
-        producer.join(timeout=30)
+        producer.join(timeout=30, raise_error=False)
         feed.join()

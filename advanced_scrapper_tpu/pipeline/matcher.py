@@ -54,6 +54,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass
@@ -501,28 +502,39 @@ class RefineController:
     WIN_MARGIN = 0.95
 
     def __init__(self):
+        # locked: since the streaming path became a stage graph,
+        # next_mode() runs in the screen stage's worker thread while
+        # record() runs in the drain (caller) thread — an epoch reset
+        # must never be observed half-applied
+        self._lock = threading.Lock()
         self._best: dict[bool, float | None] = {False: None, True: None}
         self._chunks = 0
         self._default = False  # verdict carried across epoch resets
 
     def next_mode(self) -> bool:
-        if self._best[False] is None:
-            return False
-        if self._best[True] is None:
-            return True
-        return self.verdict()
+        with self._lock:
+            if self._best[False] is None:
+                return False
+            if self._best[True] is None:
+                return True
+            return self._verdict_locked()
 
     def record(self, mode: bool, seconds_per_row: float) -> None:
-        self._chunks += 1
-        if self._chunks % self.PROBE_EVERY == 0:
-            # epoch boundary: carry the verdict as the default and re-race
-            self._default = self.verdict()
-            self._best = {False: None, True: None}
-        prev = self._best[mode]
-        if prev is None or seconds_per_row < prev:
-            self._best[mode] = seconds_per_row
+        with self._lock:
+            self._chunks += 1
+            if self._chunks % self.PROBE_EVERY == 0:
+                # epoch boundary: carry the verdict as the default, re-race
+                self._default = self._verdict_locked()
+                self._best = {False: None, True: None}
+            prev = self._best[mode]
+            if prev is None or seconds_per_row < prev:
+                self._best[mode] = seconds_per_row
 
     def verdict(self) -> bool:
+        with self._lock:
+            return self._verdict_locked()
+
+    def _verdict_locked(self) -> bool:
         off, on = self._best[False], self._best[True]
         if off is None or on is None:
             return self._default  # mid-race: the last settled verdict
@@ -987,37 +999,64 @@ def run_matcher(
         if controller is not None and nrows:
             controller.record(mode, (screen_s + time.perf_counter() - t0) / nrows)
 
-    try:
-        # bounded two-deep pipeline: chunk i+1's device screen runs while
-        # chunk i's verify slices execute in the pool; appends stay in this
-        # process, in chunk order (single CSV writer by construction)
-        from collections import deque
+    # screen→verify as a stage graph: the single-worker ``screen`` stage
+    # reads a chunk and submits its device screen + pool verify slices;
+    # the capacity-1 ``screened`` edge bounds the window at ≤3 resident
+    # chunks (one draining, one buffered, one the stage just screened
+    # before blocking on put — one more than the old deque's 2, traded
+    # for the screen never idling), and the drain stays in THIS thread so
+    # CSV appends remain single-writer, in chunk order (FIFO edge + one
+    # worker ⇒ order preserved by construction).
+    from advanced_scrapper_tpu.runtime import DONE, StageGraph
 
-        in_flight: deque = deque()
-        for chunk in pd.read_csv(articles_csv, chunksize=cfg.chunk_size):
-            mode = (
-                controller.next_mode()
-                if controller is not None and use_screen
-                else use_refine
-            )
-            t0 = time.perf_counter()
-            collect = match_chunk_async(
-                chunk,
-                index,
-                use_screen=use_screen,
-                use_refine=mode,
-                threshold=cfg.fuzzy_threshold,
-                pool=pool,
-            )
-            in_flight.append(
-                (collect, mode, time.perf_counter() - t0, len(chunk))
-            )
-            # without a pool collect() is lazy serial work — drain at once
-            # so only one chunk's rows stay resident (no overlap to gain)
-            if pool is None or len(in_flight) > 1:
-                drain(in_flight.popleft())
-        while in_flight:
-            drain(in_flight.popleft())
+    chunks = pd.read_csv(articles_csv, chunksize=cfg.chunk_size)
+
+    def read_next():
+        try:
+            return next(chunks)
+        except StopIteration:
+            return DONE
+
+    def screen(chunk):
+        mode = (
+            controller.next_mode()
+            if controller is not None and use_screen
+            else use_refine
+        )
+        t0 = time.perf_counter()
+        collect = match_chunk_async(
+            chunk,
+            index,
+            use_screen=use_screen,
+            use_refine=mode,
+            threshold=cfg.fuzzy_threshold,
+            pool=pool,
+        )
+        return (collect, mode, time.perf_counter() - t0, len(chunk))
+
+    try:
+        if pool is None:
+            # serial mode keeps its deliberate single-chunk residency
+            # bound: collect() is lazy caller-thread work with no overlap
+            # to gain, so screening ahead would only double peak memory
+            while True:
+                chunk = read_next()
+                if chunk is DONE:
+                    break
+                drain(screen(chunk))
+        else:
+            graph = StageGraph("matcher")
+            screened = graph.edge("screened", capacity=1)
+            graph.stage("screen", source=read_next, fn=screen, out_edge=screened)
+            graph.start()
+            try:
+                for item in screened:
+                    drain(item)
+                if graph.error is not None:
+                    raise graph.error  # the original screen-stage exception
+            finally:
+                graph.stop()
+                graph.join(timeout=30, raise_error=False)
     finally:
         if pool is not None:
             pool.shutdown()

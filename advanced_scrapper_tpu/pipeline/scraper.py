@@ -4,9 +4,14 @@ Re-implements the production engine's semantics
 (``constant_rate_scrapper.py:115-493``) with the races designed out
 (SURVEY.md §5.2):
 
-- **admission control at the feeder**, not the workers: one URL enters the
-  queue every ``1/rate`` seconds (ref ``:207-220``);
-- **worker pool** of N fetch threads, each owning its transport (the ref's
+- **admission control at the admit stage**, not the workers: one URL
+  enters the runtime-owned ``urls`` edge every ``1/rate`` seconds (ref
+  ``:207-220``).  The whole fixed mode is a stage graph
+  (``runtime.StageGraph``): admit → urls → fetch×N → results, with the
+  scheduler owning queues, backpressure, shutdown ordering and the
+  crash drain-snapshot;
+- **worker pool** of N fetch-stage workers, each owning its transport via
+  the stage's ``worker_init``/``worker_close`` bracket (the ref's
   per-thread Firefox, ``:136``);
 - **rate-limit circuit breaker**: the extractor's ``rate_limit_reached``
   sentinel or a network fingerprint (``contentEncodingError`` /
@@ -41,6 +46,12 @@ from bs4 import BeautifulSoup
 from advanced_scrapper_tpu.config import ScraperConfig
 from advanced_scrapper_tpu.obs.console import ConsoleMux
 from advanced_scrapper_tpu.obs.stats import StatsTracker
+from advanced_scrapper_tpu.runtime import DONE, StageGraph
+
+# the deadline-based global pause moved to the runtime (every graph can
+# honour it, not just the scraper); re-exported here because this module
+# has always been its import site
+from advanced_scrapper_tpu.runtime.pause import PauseGate as PauseController  # noqa: F401,E501
 from advanced_scrapper_tpu.storage.csvio import AppendCsv, count_rows, scraped_url_set
 
 # canonical home is the extractor boundary (the schema is the plugin
@@ -60,41 +71,6 @@ _RATE_LIMIT_FINGERPRINTS = (
     #                          on the Chrome substrate)
     "ERR_HTTP2_PROTOCOL_ERROR",
 )
-
-
-class PauseController:
-    """Deadline-based global pause (race-free successor of ref :30)."""
-
-    def __init__(self, clock=time.monotonic):
-        self._clock = clock
-        self._lock = threading.Lock()
-        self._until = 0.0
-        self.trips = 0
-
-    def trigger(self, duration: float) -> None:
-        from advanced_scrapper_tpu.obs import telemetry, trace
-
-        with self._lock:
-            self._until = max(self._until, self._clock() + duration)
-            self.trips += 1
-        # a circuit-breaker trip is exactly the rare event the telemetry
-        # plane exists for: always counted, and on the flight recorder so
-        # a crash dump shows whether the fleet died paused
-        telemetry.event_counter(
-            "astpu_rate_limit_trips_total", "rate-limit circuit-breaker trips"
-        ).inc()
-        trace.record("event", "scraper.rate_limit_trip", wait_s=duration)
-
-    def remaining(self) -> float:
-        with self._lock:
-            return max(0.0, self._until - self._clock())
-
-    def wait(self, sleep=time.sleep, tick: float = 1.0, should_stop=lambda: False) -> None:
-        while not should_stop():
-            r = self.remaining()
-            if r <= 0:
-                return
-            sleep(min(tick, r))
 
 
 @dataclass
@@ -192,12 +168,50 @@ class ScraperEngine:
         data["url"] = url
         return ("success", data)
 
+    def _fetch_one(self, transport, url: str) -> list[tuple[str, object]]:
+        """Fetch + classify one url → result events (usually one; a
+        fingerprinted network failure emits its failed row AND the
+        rate-limit signal, like the reference).  Decisions, console lines,
+        stats and circuit-breaker trips are identical for the fixed-mode
+        graph stage and the elastic worker bodies — both call this."""
+        try:
+            html = transport.fetch(url)
+            kind, payload = self._classify(url, html)
+            if kind == "rate_limit":
+                self.console.failure("!!!RATE LIMIT DETECTED!!!")
+                self.pause.trigger(self.cfg.rate_limit_wait)
+                return [("rate_limit", payload)]
+            if kind == "failed":
+                self.console.failure(f"FAIL {url} : {payload['error']}")
+                self.stats.record_fail()
+                return [("failed", payload)]
+            self.console.success(f"SUCCESS: {url}")
+            self.stats.record_success()
+            return [("success", payload)]
+        except Exception as e:
+            msg = str(e)
+            self.console.failure(f"FAIL {url} : {msg}")
+            self.stats.record_fail()
+            out: list[tuple[str, object]] = [("failed", {"url": url, "error": msg})]
+            if any(fp in msg for fp in _RATE_LIMIT_FINGERPRINTS):
+                self.console.failure(
+                    "!!!RATE LIMIT DETECTED (network fingerprint)!!!"
+                )
+                self.pause.trigger(self.cfg.rate_limit_wait)
+                out.append(("rate_limit", None))
+            return out
+
     def _worker(
         self,
-        url_q: queue.Queue,
-        result_q: queue.Queue,
+        url_q,
+        result_q,
         worker_stop: threading.Event | None = None,
     ) -> None:
+        """Elastic-mode worker body (driven by :class:`ElasticWorkerPool`,
+        which owns thread count): the queues are runtime Edges speaking the
+        ``queue.Queue`` surface; fixed mode runs the same fetch logic as a
+        graph stage instead."""
+
         def stopped() -> bool:
             return self._stop.is_set() or (
                 worker_stop is not None and worker_stop.is_set()
@@ -219,50 +233,14 @@ class ScraperEngine:
                 # modes there is no feeder to gate admission, so this is the
                 # only place the pause can take effect
                 self.pause.wait(sleep=self.sleep, should_stop=stopped)
-                try:
-                    html = transport.fetch(url)
-                    kind, payload = self._classify(url, html)
-                    if kind == "rate_limit":
-                        self.console.failure("!!!RATE LIMIT DETECTED!!!")
-                        self.pause.trigger(self.cfg.rate_limit_wait)
-                        result_q.put(("rate_limit", payload))
-                    elif kind == "failed":
-                        self.console.failure(f"FAIL {url} : {payload['error']}")
-                        self.stats.record_fail()
-                        result_q.put(("failed", payload))
-                    else:
-                        self.console.success(f"SUCCESS: {url}")
-                        self.stats.record_success()
-                        result_q.put(("success", payload))
-                except Exception as e:
-                    msg = str(e)
-                    self.console.failure(f"FAIL {url} : {msg}")
-                    self.stats.record_fail()
-                    result_q.put(("failed", {"url": url, "error": msg}))
-                    if any(fp in msg for fp in _RATE_LIMIT_FINGERPRINTS):
-                        self.console.failure(
-                            "!!!RATE LIMIT DETECTED (network fingerprint)!!!"
-                        )
-                        self.pause.trigger(self.cfg.rate_limit_wait)
-                        result_q.put(("rate_limit", None))
-                finally:
-                    url_q.task_done()
+                for item in self._fetch_one(transport, url):
+                    result_q.put(item)
+                url_q.task_done()
         finally:
             try:
                 transport.close()
             except Exception:
                 pass
-
-    # -- feeder ------------------------------------------------------------
-
-    def _feeder(self, urls: Sequence[str], url_q: queue.Queue) -> None:
-        interval = 1.0 / self.cfg.desired_request_rate
-        for url in urls:
-            if self._stop.is_set():
-                return
-            self.pause.wait(sleep=self.sleep, should_stop=self._stop.is_set)
-            url_q.put(url)
-            self.sleep(interval)
 
     # -- stats line --------------------------------------------------------
 
@@ -304,26 +282,65 @@ class ScraperEngine:
         if self._owns_console and not self.console.running:
             self.console.start()
         initial_total = initial_total or len(urls)
-        url_q: queue.Queue = queue.Queue()
-        result_q: queue.Queue = queue.Queue()
+        # ONE scheduler owns both queues: the graph's edges replace the
+        # bespoke queue.Queue pair (elastic modes ride the same edges via
+        # their queue-compat surface; the runtime's depth/stall telemetry
+        # and the crash drain-snapshot cover both modes for free)
+        # no graph-level pause gate on purpose: the fetch fn waits on
+        # self.pause ITSELF so the engine's injectable sleep applies (the
+        # runtime's pausable= path uses real time.sleep)
+        graph = StageGraph("scrape")
+        url_q = graph.edge("urls")       # unbounded: elastic modes pre-fill
+        result_q = graph.edge("results")
 
-        workers: list[threading.Thread] = []
-        feeder = None
         pool = None
         if mode == "fixed":
-            # production design: fixed pool + rate-paced feeder (ref C1)
-            workers = [
-                threading.Thread(
-                    target=self._worker, args=(url_q, result_q), daemon=True
-                )
-                for _ in range(self.cfg.max_threads)
-            ]
-            for w in workers:
-                w.start()
-            feeder = threading.Thread(
-                target=self._feeder, args=(urls, url_q), daemon=True
+            # production design: fixed fetch pool + rate-paced admit stage
+            # (ref C1).  admission control at the admit stage, not the
+            # workers: one URL enters the edge every 1/rate seconds.
+            urls_iter = iter(urls)
+            interval = 1.0 / self.cfg.desired_request_rate
+            first = [True]
+
+            def admit():
+                if self._stop.is_set():
+                    return DONE
+                if first[0]:
+                    first[0] = False
+                else:
+                    self.sleep(interval)
+                self.pause.wait(sleep=self.sleep, should_stop=self._stop.is_set)
+                try:
+                    return next(urls_iter)
+                except StopIteration:
+                    return DONE
+
+            def init_transport():
+                try:
+                    return self.transport_factory()
+                except Exception as e:
+                    self.console.failure(f"Failed to start transport: {e}")
+                    self._stop.set()
+                    raise
+
+            def fetch(url, transport):
+                # honour the circuit breaker at the worker too (the pause
+                # must gate in-queue urls, not just admission), with the
+                # engine's injected sleep so tests stay fast
+                self.pause.wait(sleep=self.sleep, should_stop=self._stop.is_set)
+                return self._fetch_one(transport, url)
+
+            graph.stage("admit", source=admit, out_edge=url_q)
+            graph.stage(
+                "fetch",
+                fn=fetch,
+                in_edge=url_q,
+                out_edge=result_q,
+                workers=self.cfg.max_threads,
+                worker_init=init_transport,
+                worker_close=lambda t: t.close(),
+                fan_out=True,
             )
-            feeder.start()
         else:
             # elastic designs: pre-filled queue, controller-driven pool size
             # (ref experiental/local_dynamic.py / local_pid.py)
@@ -352,6 +369,10 @@ class ScraperEngine:
                 interval=interval,
                 sleep=self.sleep,
             ).start()
+        # started in BOTH modes: the elastic graph has no stages (the
+        # controller owns the workers) but starting it registers the run
+        # — and its edges — with the crash-snapshot plane
+        graph.start()
 
         stats_stop = threading.Event()
         if show_stats:
@@ -371,7 +392,16 @@ class ScraperEngine:
                     try:
                         kind, data = result_q.get(timeout=self.cfg.result_timeout)
                     except queue.Empty:
-                        summary.errors.append("result timeout")
+                        # a failed graph closes the results edge, which
+                        # reads as an IMMEDIATE Empty — report the real
+                        # exception the runtime captured, not a phantom
+                        # timeout nobody can debug
+                        if graph.error is not None:
+                            summary.errors.append(
+                                f"workers died: {graph.error!r}"
+                            )
+                        else:
+                            summary.errors.append("result timeout")
                         break
                     if kind == "success":
                         ok_csv.write_row(data)  # write_row fills missing fields
@@ -405,17 +435,17 @@ class ScraperEngine:
         finally:
             # always tear the fleet down — a CSV write failing with EIO
             # (chaos substrate, disk full) must not strand live worker
-            # threads behind the propagating exception
+            # threads behind the propagating exception.  graph.stop()
+            # closes every edge (waking blocked puts/pops); join bounds
+            # the total wait like the per-thread joins it replaces.
             summary.attempted = summary.succeeded + summary.failed
             summary.rate_limit_trips = self.pause.trips
             self._stop.set()
             stats_stop.set()
-            if feeder is not None:
-                feeder.join(timeout=5)
             if pool is not None:
                 pool.stop()
-            for w in workers:
-                w.join(timeout=5)
+            graph.stop()
+            graph.join(timeout=10, raise_error=False)
             if self._owns_console:
                 self.console.stop()
             self.console.drain()

@@ -1,0 +1,52 @@
+"""Backpressured stage-graph runtime — ONE scheduler owning every queue.
+
+Before this package, the five async layers (feed, dedup, matcher, scraper,
+encode) each hand-rolled their own queues, worker threads, pause logic and
+shutdown ordering — five slightly different answers to the same four
+questions (how do items move, what bounds them, who wakes whom, and in what
+order does it all stop).  The runtime answers them once:
+
+- :class:`Edge` — a named bounded FIFO.  Puts block when full
+  (backpressure), batch pops support the full-tile ``min_fill`` discipline
+  the device feed needs, closes are one-way and wake everybody, and every
+  edge exports depth/stall telemetry for free.
+- :class:`StageGraph` — stages declare inputs/outputs/parallelism; the
+  graph owns the worker threads, the error fan-out (first failure stops
+  the whole graph, no stranded consumers), the pause gate, ordered
+  drain-at-close (an edge auto-closes when its last producer exits), and
+  a whole-graph :meth:`StageGraph.drain_snapshot` that lands in the
+  ``obs/trace`` flight recorder before a chaos death (``fsio._die``).
+- :class:`PauseGate` — the deadline-based global pause (the scraper's
+  rate-limit circuit breaker), now a runtime primitive any stage can honour.
+- :class:`FanoutPool` — a tiny Edge-fed executor for bounded parallel
+  fan-out (the index fleet's per-shard RPCs ride it), so remote hops use
+  the same queue abstraction as local stages.
+
+Layering: the runtime sits above ``obs`` only — it must never import
+``pipeline``/``extractors``/``net``/``index`` (enforced by
+``tools/lint_imports.py``); those layers import *it*.
+"""
+
+from advanced_scrapper_tpu.runtime.graph import (
+    DONE,
+    RETRY,
+    Edge,
+    EdgeClosed,
+    FanoutPool,
+    StageGraph,
+    live_graphs,
+    snapshot_all,
+)
+from advanced_scrapper_tpu.runtime.pause import PauseGate
+
+__all__ = [
+    "DONE",
+    "RETRY",
+    "Edge",
+    "EdgeClosed",
+    "FanoutPool",
+    "PauseGate",
+    "StageGraph",
+    "live_graphs",
+    "snapshot_all",
+]

@@ -1,0 +1,757 @@
+"""Stage-graph scheduler: edges, stages, and the graph that runs them.
+
+Design notes (the four questions every async layer used to answer its own
+way, answered once here):
+
+**How do items move.**  A :class:`Edge` is a bounded FIFO owned by the
+graph.  Producers ``put`` (blocking while full — backpressure is the
+default, not an option), consumers ``pop`` one item or ``pop_batch`` with
+the full-tile ``min_fill`` discipline (wait for k items unless a timeout,
+a close, or a producer's rejected push says "dispatch what you have" —
+the ``pipeline/feed.py`` staging rules, generalised).  Edges also speak
+the ``queue.Queue`` surface (``get``/``put``/``task_done``/``qsize``) so
+pre-runtime worker bodies (the elastic scraper pool) ride them unchanged.
+
+**What bounds them.**  Capacity, declared per edge.  ``min_fill`` is
+clamped to capacity so a consumer can never wait for more items than the
+edge may hold (the feed deadlock rule).
+
+**Who wakes whom.**  Closes are one-way and wake every waiter.  An edge
+auto-closes when its LAST producer stage exits, so drains propagate in
+topological order with no bespoke sentinel protocols.  A producer whose
+timed put is rejected wakes ``min_fill`` waiters (partial tiles beat
+starvation under backpressure).  A failing worker fails the whole graph:
+every edge closes, every blocked peer wakes, and :meth:`StageGraph.join`
+re-raises the first error — no stranded consumers, no half-alive fleets.
+
+**What the crash sees.**  Every live graph registers with the
+``obs/trace`` flight recorder: on a chaos fault (``fsio._die``) or crash
+dump, :func:`snapshot_all` records each graph's per-stage in-flight items
+and per-edge depths BEFORE the process dies, so the sweep harness can
+assert on what the scheduler held at the kill point.
+
+Telemetry (no-op handles when disabled): per-edge depth callback gauges,
+items-in/out counters and put/get stall-seconds counters; per-stage item
+throughput counters and busy-seconds counters — the whole graph is
+observable without any stage writing a metric itself.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+import weakref
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, Iterator
+
+__all__ = [
+    "DONE",
+    "RETRY",
+    "Edge",
+    "EdgeClosed",
+    "FanoutPool",
+    "Stage",
+    "StageGraph",
+    "live_graphs",
+    "snapshot_all",
+]
+
+
+class _Sentinel:
+    __slots__ = ("_name",)
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<runtime.{self._name}>"
+
+
+#: returned by :meth:`Edge.pop` (and accepted from stage sources) when the
+#: stream is exhausted: closed and drained, no more items will ever come.
+DONE = _Sentinel("DONE")
+
+#: returned by a stage source (or :meth:`Edge.pop` on timeout) meaning
+#: "nothing yet — poll again".
+RETRY = _Sentinel("RETRY")
+
+
+class EdgeClosed(RuntimeError):
+    """Raised by :meth:`Edge.put_nowait` on a closed edge (the blocking
+    :meth:`Edge.put` returns False instead — stage loops branch, callers
+    on the queue-compat surface get the loud version)."""
+
+
+class Edge:
+    """Named bounded FIFO between stages; the runtime owns the locking,
+    backpressure, close propagation and telemetry.
+
+    Thread-safe.  ``capacity=None`` means unbounded (for pre-filled work
+    lists); bounded edges block producers when full.
+    """
+
+    _seq_lock = threading.Lock()
+    _seq = 0
+
+    def __init__(
+        self,
+        name: str,
+        capacity: int | None = None,
+        *,
+        graph: str = "-",
+        instance: str | None = None,
+    ):
+        self.name = name
+        self.graph = graph
+        # graph-owned edges inherit the graph's instance label; bare edges
+        # (lease queues, FanoutPool task queues) draw their own — two live
+        # LeaseClients must never replace each other's gauge series or
+        # co-mingle counters (the PR-3 per-instance-series invariant)
+        self._graph_owned = instance is not None
+        if instance is None:
+            with Edge._seq_lock:
+                instance = f"e{Edge._seq}"
+                Edge._seq += 1
+        self.capacity = capacity if capacity and capacity > 0 else None
+        self._items: deque = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._closed = False
+        self._rejects = 0  # timed-out puts; wakes min_fill waiters
+        self._producers = 0
+        self._in = 0
+        self._out = 0
+        self._instrument(graph, instance)
+        if not self._graph_owned:
+            # bare edges join the crash-snapshot registry themselves —
+            # the lease plane's backlog must show up in a fault dump
+            # exactly like a graph-owned edge's
+            with _live_lock:
+                _BARE_EDGES.add(self)
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _instrument(self, graph: str, instance: str) -> None:
+        """Counters are keyed by (graph, edge) WITHOUT the instance label
+        — graphs are built per call (a 'dedup.h2d' per dedup_reps, a
+        'scrape' per run), and per-instance counter series would leak in
+        the registry forever; cumulative-across-instances is the PR-3
+        feed-counter pattern.  Gauges DO carry the instance label (two
+        live lease clients must not replace each other's depth series)
+        and are weakref-swept with the edge, so they never accumulate."""
+        from advanced_scrapper_tpu.obs import telemetry
+
+        labels = {"graph": graph, "edge": self.name}
+        self._m_in = telemetry.counter(
+            "astpu_edge_items_total", "items accepted by the edge",
+            dir="in", **labels,
+        )
+        self._m_out = telemetry.counter(
+            "astpu_edge_items_total", "items handed to consumers",
+            dir="out", **labels,
+        )
+        self._m_stall_put = telemetry.counter(
+            "astpu_edge_stall_seconds_total",
+            "seconds producers spent blocked on a full edge",
+            side="put", **labels,
+        )
+        self._m_stall_get = telemetry.counter(
+            "astpu_edge_stall_seconds_total",
+            "seconds consumers spent waiting on an empty edge",
+            side="get", **labels,
+        )
+        telemetry.gauge_fn(
+            "astpu_edge_depth",
+            lambda e: len(e._items),
+            owner=self,
+            help="items buffered on the edge",
+            g=instance,
+            **labels,
+        )
+        telemetry.gauge_fn(
+            "astpu_edge_capacity",
+            lambda e: e.capacity or 0,
+            owner=self,
+            help="edge capacity (0 = unbounded)",
+            g=instance,
+            **labels,
+        )
+
+    # -- producer side -----------------------------------------------------
+
+    def register_producer(self) -> "Edge":
+        """Count an (external or stage) producer; the edge closes when the
+        count, once positive, returns to zero."""
+        with self._lock:
+            self._producers += 1
+        return self
+
+    def producer_done(self) -> None:
+        with self._lock:
+            self._producers -= 1
+            if self._producers <= 0 and not self._closed:
+                self._close_locked()
+
+    def put(self, item, timeout: float | None = None) -> bool:
+        """Append ``item``; blocks while full.  Returns False (and wakes
+        ``min_fill`` waiters — the rejection-wakeup rule) when the edge is
+        closed or the timeout expires without space."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if self._closed:
+                    self._rejects += 1
+                    self._not_empty.notify_all()
+                    return False
+                if self.capacity is None or len(self._items) < self.capacity:
+                    self._items.append(item)
+                    self._in += 1
+                    self._m_in.inc()
+                    self._not_empty.notify()
+                    return True
+                t0 = time.perf_counter()
+                if deadline is None:
+                    self._not_full.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._not_full.wait(remaining):
+                        self._m_stall_put.inc(time.perf_counter() - t0)
+                        self._rejects += 1
+                        self._not_empty.notify_all()
+                        return False
+                self._m_stall_put.inc(time.perf_counter() - t0)
+
+    # -- consumer side -----------------------------------------------------
+
+    def pop(self, timeout: float | None = None):
+        """One item, else :data:`DONE` (closed and drained) or
+        :data:`RETRY` (timeout)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if self._items:
+                    return self._pop_locked()
+                if self._closed:
+                    return DONE
+                t0 = time.perf_counter()
+                if deadline is None:
+                    self._not_empty.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._not_empty.wait(remaining):
+                        self._m_stall_get.inc(time.perf_counter() - t0)
+                        return RETRY
+                self._m_stall_get.inc(time.perf_counter() - t0)
+
+    def pop_batch(
+        self,
+        max_n: int,
+        *,
+        min_fill: int = 1,
+        timeout: float | None = None,
+    ) -> list:
+        """Up to ``max_n`` items, waiting for at least ``min_fill`` of them
+        (clamped to capacity — the feed's no-deadlock rule) unless a close,
+        a timeout, or a producer's rejected push ends the wait first.
+        Returns a possibly-empty list; emptiness + :meth:`closed` + empty
+        depth together mean exhausted."""
+        if self.capacity is not None:
+            min_fill = min(min_fill, self.capacity)
+        min_fill = max(1, min(min_fill, max_n))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            rejects_seen = self._rejects
+            while (
+                len(self._items) < min_fill
+                and not self._closed
+                and self._rejects == rejects_seen
+            ):
+                t0 = time.perf_counter()
+                if deadline is None:
+                    self._not_empty.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._not_empty.wait(remaining):
+                        self._m_stall_get.inc(time.perf_counter() - t0)
+                        break
+                self._m_stall_get.inc(time.perf_counter() - t0)
+            out = []
+            while self._items and len(out) < max_n:
+                out.append(self._pop_locked())
+            return out
+
+    def _pop_locked(self):
+        item = self._items.popleft()
+        self._out += 1
+        self._m_out.inc()
+        self._not_full.notify()
+        return item
+
+    def __iter__(self) -> Iterator:
+        while True:
+            item = self.pop()
+            if item is DONE:
+                return
+            yield item
+
+    # -- queue.Queue compatibility (elastic worker bodies) -----------------
+
+    def get(self, block: bool = True, timeout: float | None = None):
+        """``queue.Queue.get``: raises ``queue.Empty`` on timeout AND on a
+        closed-and-drained edge (callers on this surface carry their own
+        stop conditions)."""
+        item = self.pop(timeout=timeout if block else 0.0)
+        if item is DONE or item is RETRY:
+            raise _queue.Empty
+        return item
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def put_nowait(self, item) -> None:
+        if not self.put(item, timeout=0.0):
+            raise _queue.Full if self._closed is False else EdgeClosed(
+                f"edge '{self.name}' is closed"
+            )
+
+    def task_done(self) -> None:  # the runtime tracks drain via close/DONE
+        pass
+
+    def qsize(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def __len__(self) -> int:
+        return self.qsize()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        """One-way: no further puts accepted; pops drain the remainder then
+        report :data:`DONE`.  Wakes every waiter."""
+        with self._lock:
+            self._close_locked()
+
+    def _close_locked(self) -> None:
+        self._closed = True
+        self._not_empty.notify_all()
+        self._not_full.notify_all()
+
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            snap = {
+                "edge": self.name,
+                "depth": len(self._items),
+                "capacity": self.capacity or 0,
+                "closed": self._closed,
+                "in": self._in,
+                "out": self._out,
+            }
+            if not self._graph_owned:
+                snap["graph"] = self.graph
+            return snap
+
+
+@dataclass
+class Stage:
+    """Declarative stage spec; the graph owns its threads and queues.
+
+    Exactly one of ``source`` (a zero-arg puller returning an item,
+    :data:`RETRY`, or :data:`DONE` — shared by all workers, so it must be
+    thread-safe) or ``in_edge`` feeds the stage.  ``fn`` transforms one
+    item; ``None`` results are filtered, and with ``fan_out=True`` an
+    iterable result emits item-by-item.  ``worker_init``/``worker_close``
+    bracket per-worker context (a transport, a device handle); when
+    ``worker_init`` is set, ``fn`` is called as ``fn(item, ctx)``.
+    ``pausable`` stages honour the graph's :class:`~.pause.PauseGate`
+    between pop and work.  ``tag(item)`` (optional) names the trace-span
+    fields so corpus trace ids propagate across edges for free.
+    """
+
+    name: str
+    fn: Callable | None = None
+    in_edge: Edge | None = None
+    out_edge: Edge | None = None
+    source: Callable | None = None
+    workers: int = 1
+    worker_init: Callable | None = None
+    worker_close: Callable | None = None
+    pausable: bool = False
+    fan_out: bool = False
+    tag: Callable | None = None
+    # -- runtime state (owned by the graph) --
+    live: int = field(default=0, repr=False)
+    threads: list = field(default_factory=list, repr=False)
+
+
+class StageGraph:
+    """A set of stages wired by edges, run by one scheduler.
+
+    Lifecycle: declare edges (:meth:`edge`) and stages (:meth:`stage`),
+    :meth:`start`, optionally push into externally-produced edges (close
+    them when done), consume a terminal edge (iterate it), then
+    :meth:`join` — which re-raises the first worker error.  :meth:`stop`
+    aborts: closes every edge and joins without draining.
+    """
+
+    _seq_lock = threading.Lock()
+    _seq = 0
+
+    def __init__(self, name: str, *, pause=None):
+        self.name = name
+        self.pause = pause  # a PauseGate (or None)
+        with StageGraph._seq_lock:
+            self._instance = str(StageGraph._seq)
+            StageGraph._seq += 1
+        self._edges: dict[str, Edge] = {}
+        self._stages: dict[str, Stage] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._error: BaseException | None = None
+        self._started = False
+        self._in_flight: dict[tuple[str, int], str] = {}
+        self._instrument()
+
+    def _instrument(self) -> None:
+        from advanced_scrapper_tpu.obs import telemetry
+
+        self._m_items: dict[str, object] = {}
+        self._m_busy: dict[str, object] = {}
+        self._telemetry = telemetry
+
+    def _stage_metrics(self, name: str):
+        m = self._m_items.get(name)
+        if m is None:
+            # (graph, stage)-keyed, no instance label: same no-leak rule
+            # as the edge counters (graphs are created per call)
+            labels = {"graph": self.name, "stage": name}
+            m = self._telemetry.counter(
+                "astpu_stage_items_total", "items processed by the stage",
+                **labels,
+            )
+            self._m_items[name] = m
+            self._m_busy[name] = self._telemetry.counter(
+                "astpu_stage_busy_seconds_total",
+                "seconds the stage spent inside its fn",
+                **labels,
+            )
+        return m, self._m_busy[name]
+
+    # -- construction ------------------------------------------------------
+
+    def edge(self, name: str, capacity: int | None = None) -> Edge:
+        """Declare (or fetch) the named edge."""
+        e = self._edges.get(name)
+        if e is None:
+            e = Edge(
+                name, capacity, graph=self.name, instance=self._instance
+            )
+            self._edges[name] = e
+        return e
+
+    def stage(self, name: str, **kw) -> Stage:
+        """Declare a stage (see :class:`Stage` for the spec fields)."""
+        if self._started:
+            raise RuntimeError("cannot add stages to a started graph")
+        st = Stage(name=name, **kw)
+        if st.source is None and st.in_edge is None:
+            raise ValueError(f"stage '{name}' needs a source or an in_edge")
+        if st.source is not None and st.in_edge is not None:
+            raise ValueError(f"stage '{name}' cannot have both source and in_edge")
+        self._stages[name] = st
+        if st.out_edge is not None:
+            st.out_edge.register_producer()
+        return st
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "StageGraph":
+        if self._started:
+            return self
+        self._started = True
+        _register_graph(self)
+        for st in self._stages.values():
+            st.live = st.workers
+            for w in range(st.workers):
+                t = threading.Thread(
+                    target=self._run_worker,
+                    args=(st, w),
+                    name=f"astpu-{self.name}-{st.name}-{w}",
+                    daemon=True,
+                )
+                st.threads.append(t)
+                t.start()
+        return self
+
+    @property
+    def error(self) -> BaseException | None:
+        return self._error
+
+    def fail(self, exc: BaseException) -> None:
+        """First error wins; every edge closes so no peer stays blocked."""
+        with self._lock:
+            if self._error is None:
+                self._error = exc
+        self._stop.set()
+        for e in self._edges.values():
+            e.close()
+
+    def stop(self) -> None:
+        """Abort: wake and stop every worker without draining."""
+        self._stop.set()
+        for e in self._edges.values():
+            e.close()
+
+    def join(self, timeout: float | None = None, *, raise_error: bool = True):
+        """Wait for every worker (``timeout`` bounds the TOTAL wait), then
+        re-raise the first worker error (unless ``raise_error=False``)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for st in self._stages.values():
+            for t in st.threads:
+                t.join(
+                    timeout=None
+                    if deadline is None
+                    else max(0.0, deadline - time.monotonic())
+                )
+        # the graph stays in the (weak) crash-snapshot set until collected:
+        # a fault just past join still shows the drained graph, which is
+        # exactly what the flight recorder should say happened
+        if raise_error and self._error is not None:
+            raise RuntimeError(
+                f"stage-graph '{self.name}' worker died"
+            ) from self._error
+        return self
+
+    def running(self) -> bool:
+        return any(t.is_alive() for st in self._stages.values() for t in st.threads)
+
+    # -- the worker loop ---------------------------------------------------
+
+    def _stopped(self) -> bool:
+        return self._stop.is_set()
+
+    def _run_worker(self, st: Stage, widx: int) -> None:
+        from advanced_scrapper_tpu.obs import trace
+
+        m_items, m_busy = self._stage_metrics(st.name)
+        ctx = None
+        slot = (st.name, widx)
+        try:
+            if st.worker_init is not None:
+                ctx = st.worker_init()
+            while not self._stop.is_set():
+                if st.source is not None:
+                    item = st.source()
+                else:
+                    item = st.in_edge.pop(timeout=0.5)
+                if item is RETRY:
+                    continue
+                if item is DONE:
+                    break
+                if st.pausable and self.pause is not None:
+                    self.pause.wait(should_stop=self._stopped)
+                    if self._stop.is_set():
+                        break
+                self._in_flight[slot] = _describe(item)
+                t0 = time.perf_counter()
+                try:
+                    if st.fn is None:
+                        out = item
+                    elif st.worker_init is not None:
+                        out = st.fn(item, ctx)
+                    else:
+                        if trace.RECORDER.active and st.tag is not None:
+                            with trace.span(
+                                f"{self.name}.{st.name}", **(st.tag(item) or {})
+                            ):
+                                out = st.fn(item)
+                        else:
+                            out = st.fn(item)
+                finally:
+                    m_busy.inc(time.perf_counter() - t0)
+                    self._in_flight.pop(slot, None)
+                m_items.inc()
+                if out is None or st.out_edge is None:
+                    continue
+                if st.fan_out:
+                    for o in out:
+                        if not st.out_edge.put(o):
+                            break
+                elif not st.out_edge.put(out):
+                    # closed under us: the graph is stopping/failing
+                    break
+        except BaseException as e:
+            self.fail(e)
+        finally:
+            if st.worker_close is not None and ctx is not None:
+                try:
+                    st.worker_close(ctx)
+                except Exception:
+                    pass
+            last = False
+            with self._lock:
+                st.live -= 1
+                last = st.live == 0
+            if last and st.out_edge is not None:
+                st.out_edge.producer_done()
+
+    # -- observability -----------------------------------------------------
+
+    def drain_snapshot(self) -> dict:
+        """Whole-graph state for the flight recorder: per-stage live worker
+        counts and in-flight item descriptions, per-edge depths."""
+        stages = {}
+        in_flight = dict(self._in_flight)
+        for name, st in self._stages.items():
+            stages[name] = {
+                "workers": st.live,
+                "in_flight": [
+                    v for (s, _w), v in in_flight.items() if s == name
+                ],
+            }
+        return {
+            "graph": self.name,
+            "instance": self._instance,
+            "error": None if self._error is None else repr(self._error),
+            "stages": stages,
+            "edges": [e.snapshot() for e in self._edges.values()],
+        }
+
+
+def _describe(item) -> str:
+    """A short, allocation-light description of an in-flight item for the
+    crash snapshot (never the payload — a 100 kB article must not ride the
+    ring buffer)."""
+    try:
+        if isinstance(item, (str, bytes)):
+            return f"{type(item).__name__}[{len(item)}]"
+        if isinstance(item, tuple):
+            return f"tuple[{len(item)}]"
+        return type(item).__name__
+    except Exception:  # pragma: no cover - defensive
+        return "?"
+
+
+# -- crash-snapshot registry --------------------------------------------------
+
+_live_lock = threading.Lock()
+_LIVE: "weakref.WeakSet[StageGraph]" = weakref.WeakSet()
+#: edges built OUTSIDE a StageGraph (lease queues, FanoutPool tasks) —
+#: they have no graph to snapshot them, so the fault hook covers them
+#: directly (touched ones only, capped, so the ring is never flooded)
+_BARE_EDGES: "weakref.WeakSet[Edge]" = weakref.WeakSet()
+
+
+def _register_graph(g: StageGraph) -> None:
+    with _live_lock:
+        _LIVE.add(g)
+
+
+def live_graphs() -> list[StageGraph]:
+    with _live_lock:
+        return list(_LIVE)
+
+
+def snapshot_all() -> list[dict]:
+    """Drain snapshots of every live graph (newest-started last)."""
+    return [g.drain_snapshot() for g in live_graphs()]
+
+
+def _record_snapshots(recorder) -> None:
+    """Fault hook: land every live graph's snapshot in the flight-recorder
+    ring BEFORE the dump is written (so ``fsio._die`` deaths carry the
+    whole-graph state).  Always records a ``graphs`` summary first — a
+    fault that lands before any graph starts still proves the hook ran
+    (``live=0``) — then one ``graph`` record per snapshot.  Must never
+    raise — the crash owns control flow."""
+    snaps = snapshot_all()
+    recorder.record("snapshot", "graphs", live=len(snaps))
+    for snap in snaps:
+        recorder.record("snapshot", "graph", **snap)
+    with _live_lock:
+        bare = list(_BARE_EDGES)
+    # only touched edges (something ever flowed or is buffered), capped:
+    # a fault dump should show the lease backlog, not a wall of idle edges
+    touched = [e.snapshot() for e in bare]
+    touched = [s for s in touched if s["in"] or s["depth"]][:64]
+    if touched:
+        recorder.record("snapshot", "edges", edges=touched)
+
+
+# registered at import time, not first-graph-start: a fault that lands
+# before any graph exists still writes a (live=0) summary, so the sweep
+# can tell "hook never ran" apart from "nothing was running"
+def _install_fault_hook() -> None:
+    from advanced_scrapper_tpu.obs import trace
+
+    trace.add_fault_hook(_record_snapshots)
+
+
+_install_fault_hook()
+
+
+# -- bounded fan-out pool -----------------------------------------------------
+
+
+class FanoutPool:
+    """A tiny Edge-fed executor for bounded parallel fan-out.
+
+    The index fleet's per-shard RPC fan-out (and any other remote hop)
+    rides this instead of a bespoke ``ThreadPoolExecutor``: the task queue
+    is a runtime :class:`Edge`, so depth/stall telemetry and the crash
+    snapshot see remote work exactly like local stage work.
+    """
+
+    def __init__(self, workers: int, *, name: str = "fanout"):
+        from concurrent.futures import Future
+
+        self._Future = Future
+        self.name = name
+        self._tasks = Edge(f"{name}.tasks", None, graph=name)
+        self._tasks.register_producer()
+        self._threads = [
+            threading.Thread(
+                target=self._run, name=f"astpu-{name}-{i}", daemon=True
+            )
+            for i in range(max(1, workers))
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _run(self) -> None:
+        while True:
+            item = self._tasks.pop()
+            if item is DONE:
+                return
+            fut, fn, args, kw = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args, **kw))
+            except BaseException as e:
+                fut.set_exception(e)
+
+    def submit(self, fn, *args, **kw):
+        fut = self._Future()
+        if not self._tasks.put((fut, fn, args, kw)):
+            raise RuntimeError(f"FanoutPool '{self.name}' is shut down")
+        return fut
+
+    def map(self, fn, items: Iterable):
+        return [self.submit(fn, it) for it in items]
+
+    def shutdown(self, wait: bool = True) -> None:
+        self._tasks.producer_done()
+        if wait:
+            for t in self._threads:
+                t.join(timeout=30)

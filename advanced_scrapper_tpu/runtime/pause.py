@@ -1,0 +1,63 @@
+"""Deadline-based global pause — the runtime's pause/resume primitive.
+
+Absorbed from ``pipeline/scraper.py`` (its rate-limit circuit breaker,
+itself the race-free successor of the reference's unlocked global
+``pause`` flag read by three threads): a :class:`PauseGate` owns a
+monotonic deadline behind a lock; any trigger extends it, never shortens
+it, and every stage that declared itself ``pausable`` honours it between
+popping an item and working on it.  The scraper keeps its historical
+telemetry names by default; other graphs can rename the event/counter at
+construction.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["PauseGate"]
+
+
+class PauseGate:
+    """Deadline-based global pause (race-free successor of ref :30)."""
+
+    def __init__(
+        self,
+        clock=time.monotonic,
+        *,
+        counter: str = "astpu_rate_limit_trips_total",
+        counter_help: str = "rate-limit circuit-breaker trips",
+        event: str = "scraper.rate_limit_trip",
+    ):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._until = 0.0
+        self.trips = 0
+        self._counter_name = counter
+        self._counter_help = counter_help
+        self._event_name = event
+
+    def trigger(self, duration: float) -> None:
+        from advanced_scrapper_tpu.obs import telemetry, trace
+
+        with self._lock:
+            self._until = max(self._until, self._clock() + duration)
+            self.trips += 1
+        # a circuit-breaker trip is exactly the rare event the telemetry
+        # plane exists for: always counted, and on the flight recorder so
+        # a crash dump shows whether the fleet died paused
+        telemetry.event_counter(self._counter_name, self._counter_help).inc()
+        trace.record("event", self._event_name, wait_s=duration)
+
+    def remaining(self) -> float:
+        with self._lock:
+            return max(0.0, self._until - self._clock())
+
+    def wait(
+        self, sleep=time.sleep, tick: float = 1.0, should_stop=lambda: False
+    ) -> None:
+        while not should_stop():
+            r = self.remaining()
+            if r <= 0:
+                return
+            sleep(min(tick, r))

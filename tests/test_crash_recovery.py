@@ -117,6 +117,21 @@ def test_crashsweep_stream_dedup_converges(tmp_path):
     _assert_sweep(report, min_kills=5)
 
 
+def test_crashsweep_graph_converges(tmp_path):
+    """Kill instants over the stage-graph runtime pipeline (ingest →
+    transform → persist, every queue scheduler-owned): seeded SIGKILLs
+    land mid-stage and mid-drain (the paced source exhausts well before
+    the pipeline drains), chaos-exits land inside persist writes — every
+    record must end annotated exactly once after the clean resume, and a
+    chaos fault's flight-recorder dump must carry the whole-graph drain
+    snapshot (per-stage in-flight items + per-edge depths) the runtime
+    registers with ``obs.trace``."""
+    report = crashsweep.sweep_workload(
+        "graph", str(tmp_path), sigkills=3, chaos_kills=2, seed=505
+    )
+    _assert_sweep(report, min_kills=4)
+
+
 def test_crashsweep_pindex_converges(tmp_path):
     """Kill instants over the persistent corpus index — two wall-clock
     SIGKILLs plus one seeded in-write ``os._exit`` INSIDE each durability

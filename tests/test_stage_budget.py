@@ -1,0 +1,66 @@
+"""Per-stage ``stage_ms`` regression gate on cpu-fallback (ROADMAP item 3
+interim ask): run the quick ragged bench regime and fail when any stage
+exceeds its checked-in budget (``tests/stage_budgets.json``) by more than
+2× — the on-chip 50k/s reclamation work needs the HOST path pinned while
+the device tunnel is dead, and a silent 5× encode regression would
+otherwise ride along unmeasured until the next on-chip round.
+
+The bench runs as a real subprocess (the exact CLI the driver runs), so
+the gate covers argv plumbing, the cpu-fallback path and the stage
+attribution — not just the library functions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUDGET_FILE = os.path.join(os.path.dirname(__file__), "stage_budgets.json")
+
+
+def _run_bench_regime(regime: str) -> dict:
+    env = dict(os.environ, ASTPU_BENCH_QUICK="1", JAX_PLATFORMS="cpu")
+    env.pop("ASTPU_TELEMETRY", None)  # measure the production-default cost
+    env.pop("ASTPU_CHAOS_FS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--regime", regime],
+        env=env,
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=420,
+    )
+    assert proc.returncode == 0, (
+        f"bench --regime {regime} failed:\n{proc.stderr[-3000:]}"
+    )
+    # the JSON line is the last stdout line (stderr carries breadcrumbs)
+    line = [ln for ln in proc.stdout.strip().splitlines() if ln.startswith("{")][-1]
+    return json.loads(line)
+
+
+def test_ragged_stage_ms_within_budget():
+    with open(BUDGET_FILE) as fh:
+        spec = json.load(fh)
+    budgets = spec["budgets_ms"]
+    out = _run_bench_regime(spec["regime"])
+    stage_ms = out["stage_ms"]
+    over = {
+        stage: (stage_ms.get(stage, 0.0), limit)
+        for stage, limit in budgets.items()
+        if stage_ms.get(stage, 0.0) > 2.0 * limit
+    }
+    assert not over, (
+        "stage budget regression (>2x the checked-in budget): "
+        + ", ".join(
+            f"{s}={ms:.1f}ms (budget {lim}ms, gate {2 * lim}ms)"
+            for s, (ms, lim) in over.items()
+        )
+        + f"; full stage_ms={stage_ms} — if this is an intentional "
+        "trade, re-baseline tests/stage_budgets.json (see its _comment)"
+    )
+    # the gate only makes sense if the regime actually exercised the path
+    assert stage_ms.get("kernel", 0.0) > 0.0, stage_ms
+    assert out.get("ragged_articles_per_sec", 0) > 0

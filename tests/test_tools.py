@@ -217,6 +217,59 @@ def test_obs_top_once_smoke(capsys):
         telemetry.set_enabled(None)
 
 
+def test_obs_top_graph_once_smoke(capsys):
+    """obs_top --graph --once against a live StatusServer while a runtime
+    stage graph holds items: the frame must show each edge's depth/
+    capacity, items in/out and put/get stall times, and each stage's
+    throughput — the whole-graph view of the scheduler's own gauges."""
+    import threading
+    import time
+
+    import obs_top
+
+    from advanced_scrapper_tpu.obs import telemetry
+    from advanced_scrapper_tpu.runtime import DONE, StageGraph
+
+    telemetry.REGISTRY.reset()
+    telemetry.set_enabled(True)
+    srv = None
+    g = None
+    gate = threading.Event()
+    try:
+        g = StageGraph("obstop")
+        mid = g.edge("mid", capacity=4)
+        it = iter(range(8))
+        lock = threading.Lock()
+
+        def src():
+            with lock:
+                return next(it, DONE)
+
+        g.stage("gen", source=src, out_edge=mid)
+        g.stage("hold", fn=lambda x: (gate.wait(10), x)[1], in_edge=mid)
+        g.start()
+        time.sleep(0.3)  # let the edge fill behind the held stage
+        srv = telemetry.StatusServer(port=0).start()
+        rc = obs_top.main(
+            ["--url", f"http://127.0.0.1:{srv.port}", "--once", "--graph"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "obs_top --graph @" in out
+        assert "graph obstop" in out
+        assert "edge mid" in out and "depth" in out and "stall put" in out
+        assert "stage gen" in out and "stage hold" in out and "busy" in out
+    finally:
+        gate.set()
+        if g is not None:
+            g.stop()
+            g.join(timeout=10, raise_error=False)
+        if srv is not None:
+            srv.stop()
+        telemetry.REGISTRY.reset()
+        telemetry.set_enabled(None)
+
+
 def test_obs_top_once_unreachable_exits_nonzero(capsys):
     import obs_top
 
@@ -310,11 +363,32 @@ def test_lint_imports_catches_violations(tmp_path):
         "def h():\n"
         "    from advanced_scrapper_tpu.pipeline.scraper import SUCCESS_FIELDS\n"
     )
+    # the runtime is workload-blind: no pipeline/extractors/net/index —
+    # but obs (telemetry taps, the flight recorder) is its one dependency
+    (pkg / "runtime").mkdir()
+    (pkg / "runtime" / "bad.py").write_text(
+        "from advanced_scrapper_tpu.pipeline.feed import DeviceFeed\n"
+        "def f():\n"
+        "    from advanced_scrapper_tpu.extractors.tpu_batch import (\n"
+        "        TpuBatchBackend,\n"
+        "    )\n"
+        "    import advanced_scrapper_tpu.net.rpc\n"
+        "    import advanced_scrapper_tpu.index.store\n"
+    )
+    (pkg / "runtime" / "ok.py").write_text(
+        "from advanced_scrapper_tpu.obs import telemetry, trace\n"
+    )
     problems = lint_imports.lint(str(tmp_path))
-    assert len(problems) == 5, problems
+    assert len(problems) == 9, problems
     assert any("core/ must not import obs/" in p for p in problems)
     assert any("core/ must not import pipeline/" in p for p in problems)
     assert any("index/ must not import pipeline/" in p for p in problems)
     assert any("index/ must not import net/" in p for p in problems)
     assert any("net/ must not import pipeline/" in p for p in problems)
-    assert not any("ok.py" in p for p in problems), "net.rpc is exempt"
+    assert any("runtime/ must not import pipeline/" in p for p in problems)
+    assert any("runtime/ must not import extractors/" in p for p in problems)
+    assert any("runtime/ must not import net/" in p for p in problems)
+    assert any("runtime/ must not import index/" in p for p in problems)
+    assert not any("ok.py" in p for p in problems), (
+        "net.rpc is exempt for index/, and runtime/ may use obs/"
+    )

@@ -50,6 +50,7 @@ SCRAPE_URLS = 80
 STREAM_DOCS = 40
 PINDEX_DOCS = 64
 PINDEX_BANDS = 8
+GRAPH_DOCS = 48
 
 FLEET_DOCS = 64
 FLEET_BATCH = 8
@@ -211,6 +212,80 @@ def child_stream(case_dir: str, seed: int) -> int:
                 backend.save_index(ckpt)
         backend.flush()
         backend.save_index(ckpt)
+    finally:
+        ann.close()
+    return 0
+
+
+def _graph_digest(doc: str) -> str:
+    import hashlib
+
+    return hashlib.sha1(doc.encode()).hexdigest()[:16]
+
+
+def child_graph(case_dir: str, seed: int) -> int:
+    """Stage-graph runtime ingest: ingest → transform (2 workers) →
+    persist, all queues owned by the scheduler, with the annotations CSV
+    (through the fsio seam) as the exactly-once resume artifact.
+
+    Paced stages keep items queued AND in flight for most of the run, and
+    the source exhausts well before the pipeline drains — so seeded kill
+    instants land both mid-stage and mid-drain.  The flight recorder is
+    armed at a case-local sidecar: any chaos-fs fault (``fsio._die``)
+    must dump a whole-graph drain snapshot (stage in-flight items, edge
+    depths) before the process dies — the verifier asserts the sidecar
+    holds one.
+    """
+    # force-set (never setdefault): the verifier reads THIS path, and an
+    # operator-exported ASTPU_FLIGHT_RECORDER would otherwise redirect the
+    # dump and silently skip the snapshot assertions
+    os.environ["ASTPU_FLIGHT_RECORDER"] = os.path.join(case_dir, "flight.jsonl")
+    from advanced_scrapper_tpu.runtime import DONE, StageGraph
+    from advanced_scrapper_tpu.storage.csvio import AppendCsv, read_url_column
+
+    ann_path = os.path.join(case_dir, "graph_annotations.csv")
+    docs = synth_docs(GRAPH_DOCS, seed=seed % 7)  # corpus is seed-stable
+    # repair=True: framework-owned artifact read BEFORE AppendCsv reopens
+    # it — a torn key parsed leniently would be skipped as "done" forever
+    done = set(read_url_column(ann_path, column="url", repair=True))
+    ann = AppendCsv(ann_path, ["url", "digest"])
+    todo = [(f"G{i}", docs[i]) for i in range(GRAPH_DOCS) if f"G{i}" not in done]
+
+    graph = StageGraph("crashsweep_graph")
+    raw = graph.edge("raw", capacity=4)
+    cooked = graph.edge("cooked", capacity=4)
+    it = iter(todo)
+
+    def ingest():
+        time.sleep(0.004)  # pace the source so queues stay occupied
+        try:
+            return next(it)
+        except StopIteration:
+            return DONE
+
+    def transform(item):
+        key, doc = item
+        time.sleep(0.008)  # transform slower than ingest ⇒ real drain tail
+        return (key, _graph_digest(doc))
+
+    def persist(item):
+        key, digest = item
+        ann.write_row({"url": key, "digest": digest})
+        return None
+
+    graph.stage("ingest", source=ingest, out_edge=raw)
+    graph.stage(
+        "transform", fn=transform, in_edge=raw, out_edge=cooked, workers=2,
+        # span propagation across edges: each item's key tags its
+        # transform span, so the fault dump ties "what was in flight"
+        # to named records, not just tuple[2] shapes
+        tag=lambda item: {"key": item[0]},
+    )
+    graph.stage("persist", fn=persist, in_edge=cooked)
+    _touch_marker(case_dir)
+    graph.start()
+    try:
+        graph.join(timeout=120)
     finally:
         ann.close()
     return 0
@@ -523,6 +598,7 @@ CHILDREN = {
     "stream": child_stream,
     "pindex": child_pindex,
     "fleet": child_fleet,
+    "graph": child_graph,
 }
 
 
@@ -785,10 +861,71 @@ def verify_fleet(case_dir: str) -> list[str]:
     return problems
 
 
+def check_graph_safety(case_dir: str) -> list[str]:
+    """Kill-point invariants for the stage-graph workload: the annotations
+    CSV parses (torn tails are the reader's repair problem, never a loss),
+    and IF a chaos fault dumped the flight recorder, the sidecar holds a
+    whole-graph drain snapshot — stage in-flight items and edge depths at
+    the instant of death (the runtime's drain-on-crash contract)."""
+    problems: list[str] = []
+    flight = os.path.join(case_dir, "flight.jsonl")
+    if os.path.exists(flight):
+        summaries, snaps = [], []
+        with open(flight, encoding="utf-8", errors="replace") as fh:
+            for line in fh:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue  # an OS-cut tail line is allowed
+                if ev.get("kind") != "snapshot":
+                    continue
+                if ev.get("name") == "graphs":
+                    summaries.append(ev)
+                elif ev.get("name") == "graph":
+                    snaps.append(ev)
+        if not summaries:
+            problems.append(
+                "chaos fault dumped the flight recorder but the runtime's "
+                "snapshot hook never ran"
+            )
+        elif any(s.get("live", 0) > 0 for s in summaries):
+            # a graph WAS live at the fault: its whole-graph state must be
+            # in the dump (a pre-start fault legitimately has live=0)
+            ours = [s for s in snaps if s.get("graph") == "crashsweep_graph"]
+            if not ours:
+                problems.append(
+                    "graph was live at the fault but no whole-graph drain "
+                    "snapshot landed in the dump"
+                )
+            elif "edges" not in ours[-1] or "stages" not in ours[-1]:
+                problems.append(f"graph snapshot missing edges/stages: {ours[-1]}")
+    return problems
+
+
+def verify_graph(case_dir: str) -> list[str]:
+    from advanced_scrapper_tpu.storage.csvio import read_url_column
+
+    problems = check_graph_safety(case_dir)
+    keys = read_url_column(
+        os.path.join(case_dir, "graph_annotations.csv"), column="url",
+        repair=True,
+    )
+    expect = {f"G{i}" for i in range(GRAPH_DOCS)}
+    if set(keys) != expect:
+        problems.append(
+            f"records lost/invented: missing={sorted(expect - set(keys))[:3]} "
+            f"extra={sorted(set(keys) - expect)[:3]}"
+        )
+    if len(keys) != len(set(keys)):
+        problems.append("record persisted twice")
+    return problems
+
+
 SAFETY_CHECKS = {
     "harvest": check_harvest_safety,
     "stream": check_stream_safety,
     "pindex": check_pindex_safety,
+    "graph": check_graph_safety,
 }
 VERIFIERS = {
     "harvest": verify_harvest,
@@ -796,6 +933,7 @@ VERIFIERS = {
     "stream": verify_stream,
     "pindex": verify_pindex,
     "fleet": verify_fleet,
+    "graph": verify_graph,
 }
 
 #: chaos specs that land the pindex kill-points INSIDE each durability
@@ -1032,7 +1170,7 @@ def main(argv=None) -> int:
     import tempfile
 
     base = args.dir or tempfile.mkdtemp(prefix="crashsweep-")
-    per = max(1, args.kills // 5)
+    per = max(1, args.kills // 6)
     report = {
         "seed": args.seed,
         "workloads": [
@@ -1052,9 +1190,19 @@ def main(argv=None) -> int:
             ),
             sweep_fleet(base, kills=per, seed=args.seed),
             sweep_workload(
+                "graph",
+                base,
+                sigkills=max(1, per - 2),
+                chaos_kills=2,
+                seed=args.seed,
+            ),
+            sweep_workload(
                 "stream",
                 base,
-                sigkills=args.kills - 4 * per - 1,
+                # the remainder: five workloads above each land exactly
+                # `per` instants, stream takes what's left of --kills
+                # (its one chaos case included)
+                sigkills=max(1, args.kills - 5 * per - 1),
                 chaos_kills=1,
                 seed=args.seed,
                 kill_window=(0.05, 1.2),

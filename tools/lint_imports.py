@@ -17,6 +17,11 @@ Rules (banned prefixes per source layer)::
                          transport — protocol modules like net.lease stay
                          out of the index layer)
     net/                 must not import  pipeline/
+    runtime/             must not import  pipeline/, extractors/, net/,
+                         index/  (the scheduler sits on obs only; the
+                         pipeline→runtime dependency is strictly one-way,
+                         so a stage fn can be anything but the runtime
+                         itself knows no workload)
 
 Every ``import``/``from`` statement is found by walking the AST — including
 function-local imports, which the hot paths use deliberately — so a lazy
@@ -42,6 +47,9 @@ RULES: dict[str, tuple[str, ...]] = {
     "utils": ("pipeline", "net", "obs"),
     "index": ("pipeline", "net"),
     "net": ("pipeline",),
+    # the stage-graph runtime is workload-blind: pipeline/net/index ride
+    # its edges, never the other way around
+    "runtime": ("pipeline", "extractors", "net", "index"),
 }
 
 #: source layer → module names exempt from that layer's bans (exact module

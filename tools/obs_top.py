@@ -10,6 +10,10 @@ Two modes:
 - ``--once``: fetch one ``/status`` snapshot and print the full frame
   (per-stage latency table, queue/arena gauges, dedup + fleet counters) —
   the scriptable/smoke-testable path.
+- ``--graph`` (combinable with ``--once``): the stage-graph runtime view —
+  every live graph with its edges (depth/capacity, items in/out, put/get
+  stall seconds) and stages (throughput, busy time), straight from the
+  scheduler's own ``astpu_edge_*`` / ``astpu_stage_*`` series.
 - live (default): the :class:`obs.console.ConsoleMux` idiom — a sticky
   one-line summary repainted in place (per-stage rates computed from
   successive histogram snapshots, queue depths, fleet health) with notable
@@ -129,6 +133,106 @@ def render_frame(status: dict, prev: dict | None = None, dt: float = 0.0) -> lis
     return lines
 
 
+def render_graph_frame(
+    status: dict, prev: dict | None = None, dt: float = 0.0
+) -> list[str]:
+    """The stage-graph view (``--graph``): every live runtime graph with
+    its edges (depth/capacity, items in/out, put/get stall seconds) and
+    stages (item throughput, busy seconds) — the scheduler's own gauges
+    (``astpu_edge_*``, ``astpu_stage_*``), grouped by ``graph``/``g``
+    instance labels.  ``prev``/``dt`` add rate columns in live mode."""
+    idx = _index(status)
+    pidx = _index(prev) if prev else {}
+
+    def rate(key: str, field: str = "value") -> str:
+        if key in pidx and dt > 0:
+            m, p = idx[key], pidx[key]
+            return f" (+{(m.get(field, 0) - p.get(field, 0)) / dt:.1f}/s)"
+        return ""
+
+    # grouped by graph name only: counters are (graph, edge)-keyed while
+    # gauges additionally carry a per-instance ``g`` label — instance
+    # gauges of the same (graph, edge) are SUMMED (depth) / maxed (cap)
+    graphs: dict[str, dict] = {}
+    for m in status.get("metrics", []):
+        name = m["name"]
+        if not (name.startswith("astpu_edge_") or name.startswith("astpu_stage_items") or name.startswith("astpu_stage_busy")):
+            continue
+        labels = m.get("labels") or {}
+        if "graph" not in labels:
+            continue
+        slot = graphs.setdefault(labels["graph"], {"edges": {}, "stages": {}})
+        if name.startswith("astpu_edge_"):
+            ekey = labels.get("edge", "?")
+            slot["edges"].setdefault(ekey, {}).setdefault(
+                (name, labels.get("dir") or labels.get("side") or ""), []
+            ).append(m)
+        else:
+            slot["stages"].setdefault(labels.get("stage", "?"), {})[name] = m
+    lines: list[str] = []
+    if not graphs:
+        return ["  (no stage-graph series — is a runtime graph live?)"]
+    for gname in sorted(graphs):
+        slot = graphs[gname]
+        lines.append(f"  graph {gname}:")
+        for ename in sorted(slot["edges"]):
+            em = slot["edges"][ename]
+
+            def val(metric: str, sub: str = "", agg=sum) -> float:
+                ms = em.get((metric, sub))
+                return agg(m["value"] for m in ms) if ms else 0.0
+
+            depth = val("astpu_edge_depth")
+            cap = val("astpu_edge_capacity", agg=max)
+            cap_s = f"{cap:.0f}" if cap else "∞"
+            in_ms = em.get(("astpu_edge_items_total", "in"))
+            in_key = _series_key(in_ms[0]) if in_ms else ""
+            lines.append(
+                f"    edge {ename:<12} depth {depth:.0f}/{cap_s:<5} "
+                f"in {val('astpu_edge_items_total', 'in'):.0f}"
+                f"{rate(in_key)} "
+                f"out {val('astpu_edge_items_total', 'out'):.0f}  "
+                f"stall put {val('astpu_edge_stall_seconds_total', 'put'):.2f}s "
+                f"get {val('astpu_edge_stall_seconds_total', 'get'):.2f}s"
+            )
+        for sname in sorted(slot["stages"]):
+            sm = slot["stages"][sname]
+            items = sm.get("astpu_stage_items_total")
+            busy = sm.get("astpu_stage_busy_seconds_total")
+            ikey = _series_key(items) if items else ""
+            lines.append(
+                f"    stage {sname:<11} items "
+                f"{items['value'] if items else 0:.0f}{rate(ikey)}  "
+                f"busy {busy['value'] if busy else 0:.2f}s"
+            )
+    return lines
+
+
+def graph_summary_line(status: dict, prev: dict | None, dt: float) -> str:
+    """Sticky one-liner for live ``--graph`` mode: total edge depth and
+    the hottest stall side per graph."""
+    idx = _index(status)
+    per_graph: dict[str, float] = {}
+    stall: dict[str, float] = {}
+    for k, m in idx.items():
+        labels = m.get("labels") or {}
+        if m["name"] == "astpu_edge_depth" and "graph" in labels:
+            per_graph[labels["graph"]] = (
+                per_graph.get(labels["graph"], 0.0) + m["value"]
+            )
+        if m["name"] == "astpu_edge_stall_seconds_total" and "graph" in labels:
+            stall[labels["graph"]] = max(
+                stall.get(labels["graph"], 0.0), m["value"]
+            )
+    if not per_graph:
+        return "(no stage-graph series)"
+    parts = [
+        f"{g}: depth {d:.0f} stall≤{stall.get(g, 0):.1f}s"
+        for g, d in sorted(per_graph.items())
+    ]
+    return " | ".join(parts)
+
+
 def summary_line(status: dict, prev: dict | None, dt: float) -> str:
     """The sticky one-liner: per-stage rates + queue depth + fleet health."""
     idx = _index(status)
@@ -185,6 +289,12 @@ def main(argv=None) -> int:
     ap.add_argument("--interval", type=float, default=1.0)
     ap.add_argument("--once", action="store_true", help="one frame, then exit")
     ap.add_argument(
+        "--graph",
+        action="store_true",
+        help="stage-graph view: live edge depths/stall times and per-stage "
+        "throughput from the runtime's own gauges",
+    )
+    ap.add_argument(
         "--frames", type=int, default=0, help="stop after N polls (0 = forever)"
     )
     args = ap.parse_args(argv)
@@ -195,7 +305,11 @@ def main(argv=None) -> int:
         except OSError as e:
             print(f"obs_top: cannot reach {args.url}: {e}", file=sys.stderr)
             return 1
-        print("\n".join(render_frame(status)))
+        lines = render_graph_frame(status) if args.graph else render_frame(status)
+        if args.graph:
+            head = f"obs_top --graph @ {time.strftime('%H:%M:%S', time.localtime(status.get('ts')))}"
+            lines = [head] + lines
+        print("\n".join(lines))
         return 0
 
     from advanced_scrapper_tpu.obs.console import ConsoleMux, green, red
@@ -216,7 +330,11 @@ def main(argv=None) -> int:
             dt = now - t_prev if prev is not None else 0.0
             for msg, bad in watch_events(status, prev):
                 mux.event(red(msg) if bad else green(msg))
-            mux.stats(summary_line(status, prev, dt))
+            mux.stats(
+                graph_summary_line(status, prev, dt)
+                if args.graph
+                else summary_line(status, prev, dt)
+            )
             prev, t_prev = status, now
             n += 1
             if args.frames and n >= args.frames:
