@@ -149,11 +149,39 @@ class DedupConfig:
     #   (a pathological all-borderline corpus must not degrade to O(n²))
     seed: int = 1            # datasketch's default seed for oracle parity
     backend: str = "scan"    # scan (dense, datasketch-parity) | oph | pallas
-    put_workers: int = 0     # H2D put threads for the ragged path.
+    put_workers: int = 0     # H2D put threads INSIDE the pipelined
+    #   dispatch executor (pipeline/dispatch.py — the encode→pack→put→
+    #   dispatch pipeline every signature corpus now rides).
     #   0 = auto: the transport default (core.mesh.auto_h2d_workers — 4 on
     #   the serializing axon tunnel, 1 on local backends); >1 overlaps
     #   per-put round trips (DESIGN §5 stream-tuning note);
-    #   order-independent min-combine makes any arrival order exact
+    #   order-independent min-combine makes any arrival order exact.
+    #   Pre-PR-9 this knob also selected the inline put→accumulate loop
+    #   at 1 — the executor is now always on (MIGRATION.md).
+    dispatch_window: int = 0  # depth-N in-flight dispatch window: tiles
+    #   resident between the H2D put stage and the accumulate dispatch
+    #   (the executor's staged-edge capacity; total in-flight device
+    #   tiles ≈ window + put_workers + 1 accumulating).  0 = auto:
+    #   max(2, put_workers) — double buffering on local backends, a
+    #   put-worker-deep window on serializing transports.
+    packed_h2d: bool = True  # pack each tile's (tokens, lengths, owners)
+    #   into ONE contiguous buffer (ops/pack.py): per-tile H2D is one
+    #   device_put instead of three serialized round trips, and the
+    #   signature+accumulate step is ONE fused jitted dispatch with the
+    #   accumulator donated (ops.minhash.make_fused_tile_step).  False
+    #   restores the legacy 3-put/2-dispatch tile transport — kept for
+    #   parity certification (byte-identical, tested) and as an escape
+    #   hatch; both routes ride the same executor.
+    prewarm: int = 0         # compile the packed tile-step shape set at
+    #   engine init (NearDupEngine.prewarm): every width bucket's full
+    #   tile plus its O(log bs) power-of-two tail chunks.  0 = off
+    #   (default: cold compile of the full set costs tens of seconds on
+    #   CPU, which tests must not pay); 1 = prewarm for one batch_size
+    #   corpus; >1 = the EXPECTED ARTICLE COUNT per corpus — the fused
+    #   step is compiled per bucketed article axis, so prewarming the
+    #   wrong bucket recompiles everything on the first real corpus
+    #   anyway.  Pair with ASTPU_COMPILE_CACHE (persistent XLA
+    #   compilation cache) to make the warmup survive process restarts.
     stream_index: str = "exact"  # exact (attributed, grows with stream) |
     #   bloom (LSHBloom: fixed memory, no attribution) |
     #   persist (index/ subsystem: durable log-structured postings on disk,

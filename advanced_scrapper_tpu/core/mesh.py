@@ -74,6 +74,62 @@ def shard_map_compat(f, *, mesh, in_specs, out_specs):
     )
 
 
+_compile_cache_dir: str | None = None
+_compile_cache_applied = False
+
+
+def maybe_enable_compile_cache() -> str | None:
+    """Point XLA's persistent compilation cache at ``$ASTPU_COMPILE_CACHE``.
+
+    Every cold process used to recompile the dedup tile-shape set from
+    scratch (O(log bs) shapes per width bucket — seconds of first-corpus
+    latency that bench rounds kept re-paying).  With the knob set, jitted
+    programs persist to the named directory and later processes load them
+    instead of recompiling; the entry-size/compile-time thresholds are
+    dropped to zero so the small minhash steps actually qualify.  Called
+    from engine init (``pipeline.dedup.NearDupEngine``) and ``bench.py``;
+    idempotent, returns the cache dir when active, None when the knob is
+    unset or this jax predates the config names.
+    """
+    global _compile_cache_dir, _compile_cache_applied
+    if _compile_cache_applied:
+        return _compile_cache_dir
+    import os
+
+    d = os.environ.get("ASTPU_COMPILE_CACHE")
+    if not d:
+        # do NOT latch: the knob may be exported later in the process
+        # (long-lived workers, tests) and must still take effect then
+        return None
+    _compile_cache_applied = True
+    # all-or-nothing: applying the cache dir but not the thresholds would
+    # leave the cache writing with defaults that skip every small tile
+    # step — enabled-but-useless, while this function reports None.  So
+    # every update is staged with its previous value and the whole set
+    # rolls back if any config name is missing (older jax).
+    updates = (
+        ("jax_compilation_cache_dir", d),
+        # without these the cache skips "cheap" compiles — which is every
+        # tile-step variant on CPU, making the knob silently useless
+        ("jax_persistent_cache_min_entry_size_bytes", -1),
+        ("jax_persistent_cache_min_compile_time_secs", 0.0),
+    )
+    applied: list[tuple[str, object]] = []
+    try:
+        for name, value in updates:
+            applied.append((name, getattr(jax.config, name)))
+            jax.config.update(name, value)
+    except Exception:  # older jax without the persistent-cache config
+        for name, prev in applied:
+            try:
+                jax.config.update(name, prev)
+            except Exception:  # pragma: no cover - rollback is best-effort
+                pass
+        return None
+    _compile_cache_dir = d
+    return d
+
+
 def auto_h2d_workers() -> int:
     """Default H2D-overlap thread count for the attached transport.
 

@@ -13,11 +13,12 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import sysconfig
 import threading
 
 import numpy as np
+
+from advanced_scrapper_tpu.cpu.nativebuild import build_or_find, find_fresh
 
 _SRC = os.path.join(
     os.path.dirname(os.path.dirname(__file__)), "native", "exactdedup.cpp"
@@ -27,49 +28,47 @@ _LIB = os.path.join(os.path.dirname(_SRC), "libexactdedup.so")
 _lock = threading.Lock()
 _lib: ctypes.PyDLL | None = None
 _backend = "unloaded"
-
-
-def _build() -> bool:
-    include = sysconfig.get_paths().get("include")
-    if not include or not os.path.exists(os.path.join(include, "Python.h")):
-        return False
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", f"-I{include}", _SRC,
-             "-o", _LIB],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
-        return True
-    except Exception:
-        return False
+_reason = ""  # why the native tier is unavailable ("" when it is)
 
 
 def _load() -> ctypes.PyDLL | None:
-    global _lib, _backend
+    global _lib, _backend, _reason
     if _backend != "unloaded":
         return _lib
     with _lock:
         if _backend != "unloaded":
             return _lib
-        needs_build = (not os.path.exists(_LIB)) or (
-            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
-        )
-        if needs_build and not _build():
-            _backend = "python"
-            return None
+        # a prebuilt fresh .so loads WITHOUT the CPython dev headers —
+        # they are a compile-time prerequisite only (a deploy box that
+        # ships the binary must not fall back just because it could not
+        # have built it)
+        lib_path = find_fresh(_SRC, _LIB)
+        if lib_path is None:
+            include = sysconfig.get_paths().get("include")
+            if not include or not os.path.exists(
+                os.path.join(include, "Python.h")
+            ):
+                _backend, _reason = "python", "CPython dev headers not found"
+                return None
+            # build beside the source, falling back to a temp dir when
+            # the repo is unwritable; the failure reason is kept for
+            # reporting (bench exposes it — a silent fallback cost
+            # BENCH_r05 12× on the exact regime)
+            lib_path, why = build_or_find(_SRC, _LIB, (f"-I{include}",))
+            if lib_path is None:
+                _backend, _reason = "python", why
+                return None
         try:
             # PyDLL: calls run WITH the GIL held — the kernel walks live
             # Python objects, so releasing it (plain CDLL) would race the
             # interpreter
-            lib = ctypes.PyDLL(_LIB)
+            lib = ctypes.PyDLL(lib_path)
             lib.ed_keep_first_list.restype = ctypes.c_long
             lib.ed_keep_first_list.argtypes = [
                 ctypes.py_object, ctypes.c_void_p,
             ]
-        except (OSError, AttributeError):
-            _backend = "python"
+        except (OSError, AttributeError) as e:
+            _backend, _reason = "python", f"load failed: {e}"
             return None
         _lib = lib
         _backend = "native"
@@ -80,6 +79,12 @@ def exactdedup_backend() -> str:
     """'native' or 'python' (after first use)."""
     _load()
     return _backend
+
+
+def backend_reason() -> str:
+    """Why the native tier is unavailable — "" when it is live."""
+    _load()
+    return _reason
 
 
 def keep_first_list(items) -> np.ndarray | None:

@@ -20,7 +20,6 @@ from __future__ import annotations
 import collections
 import ctypes
 import os
-import subprocess
 import threading
 import time
 from typing import Iterable
@@ -33,36 +32,28 @@ _LIB = os.path.join(os.path.dirname(_SRC), "libhostbatch.so")
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _backend = "unloaded"
-
-
-def _build() -> bool:
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", _LIB],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
-        return True
-    except Exception:
-        return False
+_reason = ""  # why the native backend is unavailable ("" when it is)
 
 
 def _load() -> ctypes.CDLL | None:
-    global _lib, _backend
+    global _lib, _backend, _reason
     with _lock:
         if _backend != "unloaded":
             return _lib
-        needs_build = (not os.path.exists(_LIB)) or (
-            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
-        )
-        if needs_build and not _build():
-            _backend = "python"
+        from advanced_scrapper_tpu.cpu.nativebuild import build_or_find
+
+        # build beside the source, falling back to a per-user temp dir
+        # when the repo is unwritable; keep the failure reason for
+        # reporting (a silently-degraded batcher/encoder costs the whole
+        # stream/ragged path, not just one call site)
+        lib_path, why = build_or_find(_SRC, _LIB)
+        if lib_path is None:
+            _backend, _reason = "python", why
             return None
         try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError:
-            _backend = "python"
+            lib = ctypes.CDLL(lib_path)
+        except OSError as e:
+            _backend, _reason = "python", f"load failed: {e}"
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         lib.hb_create.restype = ctypes.c_void_p
@@ -136,6 +127,12 @@ def hostbatch_backend() -> str:
     """'native' or 'python' (after first use)."""
     _load()
     return _backend
+
+
+def backend_reason() -> str:
+    """Why the native backend is unavailable — "" when it is live."""
+    _load()
+    return _reason
 
 
 def _enc(doc: str | bytes) -> bytes:

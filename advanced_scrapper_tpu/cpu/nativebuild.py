@@ -1,0 +1,126 @@
+"""Shared build-or-find logic for the on-demand native kernels.
+
+Every native helper (``hostbatch``, ``exactdedup``, …) compiles its .so
+beside its source on first use.  Two silent failure modes used to route
+hot paths onto Python fallbacks with no trace — BENCH_r05's exact
+regime ran the 12×-slower grouping fallback for a whole round before
+anyone noticed (ISSUE 9):
+
+- the repo directory may be unwritable under a harness (read-only
+  checkout, sandbox) — ``g++ -o <repo>/lib*.so`` fails even though the
+  compiler works; and
+- the failure reason (no g++, missing Python.h, timeout, unwritable
+  target) was swallowed by a bare ``except``.
+
+:func:`build_or_find` fixes both: it tries the canonical beside-source
+path first, then a per-user temp-dir fallback, and remembers WHY the
+last attempt failed so loaders can expose it
+(``exactdedup.backend_reason()`` → bench JSON ``exact_backend_reason``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import tempfile
+
+
+def fallback_lib_path(lib_path: str) -> str:
+    """Per-user temp-dir twin of a beside-source .so path.
+
+    The filename carries a short hash of the canonical path: two
+    checkouts of the repo on one machine (CI sandboxes, worktrees) must
+    never share a fallback .so — a fresh-looking binary built from the
+    OTHER checkout's source would load silently."""
+    tag = f"astpu-native-{os.getuid() if hasattr(os, 'getuid') else 'u'}"
+    digest = hashlib.sha1(
+        os.path.abspath(lib_path).encode("utf-8")
+    ).hexdigest()[:10]
+    base, ext = os.path.splitext(os.path.basename(lib_path))
+    return os.path.join(
+        tempfile.gettempdir(), tag, f"{base}-{digest}{ext}"
+    )
+
+
+def _fallback_dir_trusted(lib: str, create: bool) -> bool:
+    """The fallback dir is trusted only when THIS user owns it and no one
+    else can write it — ``ctypes`` will dlopen whatever sits there, and
+    the tag name under the world-writable temp dir is predictable, so an
+    attacker-planted directory (or .so) must never be honoured."""
+    d = os.path.dirname(lib)
+    if create:
+        try:
+            os.makedirs(d, mode=0o700, exist_ok=True)
+        except Exception:
+            return False
+    try:
+        st = os.stat(d)
+    except OSError:
+        return False
+    if hasattr(os, "getuid") and st.st_uid != os.getuid():
+        return False
+    return not st.st_mode & 0o022  # no group/other write
+
+
+def _fresh(lib: str, src: str) -> bool:
+    return os.path.exists(lib) and os.path.getmtime(lib) >= os.path.getmtime(
+        src
+    )
+
+
+def find_fresh(src: str, lib_path: str) -> str | None:
+    """An already-built, up-to-date .so for ``src`` (canonical path or
+    owner-verified temp-dir fallback), or None.  Lets loaders with
+    build-only prerequisites (e.g. CPython headers) serve a prebuilt
+    library on hosts that could not have compiled it."""
+    if _fresh(lib_path, src):
+        return lib_path
+    fb = fallback_lib_path(lib_path)
+    if _fallback_dir_trusted(fb, create=False) and _fresh(fb, src):
+        return fb
+    return None
+
+
+def build_or_find(
+    src: str, lib_path: str, extra_flags: tuple[str, ...] = ()
+) -> tuple[str | None, str]:
+    """``(path_to_fresh_so | None, reason)``.
+
+    Candidates in order: the canonical ``lib_path`` (beside the source),
+    then :func:`fallback_lib_path` under the temp dir.  A candidate that
+    is already fresh (mtime ≥ source) wins without compiling; otherwise
+    a ``g++`` build into it is attempted.  On total failure the second
+    element says why (compiler stderr tail, missing toolchain, …) so the
+    caller can surface it instead of silently degrading.
+    """
+    # fresh candidates first — BOTH of them, before any build attempt:
+    # a missing compiler must not hide a loadable prebuilt fallback
+    found = find_fresh(src, lib_path)
+    if found is not None:
+        return found, ""
+    reasons: list[str] = []
+    fb = fallback_lib_path(lib_path)
+    for target in (lib_path, fb):
+        if target is fb and not _fallback_dir_trusted(fb, create=True):
+            reasons.append(f"fallback dir for {fb} not owned/private")
+            continue
+        try:
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", *extra_flags, src,
+                 "-o", target],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            return target, ""
+        except FileNotFoundError:
+            reasons.append("g++ not found")
+            break  # no compiler: the fallback dir won't help
+        except subprocess.CalledProcessError as e:
+            tail = (e.stderr or b"").decode("utf-8", "replace")[-200:]
+            reasons.append(f"g++ failed for {target}: {tail.strip()}")
+        except Exception as e:  # timeout, unwritable dir, ...
+            reasons.append(f"build into {target}: {type(e).__name__}: {e}")
+    return None, "; ".join(reasons) or "unknown build failure"

@@ -733,31 +733,33 @@ class TpuBatchBackend:
                     if key:
                         self._seen_keys.add(key)
 
-        # near-dup stage: device signatures + band keys, host bucket join
+        # near-dup stage: device signatures + band keys (computed together
+        # in the engine's fused epilogue — one dispatch off the
+        # device-resident accumulator, no sig D2H→re-H2D bounce), host
+        # bucket join
         texts = [str(r.get(self.text_field, "") or "") for r in records]
-        sigs = self.engine.signatures(texts)
         thresh = self.cfg.sim_threshold
         if self._bloom_mode or self._persist_mode:
             # wide (2×uint32 → uint64) keys: neither index stores
             # signatures to verify agreement against, so key width IS the
             # false-drop floor
-            keys64 = self._pack_keys64(
-                np.asarray(band_keys_wide(sigs, self.params.band_salt))
-            )
+            _sigs, keys_wide = self.engine.signatures_and_keys(
+                texts, wide=True, sync_sigs=False
+            )  # neither index stores signatures: skip their D2H entirely
+            keys64 = self._pack_keys64(keys_wide)
             if self._persist_mode:
                 return self._near_dup_persist(
                     records, texts, keys64, doc_ids, url_postings
                 )
             return self._near_dup_bloom(records, texts, keys64)
         # Coarse + fine candidate columns — the same key scheme as the
-        # certified batch engine (ops.lsh.candidate_keys), so the streaming
-        # exact index keeps knee-regime candidacy; every hit still verifies
-        # by signature agreement before attribution.  (The bloom mode below
-        # stays coarse-band: it cannot verify, and widening its key set
-        # would trade its bounded-memory contract for unverifiable drops.)
-        keys = np.asarray(
-            candidate_keys(sigs, self.params.band_salt, self.cfg.cand_subbands)
-        )
+        # certified batch engine (ops.lsh.candidate_keys semantics), so
+        # the streaming exact index keeps knee-regime candidacy; every hit
+        # still verifies by signature agreement before attribution.  (The
+        # bloom mode below stays coarse-band: it cannot verify, and
+        # widening its key set would trade its bounded-memory contract for
+        # unverifiable drops.)
+        sigs, keys = self.engine.signatures_and_keys(texts)
         for i, rec in enumerate(records):
             rec["near_dup_of"] = None
             if rec["dup_of"] is not None:

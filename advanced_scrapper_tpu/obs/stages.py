@@ -84,6 +84,72 @@ def snapshot_ms() -> dict[str, float]:
     return dict(sorted(out.items()))
 
 
+# -- device-traffic counters ---------------------------------------------
+#
+# Always-on (like the stage histograms): dispatch-count wins are gated
+# NUMERICALLY — a tier-1 test asserts the packed dedup path's per-tile
+# traffic is 1 put + 1 dispatch, and the bench emits per-regime deltas —
+# so the counters must exist whether or not telemetry is enabled.  The
+# ``regime`` label names the instrumented call-site plane ("dedup" = the
+# NearDupEngine hot path, "feed" = DeviceFeed staging); bench maps the
+# cumulative deltas onto its own regime keys.  Only EXPLICIT device
+# traffic is counted: ``jax.device_put`` calls and jitted-step dispatches
+# in the instrumented pipelines — implicit transfers (numpy passed
+# straight to a jit) are exactly the shape the packed path exists to
+# avoid, and counting them would hide that.
+
+_DEV_NAMES = (
+    "astpu_device_puts_total",
+    "astpu_device_dispatches_total",
+    "astpu_h2d_bytes_total",
+)
+_dev_counters: dict[tuple[str, str], telemetry.Counter] = {}
+
+
+def _dev(name: str, regime: str) -> telemetry.Counter:
+    c = _dev_counters.get((name, regime))
+    if c is None:
+        c = telemetry.event_counter(
+            name,
+            {
+                "astpu_device_puts_total": "explicit jax.device_put calls",
+                "astpu_device_dispatches_total": "jitted device dispatches",
+                "astpu_h2d_bytes_total": "host→device bytes shipped by puts",
+            }[name],
+            regime=regime,
+        )
+        with _lock:
+            _dev_counters[(name, regime)] = c
+    return c
+
+
+def count_device_put(nbytes: int, regime: str = "dedup") -> None:
+    """Record one explicit ``jax.device_put`` of ``nbytes``."""
+    _dev("astpu_device_puts_total", regime).inc()
+    _dev("astpu_h2d_bytes_total", regime).inc(nbytes)
+
+
+def count_dispatch(regime: str = "dedup", n: int = 1) -> None:
+    """Record ``n`` jitted device dispatches."""
+    _dev("astpu_device_dispatches_total", regime).inc(n)
+
+
+def device_counters() -> dict[str, float]:
+    """Cumulative device-traffic totals, summed across ``regime`` labels:
+    ``{"device_puts", "device_dispatches", "h2d_bytes"}``.  Subtract two
+    snapshots to window a regime (the bench does)."""
+    out = {"device_puts": 0.0, "device_dispatches": 0.0, "h2d_bytes": 0.0}
+    short = {
+        "astpu_device_puts_total": "device_puts",
+        "astpu_device_dispatches_total": "device_dispatches",
+        "astpu_h2d_bytes_total": "h2d_bytes",
+    }
+    for name, key in short.items():
+        for c in telemetry.REGISTRY.find(name):
+            out[key] += c.value
+    return out
+
+
 def _clear_for_tests() -> None:
     """Drop the handle cache and baselines — required after a test calls
     ``telemetry.REGISTRY.reset()``, or cached handles would keep feeding
@@ -91,3 +157,4 @@ def _clear_for_tests() -> None:
     with _lock:
         _hists.clear()
         _baseline.clear()
+        _dev_counters.clear()

@@ -318,6 +318,124 @@ def candidate_keys(
     return jnp.concatenate([keys, fine], axis=1)
 
 
+def _maybe_densify(sig: jnp.ndarray, densify_oph: bool) -> jnp.ndarray:
+    """OPH accumulators arrive RAW (empty bins ``U32_MAX``) so the
+    streamed min-combine stays exact; the epilogues densify once, after
+    the combine, inside their own dispatch."""
+    if not densify_oph:
+        return sig
+    from advanced_scrapper_tpu.ops.oph import densify
+
+    return densify(sig)
+
+
+def _coarse_fine_keys(
+    sig: jnp.ndarray, band_salt: jnp.ndarray, fine_salt: jnp.ndarray
+) -> jnp.ndarray:
+    """:func:`candidate_keys`' fold with the fine salts passed as an
+    array — a zero-length ``fine_salt`` (static shape under trace)
+    yields the plain coarse keys.  Shared by the fused epilogues so the
+    key scheme still lives in exactly one construction."""
+    keys = band_keys(sig, band_salt)
+    if fine_salt.shape[0]:
+        keys = jnp.concatenate([keys, band_keys(sig, fine_salt)], axis=1)
+    return keys
+
+
+@partial(jax.jit, static_argnames=("densify_oph",))
+def fused_candidate_epilogue(
+    sig_acc: jnp.ndarray,
+    valid: jnp.ndarray,
+    band_salt: jnp.ndarray,
+    fine_salt: jnp.ndarray,
+    *,
+    densify_oph: bool,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """ONE-dispatch corpus epilogue: ``(sigs, keys, rep_bands)`` from the
+    device-resident signature accumulator.
+
+    Folds what used to be separate jitted calls — OPH densify,
+    :func:`candidate_keys` (itself two ``band_keys`` dispatches when
+    sub-bands are on) and :func:`duplicate_rep_bands` — into a single
+    step, so a full corpus through the packed dedup path is
+    ``tiles × 1`` dispatches plus this epilogue (ISSUE 9 / SEDD's
+    launch-count argument).  ``fine_salt`` is ``subband_salt(cand_subbands)``
+    or a zero-length array (its static shape selects the variant).
+    """
+    sig = _maybe_densify(sig_acc, densify_oph)
+    keys = _coarse_fine_keys(sig, band_salt, fine_salt)
+    return sig, keys, duplicate_rep_bands(keys, valid)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "densify_oph", "num_coarse", "jump_rounds", "use_fine_margin",
+    ),
+)
+def fused_resolve_epilogue(
+    sig_acc: jnp.ndarray,
+    valid: jnp.ndarray,
+    band_salt: jnp.ndarray,
+    fine_salt: jnp.ndarray,
+    base,
+    fine_margin,
+    *,
+    densify_oph: bool,
+    num_coarse: int,
+    jump_rounds: int,
+    use_fine_margin: bool,
+) -> jnp.ndarray:
+    """The WHOLE estimator-only resolution as one dispatch: OPH densify →
+    coarse+fine keys → per-band candidates → (optional) per-edge fine
+    bars → verification + union-find labels.
+
+    The async/firehose path (``dedup_reps_async`` with no rerank hook)
+    rides this, so a full corpus is exactly ``tiles × 1`` dispatches plus
+    this single epilogue — the ISSUE 9 launch-count shape.  A rerank hook
+    needs the candidate matrix on the host boundary between candidates
+    and resolution, so hooked engines fall back to the two-stage
+    :func:`fused_candidate_epilogue` + :func:`resolve_rep_bands` split
+    (identical math, one extra dispatch).
+    """
+    sig = _maybe_densify(sig_acc, densify_oph)
+    keys = _coarse_fine_keys(sig, band_salt, fine_salt)
+    rep_bands = duplicate_rep_bands(keys, valid)
+    if use_fine_margin:
+        thr = fine_edge_thresholds(
+            rep_bands, keys, base, fine_margin, num_coarse=num_coarse
+        )
+    else:
+        thr = base
+    return resolve_rep_bands(
+        rep_bands, sig, valid, thr, jump_rounds=jump_rounds
+    )
+
+
+@partial(jax.jit, static_argnames=("densify_oph", "wide"))
+def fused_keys_epilogue(
+    sig_acc: jnp.ndarray,
+    band_salt: jnp.ndarray,
+    fine_salt: jnp.ndarray,
+    *,
+    densify_oph: bool,
+    wide: bool,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """ONE-dispatch ``(sigs, keys)`` epilogue for callers that join on
+    host (the streaming batch backend) or feed a persistent index.
+
+    ``wide=False`` returns :func:`candidate_keys`-equivalent coarse+fine
+    keys; ``wide=True`` returns :func:`band_keys_wide`'s two-lane keys
+    (``fine_salt`` ignored).  Replaces the old shape where the backend
+    synced host signatures and passed them BACK through ``band_keys*`` —
+    a D2H → re-H2D bounce plus extra dispatches per batch.
+    """
+    sig = _maybe_densify(sig_acc, densify_oph)
+    if wide:
+        return sig, band_keys_wide(sig, band_salt)
+    return sig, _coarse_fine_keys(sig, band_salt, fine_salt)
+
+
 def _fine_only_chunks(rep_bands, keys, num_coarse):
     """Yield ``(c0, cand_slice, fine_only_slice)`` in 8-column chunks:
     ``fine_only[b, c]`` is True when column c's candidate for row b shares

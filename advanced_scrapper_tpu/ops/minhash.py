@@ -162,6 +162,60 @@ def combine_block_signatures(
     )
 
 
+def make_fused_tile_step(params: MinHashParams, backend: str):
+    """Build the SINGLE-dispatch per-tile step of the packed dedup path:
+    ``(running, packed) -> running'`` — unpack the one-buffer tile
+    (``ops.pack``), compute block signatures, segment-min them per
+    article, and fold into the DONATED running accumulator, all inside
+    one jitted call.
+
+    The legacy path pays two dispatches per tile (``block_fn`` then
+    :func:`accumulate_block_signatures`); on a tunneled transport each
+    dispatch is a control-channel round trip, so halving the count is a
+    direct latency win (SEDD's per-batch launch-minimisation argument —
+    PAPERS.md).  Donating ``running`` extends the donation already on
+    the legacy accumulate: the device updates the accumulator in place,
+    no per-tile ``[num_articles, P]`` allocation.
+
+    ``backend == "oph"`` uses the RAW OPH form (empty bins ``U32_MAX``)
+    so the min-combine stays exact; callers densify once after the last
+    tile (``ops/oph.py`` on why that order is load-bearing).  The
+    params arrays are closure-captured (constant-folded into the
+    compiled step), so cache the returned callable per (params,
+    backend) — ``pipeline.dedup.NearDupEngine`` holds one per engine.
+    """
+    if backend == "oph":
+        from advanced_scrapper_tpu.ops.oph import oph_raw_signatures
+
+        block_fn = oph_raw_signatures
+    else:
+        block_fn = resolve_signature_fn(backend)
+
+    from advanced_scrapper_tpu.ops.pack import unpack_tile
+
+    @partial(
+        jax.jit,
+        static_argnames=("rows", "width", "num_articles"),
+        donate_argnums=(0,),
+    )
+    def fused_tile_step(
+        running: jnp.ndarray,
+        packed: jnp.ndarray,
+        *,
+        rows: int,
+        width: int,
+        num_articles: int,
+    ) -> jnp.ndarray:
+        tok, lens, owners = unpack_tile(packed, rows, width)
+        sigs = block_fn(tok, lens, params)
+        part = jax.ops.segment_min(
+            sigs, owners, num_segments=num_articles, indices_are_sorted=False
+        )
+        return jnp.minimum(running, part)
+
+    return fused_tile_step
+
+
 @partial(jax.jit, static_argnames=("num_articles",), donate_argnums=(0,))
 def accumulate_block_signatures(
     running: jnp.ndarray,

@@ -1,0 +1,79 @@
+"""Tile packing for single-`device_put` H2D transfers.
+
+The dedup hot path used to ship every tile as THREE host arrays —
+``tokens uint8[rows, width]``, ``lengths int32[rows]``,
+``owners int32[rows]`` — i.e. three ``jax.device_put`` calls per tile.
+On transports where each put is a serialized round trip (the tunneled
+dev chip; DESIGN.md §5) that is three round trips for one tile of work.
+
+:func:`pack_tile` flattens the triple into ONE contiguous ``uint8``
+buffer (tokens first, then the two int32 planes as little-endian byte
+quadruples) so the whole tile crosses the host→device boundary in one
+put; :func:`unpack_tile` re-slices it *inside* the jitted step — the
+reconstruction is a reshape plus three shift-ors per int32 plane, noise
+against the MinHash work that follows, and XLA fuses it into the kernel
+prologue.
+
+Layout (``rows``/``width`` are static per compiled step — the flat
+buffer alone is ambiguous: ``rows·(width+8)`` collides across shapes)::
+
+    [0, rows*width)              tokens, row-major uint8
+    [rows*width, +4*rows)        lengths, int32 little-endian bytes
+    [rows*width+4*rows, +4*rows) owners,  int32 little-endian bytes
+
+Host-side packing is one preallocated buffer and three ``memcpy``-class
+numpy assignments — no per-row Python work.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: trailer bytes per row: lengths (4) + owners (4)
+TRAILER_BYTES_PER_ROW = 8
+
+
+def packed_nbytes(rows: int, width: int) -> int:
+    """Size of a packed tile buffer in bytes."""
+    return rows * (width + TRAILER_BYTES_PER_ROW)
+
+
+def pack_tile(
+    tok: np.ndarray, lens: np.ndarray, owners: np.ndarray
+) -> np.ndarray:
+    """``uint8[rows*(width+8)]`` single-buffer form of a ``(tokens,
+    lengths, owners)`` tile (see module docstring for the layout)."""
+    rows, width = tok.shape
+    buf = np.empty(packed_nbytes(rows, width), np.uint8)
+    buf[: rows * width] = tok.reshape(-1)
+    off = rows * width
+    buf[off : off + 4 * rows] = np.ascontiguousarray(
+        lens, dtype="<i4"
+    ).view(np.uint8)
+    buf[off + 4 * rows :] = np.ascontiguousarray(
+        owners, dtype="<i4"
+    ).view(np.uint8)
+    return buf
+
+
+def unpack_tile(packed, rows: int, width: int):
+    """Device-side inverse of :func:`pack_tile` — traceable under jit.
+
+    Returns ``(tokens uint8[rows, width], lengths int32[rows],
+    owners int32[rows])``.  The int32 planes are rebuilt from their
+    little-endian bytes arithmetically (bitcast of a trailing uint8 axis
+    is not portable across jax releases; four shift-ors are).
+    """
+    import jax.numpy as jnp
+
+    tok = packed[: rows * width].reshape(rows, width)
+    words = packed[rows * width :].astype(jnp.uint32).reshape(2, rows, 4)
+    vals = (
+        words[..., 0]
+        | (words[..., 1] << 8)
+        | (words[..., 2] << 16)
+        | (words[..., 3] << 24)
+    )
+    lens = vals[0].astype(jnp.int32)
+    owners = vals[1].astype(jnp.int32)
+    return tok, lens, owners

@@ -44,6 +44,20 @@ _U32_MAX = 0xFFFFFFFF
 LANE = 128
 _NUM_PERM = 128
 
+#: lazily-resolved "is the backend CPU" probe for the default interpret
+#: mode.  Resolved ONCE: the wrapper used to call ``jax.devices()`` on
+#: every invocation, which is a per-tile backend query on the legacy
+#: (non-fused) dispatch path — and on a tunneled transport a backend
+#: query is not free.  The platform cannot change mid-process.
+_ON_CPU: bool | None = None
+
+
+def _on_cpu() -> bool:
+    global _ON_CPU
+    if _ON_CPU is None:
+        _ON_CPU = jax.devices()[0].platform == "cpu"
+    return _ON_CPU
+
 
 def _fmix32(h):
     h = h ^ (h >> 16)
@@ -173,7 +187,7 @@ def minhash_signatures_pallas(
         lengths = jnp.pad(lengths, ((0, pb),))
     tokens = jnp.pad(tokens, ((0, 0), (0, LANE)))
     if interpret is None:
-        interpret = jax.devices()[0].platform == "cpu"
+        interpret = _on_cpu()
     sig = _pallas_signatures(
         tokens,
         lengths,

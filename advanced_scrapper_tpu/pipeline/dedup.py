@@ -12,7 +12,7 @@
 
 from __future__ import annotations
 
-import threading
+import time
 from typing import Sequence
 
 import numpy as np
@@ -28,8 +28,6 @@ from advanced_scrapper_tpu.core.tokenizer import (
 from advanced_scrapper_tpu.ops.exact import ExactHasher
 from advanced_scrapper_tpu.ops.lsh import (
     borderline_edge_mask,
-    candidate_keys,
-    duplicate_rep_bands,
     fine_edge_thresholds,
     resolve_rep_bands,
     resolve_rep_bands_from_ok,
@@ -54,6 +52,28 @@ def _jump_rounds(n: int) -> int:
     while (1 << r) < n:
         r += 1
     return r
+
+
+def _tile_bs(cfg: DedupConfig, width: int) -> int:
+    """Full-tile row count for a width bucket.  ``cfg.batch_size`` keeps
+    its pre-bucketing meaning — peak device bytes per dispatch stay
+    ``batch_size × block_len`` — so rows scale up as the bucket narrows.
+    THE single source of the formula: the encode chunker and
+    :meth:`NearDupEngine.prewarm` must draw from the same shape set, or
+    prewarming silently compiles a disjoint set and defeats itself."""
+    return min(max(cfg.batch_size * cfg.block_len // width, 64), 16384)
+
+
+def _tile_rows_options(bs: int) -> list[int]:
+    """Every row count the greedy chunker can emit for a width bucket:
+    the full tile plus the descending power-of-two tail chunks (≥64) —
+    the O(log bs) shape set prewarm compiles."""
+    rows_set = {bs}
+    rows = 64
+    while rows < bs:
+        rows_set.add(rows)
+        rows *= 2
+    return sorted(rows_set)
 
 
 def resolve_put_workers(cfg: DedupConfig) -> int:
@@ -87,11 +107,30 @@ class NearDupEngine:
         # compiled fused-step cache for dedup_reps_sharded, keyed on
         # (mesh, article bucket, block_len) — meshes are hashable
         self._sharded_steps: dict = {}
+        #: the single-dispatch packed tile step (ops.minhash.
+        #: make_fused_tile_step), built lazily — params constant-fold in
+        self._fused_step = None
         #: the rerank tier's slot on :data:`RERANK_HOOK_EDGE` — when set,
         #: every resolution path passes its candidate matrix through it
         #: before union-find (None = pass-through)
         self.rerank_hook = None
+        #: optional per-tile observer ``(dict) -> None`` on the dispatch
+        #: executor loop (tile index, rows, width, h2d_bytes, put/dispatch
+        #: ms) — ``tools/profile_hostpath.py --device`` renders it as a
+        #: timeline; None = no per-tile host work
+        self.dispatch_probe = None
+        #: tiles dispatched by the most recent corpus (set by
+        #: ``_accumulate_device``; the per-tile traffic gate divides the
+        #: device counters by this)
+        self.last_tiles = 0
         self._instrument()
+        # ASTPU_DEDUP_PREWARM=N; initialises jax.  1 = default (one
+        # batch_size corpus); >1 pins the expected per-corpus article
+        # count, whose bucket the step set keys on.  Pointless under the
+        # legacy transport (it never dispatches the fused step), so the
+        # escape-hatch combination skips it instead of burning compiles.
+        if self.cfg.prewarm and self.cfg.packed_h2d:
+            self.prewarm(None if self.cfg.prewarm == 1 else self.cfg.prewarm)
 
     def _instrument(self) -> None:
         """Telemetry handles (no-ops when disabled) + the production home
@@ -184,37 +223,125 @@ class NearDupEngine:
             return np.asarray(sigs)[: len(texts)]
 
     def _signatures_device(self, texts: Sequence[str | bytes], trace_id=None):
-        """Device ``uint32[bucket_len(N), num_perm]`` combined signatures.
+        """Device ``uint32[bucket_len(N), num_perm]`` combined signatures
+        (densified for the OPH backend): :meth:`_accumulate_device` plus
+        the one densify dispatch the raw OPH accumulator defers.  The
+        resolution paths skip this and fold the densify into their fused
+        epilogue instead (:meth:`_prepare`)."""
+        running, _n_bucket, use_oph = self._accumulate_device(
+            texts, trace_id=trace_id
+        )
+        if use_oph:
+            from advanced_scrapper_tpu.obs import stages
+            from advanced_scrapper_tpu.ops.oph import densify
+
+            running = densify(running)
+            stages.count_dispatch("dedup")
+        return running
+
+    def _get_fused_step(self):
+        """The engine's single-dispatch tile step (params constant-folded;
+        built once — jit caches per static (rows, width, num_articles))."""
+        step = self._fused_step
+        if step is None:
+            from advanced_scrapper_tpu.ops.minhash import make_fused_tile_step
+
+            step = make_fused_tile_step(self.params, self.cfg.backend)
+            self._fused_step = step
+        return step
+
+    def prewarm(self, n_articles: int | None = None) -> int:
+        """Compile the packed tile-step shape set ahead of the first
+        corpus: every width bucket's full tile plus its descending
+        power-of-two tail chunks — the same O(log bs)-per-width shape set
+        ``_accumulate_device`` draws from.  Returns the number of shape
+        variants compiled.  Initialises the jax backend.
+
+        ``n_articles`` pins the article-axis bucket (default: one
+        ``batch_size`` corpus) and the pin is LOAD-BEARING: the fused
+        step is compiled per static ``num_articles = bucket_len(N)``, so
+        only corpora whose article count buckets the same skip their
+        per-shape compiles — prewarm with the corpus size you will
+        actually stream (``ASTPU_DEDUP_PREWARM=<count>``).  With
+        ``ASTPU_COMPILE_CACHE`` set the compiles persist across
+        processes and later prewarms (any bucket) are cache loads.
+        """
+        import jax.numpy as jnp
+
+        from advanced_scrapper_tpu.core.mesh import maybe_enable_compile_cache
+        from advanced_scrapper_tpu.ops.pack import packed_nbytes
+        from advanced_scrapper_tpu.ops.shingle import U32_MAX
+
+        maybe_enable_compile_cache()
+        cfg = self.cfg
+        n_bucket = bucket_len(
+            n_articles if n_articles else cfg.batch_size, min_bucket=64
+        )
+        step = self._get_fused_step()
+        compiled = 0
+        # the width set mirrors bucket_widths(..., max_bucket=block_len):
+        # powers of two BELOW block_len, plus block_len itself (the body/
+        # long-tail bucket — which need not be a power of two, and must
+        # not be skipped or prewarm misses the dominant width)
+        widths = []
+        w = 64
+        while w < cfg.block_len:
+            widths.append(w)
+            w *= 2
+        widths.append(cfg.block_len)
+        for w in widths:
+            # same derivation as the encode chunker (_tile_bs /
+            # _tile_rows_options) — shared helpers, never re-derived here
+            for rows in _tile_rows_options(_tile_bs(cfg, w)):
+                running = jnp.full(
+                    (n_bucket, self.params.num_perm), U32_MAX, jnp.uint32
+                )
+                packed = jnp.zeros((packed_nbytes(rows, w),), jnp.uint8)
+                step(
+                    running, packed, rows=rows, width=w, num_articles=n_bucket
+                ).block_until_ready()
+                compiled += 1
+        return compiled
+
+    def _accumulate_device(self, texts: Sequence[str | bytes], trace_id=None):
+        """``(running, n_bucket, use_oph)``: the device-resident combined
+        signature accumulator (RAW for the OPH backend — densify happens
+        once downstream) after streaming every tile through the pipelined
+        dispatch executor.
 
         The ragged corpus is grouped by power-of-two *width buckets* (a doc
         of 700 B rides a 1024-wide row, not a block_len-wide one) and docs
         longer than ``cfg.block_len`` split blockwise; every group folds
-        into one running per-article minimum on device.  Two properties are
-        load-bearing for throughput on an H2D-constrained link (the ragged
-        regime is transfer-bound, not compute-bound — DESIGN.md §5):
+        into one running per-article minimum on device.  Three properties
+        are load-bearing for throughput on an H2D-constrained link (the
+        ragged regime is transfer-bound, not compute-bound — DESIGN.md §5):
 
         - bucketing cuts padded bytes on realistic length mixes vs
           one-width encoding, and padding that remains is zeros (cheap for
           a compressing transport);
-        - every batch is explicitly ``jax.device_put`` (async) BEFORE its
-          kernel dispatch, and no host sync happens until the caller
-          materialises the result.  Passing host numpy straight to the jit
-          serialises each transfer with its execution through the device
-          transport (measured 25×+ slower on the tunneled chip); explicit
-          puts let transfers queue ahead of compute.
+        - each tile crosses the boundary as ONE packed ``device_put``
+          (``ops/pack.py``) and ONE fused jitted dispatch with the
+          accumulator donated (``ops.minhash.make_fused_tile_step``) —
+          down from three serialized puts + two dispatches per tile
+          (``cfg.packed_h2d=False`` restores that legacy transport, kept
+          byte-identical for parity certification);
+        - puts queue ahead of compute (async dispatch, no host sync until
+          the caller materialises a result), and the
+          encode→pack→put→dispatch stages run pipelined with a bounded
+          in-flight window (``pipeline/dispatch.py``).
 
         Rows past ``len(texts)`` are untouched ⇒ all-``U32_MAX``.
         """
         cfg, params = self.cfg, self.params
-        block_fn = resolve_signature_fn(cfg.backend)  # validates the name
         use_oph = cfg.backend == "oph"
-        if use_oph:
-            from advanced_scrapper_tpu.ops.oph import densify, oph_raw_signatures
-
-            block_fn = oph_raw_signatures  # densify AFTER the block combine
+        resolve_signature_fn(cfg.backend)  # validates the name up front
 
         import jax
         import jax.numpy as jnp
+
+        from advanced_scrapper_tpu.core.mesh import maybe_enable_compile_cache
+
+        maybe_enable_compile_cache()
 
         from advanced_scrapper_tpu.cpu.hostbatch import (
             block_counts,
@@ -309,10 +436,7 @@ class NearDupEngine:
                     tok, blk_lens, owners_local = enc
                     owners = range_owner[idx].astype(np.int32)[owners_local]
                 n_blocks = tok.shape[0]
-                # cfg.batch_size keeps its pre-bucketing meaning — the peak
-                # device bytes per dispatch stay batch_size × block_len — so
-                # the row count scales up as the width bucket narrows.
-                bs = min(max(cfg.batch_size * cfg.block_len // w, 64), 16384)
+                bs = _tile_bs(cfg, w)  # shared with prewarm's shape set
                 # Greedy power-of-two row chunks: full bs tiles, then the
                 # tail decomposes into descending power-of-two dispatches
                 # (≥64; the last one zero-pads).  A width group with 33
@@ -343,106 +467,175 @@ class NearDupEngine:
                     yield (t, l, o)
                     start += rows
 
-        # put_workers > 1 (ASTPU_DEDUP_PUT_WORKERS; 0 = transport auto —
-        # see resolve_put_workers) issues the H2D puts from a thread pool:
-        # on transports where each put is a serialized round trip (see
-        # DESIGN.md §5 stream-tuning note) concurrent puts overlap that
-        # latency.  The min-combine is order-independent, so batch order
-        # never matters; 1 keeps the original inline put→accumulate
-        # interleaving untouched.
+        # The tile plane rides the pipelined dispatch executor
+        # (pipeline/dispatch.py): a pack stage draws width-group tiles off
+        # the encode generator, a put pool (ASTPU_DEDUP_PUT_WORKERS; 0 =
+        # transport auto — resolve_put_workers) overlaps H2D round trips,
+        # and this thread drains the depth-N staged window and dispatches.
+        # The min-combine is order-independent, so out-of-order arrival
+        # from the pool never matters.
+        from advanced_scrapper_tpu.pipeline.dispatch import PipelinedDispatcher
+
         put_workers = resolve_put_workers(cfg)
-        running = jnp.full((n_bucket, params.num_perm), U32_MAX, jnp.uint32)
-        dispatched = 0
-        if put_workers > 1:
-            # encode→h2d as a stage graph: pull workers draw width-group
-            # batches off the (locked) encode generator and device_put
-            # them concurrently; the capacity-1 ``staged`` edge bounds
-            # resident tiles at put_workers (executing) + 1 (buffered)
-            # + 1 (being accumulated) — the SAME window the hand-rolled
-            # executor+deque enforced, now via the runtime's
-            # backpressure.  The min-combine is order-independent, so
-            # out-of-order staging never matters.
-            from advanced_scrapper_tpu.runtime import DONE, StageGraph
+        packed_mode = cfg.packed_h2d
+        probe = self.dispatch_probe
 
-            gen = host_batches()
-            gen_lock = threading.Lock()
+        if packed_mode:
+            from advanced_scrapper_tpu.ops.pack import pack_tile
 
-            def pull():
-                with gen_lock:
-                    return next(gen, DONE)
+            step = self._get_fused_step()
 
-            def put(batch):
+            def pack(batch):
                 t, l, o = batch
-                with stages.timed("h2d"):
-                    return jax.device_put(t), jax.device_put(l), jax.device_put(o)
+                with stages.timed("encode"):  # host memcpy: encode plane
+                    return pack_tile(t, l, o), t.shape[0], t.shape[1]
 
-            g = StageGraph("dedup.h2d")
-            staged = g.edge("staged", capacity=1)
-            g.stage(
-                "h2d", source=pull, fn=put, out_edge=staged,
-                workers=put_workers,
-            )
-            g.start()
-            try:
-                for t, l, o in staged:
-                    dispatched += 1
-                    with stages.timed("kernel"), self.step_timer.step(
-                        int(t.shape[0])
-                    ):
-                        running = accumulate_block_signatures(
-                            running, block_fn(t, l, params), o,
-                            num_articles=n_bucket,
-                        )
-                if g.error is not None:
-                    raise g.error  # the original worker exception, unwrapped
-            finally:
-                g.stop()
-                g.join(timeout=30, raise_error=False)
+            def put(item):
+                buf, rows, w = item
+                t0 = time.perf_counter()
+                with stages.timed("h2d"):
+                    dev = jax.device_put(buf)
+                stages.count_device_put(buf.nbytes, "dedup")
+                return dev, rows, w, buf.nbytes, time.perf_counter() - t0
+
+            def dispatch(running, item):
+                dev, rows, w, _nb, _pms = item
+                return step(
+                    running, dev, rows=rows, width=w, num_articles=n_bucket
+                )
         else:
-            for t, l, o in host_batches():
+            # legacy tile transport (parity certification / escape hatch):
+            # three serialized puts + two dispatches per tile, same bytes
+            block_fn = resolve_signature_fn(cfg.backend)
+            if use_oph:
+                from advanced_scrapper_tpu.ops.oph import oph_raw_signatures
+
+                block_fn = oph_raw_signatures  # densify AFTER the combine
+
+            def pack(batch):
+                t, l, o = batch
+                return t, l, o, t.nbytes + l.nbytes + o.nbytes
+
+            def put(item):
+                t, l, o, nbytes = item
+                t0 = time.perf_counter()
                 with stages.timed("h2d"):
                     t, l, o = (
                         jax.device_put(t), jax.device_put(l), jax.device_put(o)
                     )
-                dispatched += 1
-                with stages.timed("kernel"), self.step_timer.step(
-                    int(t.shape[0])
-                ):  # async dispatch; waits land here
-                    running = accumulate_block_signatures(
-                        running, block_fn(t, l, params), o, num_articles=n_bucket
+                for arr in (t, l, o):
+                    stages.count_device_put(arr.nbytes, "dedup")
+                return t, l, o, nbytes, time.perf_counter() - t0
+
+            def dispatch(running, item):
+                t, l, o, _nb, _pms = item
+                stages.count_dispatch("dedup")  # block_fn; the fold below
+                return accumulate_block_signatures(
+                    running, block_fn(t, l, params), o, num_articles=n_bucket
+                )
+
+        running = jnp.full((n_bucket, params.num_perm), U32_MAX, jnp.uint32)
+        dispatched = 0
+        pipe = PipelinedDispatcher(
+            host_batches(),
+            pack=pack,
+            put=put,
+            put_workers=put_workers,
+            window=cfg.dispatch_window,
+        )
+        try:
+            for item in pipe:
+                rows = int(item[0].shape[0]) if not packed_mode else item[1]
+                t0 = time.perf_counter()
+                with stages.timed("kernel"), self.step_timer.step(rows):
+                    # async dispatch; device waits land here
+                    running = dispatch(running, item)
+                stages.count_dispatch("dedup")
+                if probe is not None:
+                    probe(
+                        {
+                            "tile": dispatched,
+                            "rows": rows,
+                            "width": int(
+                                item[2] if packed_mode else item[0].shape[1]
+                            ),
+                            "h2d_bytes": int(item[-2]),
+                            "put_ms": round(item[-1] * 1e3, 3),
+                            "dispatch_ms": round(
+                                (time.perf_counter() - t0) * 1e3, 3
+                            ),
+                        }
                     )
+                dispatched += 1
+        finally:
+            pipe.close()
         self._m_batches.inc(dispatched)
+        self.last_tiles = dispatched
         if trace.RECORDER.active:
             trace.record(
                 "span", "dedup.dispatch", trace=tid, batches=dispatched, docs=n
             )
-        if use_oph:
-            running = densify(running)
-        return running
+        return running, n_bucket, use_oph
+
+    def _fine_salt(self) -> np.ndarray:
+        """``subband_salt(cand_subbands)`` (validated against num_perm) or
+        a zero-length array — the fused epilogues select the fine-band
+        variant by its static shape."""
+        cs = self.cfg.cand_subbands
+        if not cs:
+            return np.zeros((0,), np.uint32)
+        if self.params.num_perm % cs:
+            raise ValueError(
+                f"cand_subbands {cs} must divide num_perm "
+                f"{self.params.num_perm} (each sub-band folds "
+                "num_perm/cand_subbands signature rows)"
+            )
+        from advanced_scrapper_tpu.ops.lsh import subband_salt
+
+        return subband_salt(cs)
+
+    def _valid_device(self, raw: list, n_bucket: int):
+        """Device ``bool[n_bucket]`` shingle-eligibility mask (counted as
+        the one per-corpus put the epilogue needs beside the tiles)."""
+        import jax
+
+        from advanced_scrapper_tpu.obs import stages
+
+        n = len(raw)
+        lens = np.fromiter((len(r) for r in raw), np.int64, count=n)
+        valid = np.zeros((n_bucket,), bool)
+        valid[:n] = lens >= self.params.shingle_k
+        dev = jax.device_put(valid)
+        stages.count_device_put(valid.nbytes, "dedup")
+        return dev
 
     def _prepare(self, texts: Sequence[str | bytes]):
         """Shared front half of both resolution paths: encode → device
-        signatures → candidate keys → per-band candidates."""
-        import jax
-
+        signature accumulator → ONE fused epilogue dispatch (OPH densify +
+        coarse/fine candidate keys + per-band candidates), so a full
+        corpus is ``tiles × 1`` dispatches plus this epilogue before
+        resolution."""
         from advanced_scrapper_tpu.obs import stages, trace
+        from advanced_scrapper_tpu.ops.lsh import fused_candidate_epilogue
 
         tid = trace.new_trace_id()
         n = len(texts)
         raw = [to_bytes(t) for t in texts]  # encode once; identity on bytes
-        sigs = self._signatures_device(raw, trace_id=tid)
-        n_bucket = sigs.shape[0]
-        lens = np.fromiter((len(r) for r in raw), np.int64, count=n)
-        valid = np.zeros((n_bucket,), bool)
-        valid[:n] = lens >= self.params.shingle_k
-        valid = jax.device_put(valid)
+        running, n_bucket, use_oph = self._accumulate_device(
+            raw, trace_id=tid
+        )
+        valid = self._valid_device(raw, n_bucket)
         with stages.timed("resolve"), trace.span(
             "dedup.candidates", trace=tid, docs=n
         ):
-            keys = candidate_keys(
-                sigs, self.params.band_salt, self.cfg.cand_subbands
+            sigs, keys, rep_bands = fused_candidate_epilogue(
+                running,
+                valid,
+                np.asarray(self.params.band_salt),
+                self._fine_salt(),
+                densify_oph=use_oph,
             )
-            rep_bands = duplicate_rep_bands(keys, valid)
+            stages.count_dispatch("dedup")
         if self.rerank_hook is not None:
             # the declared RERANK_HOOK_EDGE: candidates flow through the
             # rerank tier before EITHER resolution path sees them
@@ -468,26 +661,65 @@ class NearDupEngine:
         # on the tunneled link); the only D2H is the final int32[N] reps.
         from advanced_scrapper_tpu.obs import stages, trace
 
-        _raw, sigs, keys, valid, rep_bands, n_bucket, tid = self._prepare(texts)
+        if self.rerank_hook is not None:
+            # the declared RERANK_HOOK_EDGE needs the candidate matrix at
+            # the host boundary → the two-stage split (one extra dispatch)
+            _raw, sigs, keys, valid, rep_bands, n_bucket, tid = self._prepare(
+                texts
+            )
+            self._m_docs[_regime].inc(len(texts))
+            with stages.timed("resolve"), trace.span(
+                "dedup.resolve", trace=tid, regime=_regime, docs=len(texts)
+            ):
+                if self.cfg.cand_subbands and self.cfg.fine_margin:
+                    thr = fine_edge_thresholds(
+                        rep_bands,
+                        keys,
+                        self.cfg.sim_threshold,
+                        self.cfg.fine_margin,
+                        num_coarse=self.params.num_bands,
+                    )
+                    stages.count_dispatch("dedup")
+                else:
+                    thr = self.cfg.sim_threshold
+                rep = resolve_rep_bands(
+                    rep_bands, sigs, valid, thr,
+                    jump_rounds=_jump_rounds(n_bucket),
+                )
+                stages.count_dispatch("dedup")
+                return rep
+        # no hook: the WHOLE resolution is one fused dispatch — a full
+        # corpus is tiles × 1 dispatches plus this epilogue
+        from advanced_scrapper_tpu.ops.lsh import fused_resolve_epilogue
+
+        tid = trace.new_trace_id()
+        raw = [to_bytes(t) for t in texts]
+        running, n_bucket, use_oph = self._accumulate_device(
+            raw, trace_id=tid
+        )
+        valid = self._valid_device(raw, n_bucket)
         # _regime: the one-shot API's estimator-only branch delegates here —
         # its documents must count as "oneshot", not inflate the async series
         self._m_docs[_regime].inc(len(texts))
         with stages.timed("resolve"), trace.span(
             "dedup.resolve", trace=tid, regime=_regime, docs=len(texts)
         ):
-            if self.cfg.cand_subbands and self.cfg.fine_margin:
-                thr = fine_edge_thresholds(
-                    rep_bands,
-                    keys,
-                    self.cfg.sim_threshold,
-                    self.cfg.fine_margin,
-                    num_coarse=self.params.num_bands,
-                )
-            else:
-                thr = self.cfg.sim_threshold
-            return resolve_rep_bands(
-                rep_bands, sigs, valid, thr, jump_rounds=_jump_rounds(n_bucket)
+            rep = fused_resolve_epilogue(
+                running,
+                valid,
+                np.asarray(self.params.band_salt),
+                self._fine_salt(),
+                self.cfg.sim_threshold,
+                self.cfg.fine_margin,
+                densify_oph=use_oph,
+                num_coarse=self.params.num_bands,
+                jump_rounds=_jump_rounds(n_bucket),
+                use_fine_margin=bool(
+                    self.cfg.cand_subbands and self.cfg.fine_margin
+                ),
             )
+            stages.count_dispatch("dedup")
+            return rep
 
     def dedup_reps_sharded(self, texts: Sequence[str | bytes], mesh) -> np.ndarray:
         """int32[N] representatives via the mesh-sharded FUSED step: blockwise
@@ -547,6 +779,7 @@ class NearDupEngine:
             self._sharded_steps[key] = step
         with self.step_timer.step(int(tok.shape[0])):
             rep, _hist = step(tok, lens, owners)
+        stages.count_dispatch("dedup")
         self._m_batches.inc()
         with stages.timed("resolve"), trace.span(
             "dedup.resolve", trace=tid, regime="sharded", docs=n
@@ -572,6 +805,7 @@ class NearDupEngine:
         cleared, ready for ``resolve_rep_bands_from_ok``.
         """
         from advanced_scrapper_tpu.cpu.oracle import jaccard, shingle_set
+        from advanced_scrapper_tpu.obs import stages
 
         need_dev, ok_dev = borderline_edge_mask(
             rep_bands,
@@ -582,6 +816,7 @@ class NearDupEngine:
             self.cfg.exact_verify_band,
             num_coarse=self.params.num_bands,
         )
+        stages.count_dispatch("dedup")
         from advanced_scrapper_tpu.obs.telemetry import NOOP
 
         need = np.asarray(need_dev)
@@ -654,6 +889,8 @@ class NearDupEngine:
             out = np.asarray(self.dedup_reps_async(texts, _regime="oneshot"))[:n]
             self._count_result("oneshot", n, out)
             return out
+        from advanced_scrapper_tpu.obs import stages
+
         raw, sigs, keys, valid, rep_bands, n_bucket, tid = self._prepare(texts)
         self._m_docs["oneshot"].inc(n)
         with trace.span("dedup.resolve", trace=tid, regime="oneshot", docs=n):
@@ -661,6 +898,7 @@ class NearDupEngine:
             rep = resolve_rep_bands_from_ok(
                 rep_bands, ok, valid, jump_rounds=_jump_rounds(n_bucket)
             )
+            stages.count_dispatch("dedup")
             out = np.asarray(rep)[:n]
         self._count_result("oneshot", n, out)
         return out
@@ -668,6 +906,58 @@ class NearDupEngine:
     def keep(self, texts: Sequence[str | bytes]) -> np.ndarray:
         reps = self.dedup_reps(texts)
         return reps == np.arange(len(reps))
+
+    def signatures_and_keys(
+        self,
+        texts: Sequence[str | bytes],
+        *,
+        wide: bool = False,
+        sync_sigs: bool = True,
+    ) -> tuple[np.ndarray | None, np.ndarray]:
+        """Host ``(sigs[:N], keys[:N])`` with the keys computed ON DEVICE
+        from the device-resident accumulator — one fused epilogue dispatch
+        (``ops.lsh.fused_keys_epilogue``).
+
+        ``wide=False`` returns the coarse+fine candidate keys
+        (``candidate_keys`` semantics — ``uint32[N, nb+cand_subbands]``);
+        ``wide=True`` the two-lane wide keys (``band_keys_wide`` —
+        ``uint32[N, nb, 2]``, pack on host).  Replaces the streaming
+        backends' old shape — sync host signatures, then feed them BACK
+        through ``band_keys*`` (a D2H → re-H2D bounce plus extra
+        dispatches per batch on a tunneled transport).
+
+        ``sync_sigs=False`` returns ``(None, keys)``: callers that only
+        consume keys (the bloom/persist stream indexes — neither stores
+        signatures) skip the ``uint32[bucket, num_perm]`` D2H entirely,
+        which on a tunneled link is ~8× the key volume for nothing.
+        """
+        from advanced_scrapper_tpu.obs import stages, trace
+        from advanced_scrapper_tpu.ops.lsh import fused_keys_epilogue
+
+        n = len(texts)
+        if n == 0:
+            nb = self.params.num_bands
+            shape = (0, nb, 2) if wide else (0, nb + self.cfg.cand_subbands)
+            sigs0 = np.zeros((0, self.params.num_perm), np.uint32)
+            return (sigs0 if sync_sigs else None), np.zeros(shape, np.uint32)
+        tid = trace.new_trace_id()
+        raw = [to_bytes(t) for t in texts]
+        running, _n_bucket, use_oph = self._accumulate_device(
+            raw, trace_id=tid
+        )
+        sig_dev, keys_dev = fused_keys_epilogue(
+            running,
+            np.asarray(self.params.band_salt),
+            self._fine_salt(),
+            densify_oph=use_oph,
+            wide=wide,
+        )
+        stages.count_dispatch("dedup")
+        with stages.timed("kernel"), trace.span(
+            "dedup.readback", trace=tid, docs=n
+        ):  # readback sync: the device drains here
+            sigs = np.asarray(sig_dev)[:n] if sync_sigs else None
+            return sigs, np.asarray(keys_dev)[:n]
 
     def open_stream_index(self, index_dir: str):
         """Open the durable stream index this engine's config names: a
@@ -708,7 +998,6 @@ class NearDupEngine:
         with record bookkeeping, but a raw corpus stream can consume it
         directly.
         """
-        from advanced_scrapper_tpu.ops.lsh import band_keys_wide
         from advanced_scrapper_tpu.utils.bloom import pack_keys64
 
         n = len(texts)
@@ -716,10 +1005,13 @@ class NearDupEngine:
         if n == 0:
             return out
         raw = [to_bytes(t) for t in texts]
-        sigs = self.signatures(raw)
-        keys64 = pack_keys64(
-            np.asarray(band_keys_wide(sigs, self.params.band_salt))
+        # fused epilogue: the wide keys come off the device-resident
+        # accumulator in one dispatch — signatures never bounce D2H→H2D,
+        # and are never synced at all (the index stores keys only)
+        _sigs, keys_wide = self.signatures_and_keys(
+            raw, wide=True, sync_sigs=False
         )
+        keys64 = pack_keys64(keys_wide)
         eligible = np.fromiter(
             (len(r) >= self.params.shingle_k for r in raw), bool, n
         )
@@ -762,6 +1054,12 @@ class ExactDedup:
         # longer caps item length — any size hashes exactly (the linear hash
         # splits across blocks; see ``ExactHasher.hash_docs``).
         self.max_len = max_len
+        #: which tier served the most recent :meth:`keep_indices` call:
+        #: "zero-copy" | "blob" | "grouping" — BENCH_r05's silent 0.22×
+        #: regression was the grouping fallback running where the native
+        #: tiers should have (build failure swallowed); the bench now
+        #: reports this so path selection is a measured fact
+        self.last_path: str = ""
 
     def keep_indices(self, items: Sequence[str]) -> list[int]:
         if not items:
@@ -777,10 +1075,13 @@ class ExactDedup:
             # hash-equal probe with a full memcmp, so each is byte-identical
             # to the pandas path on the inputs it accepts
             keep = keep_first_list(items)
+            self.last_path = "zero-copy"
             if keep is None:
                 keep = exact_keep_first_native(items)
+                self.last_path = "blob"
             if keep is not None:
                 return np.flatnonzero(keep).tolist()
+        self.last_path = "grouping"
         n = len(items)
         raw = [to_bytes(s) for s in items]
         block = bucket_len(max(1, min(max(len(r) for r in raw), self.max_len)))

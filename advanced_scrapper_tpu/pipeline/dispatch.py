@@ -1,0 +1,110 @@
+"""Depth-N pipelined device-dispatch executor for the dedup hot path.
+
+The tile plane of a dedup corpus is a four-stage pipeline —
+**encode** (host blob → width-group tiles), **pack** (one contiguous
+buffer per tile, ``ops/pack.py``), **put** (``jax.device_put``), and
+**dispatch** (the fused jitted accumulate step) — and throughput on a
+transfer-bound link comes from keeping all four saturated at once.
+``pipeline/dedup.py`` used to hand-roll this twice (an inline loop at
+``put_workers == 1``, a locked-generator stage graph above it); this
+module is the ONE executor, expressed on the PR 7 runtime:
+
+- the ``pack`` stage draws tiles off the encode generator
+  (``StageGraph``'s ``source_iter`` wraps it in a locked puller) and
+  packs them on a worker thread, overlapping the next tile's encode
+  with the previous tile's transfer;
+- the ``h2d`` stage (``put_workers`` threads) issues the device puts —
+  on transports where each put is a serialized round trip (DESIGN.md
+  §5) concurrent puts overlap that latency;
+- the caller's thread drains the ``staged`` edge and dispatches; the
+  edge's capacity is the **dispatch window** — how many transferred
+  tiles may wait in flight ahead of the accumulate step.  Total
+  resident tiles are bounded at ``window + put_workers + 1``
+  (buffered + transferring + accumulating) plus at most two packed
+  host buffers awaiting transfer, so backpressure — not the encode
+  rate — sets host memory.
+
+Because the dedup min-combine is order-independent, out-of-order
+arrival from the put pool never matters; a worker error closes every
+edge and re-raises at the consumer (the runtime's error fan-out).
+The executor is workload-blind: ``pack``/``put`` are caller-supplied,
+so the legacy three-array tile transport rides it exactly like the
+packed single-buffer one (parity certification keeps both alive).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator
+
+from advanced_scrapper_tpu.runtime import DONE, StageGraph
+
+
+def resolve_dispatch_window(window: int, put_workers: int) -> int:
+    """Effective staged-edge capacity: explicit ``window`` wins, else
+    ``max(2, put_workers)`` — double buffering on local backends, a
+    put-pool-deep window on serializing transports (where puts complete
+    out of order and a shallow edge would stall the pool)."""
+    if window and window > 0:
+        return window
+    return max(2, put_workers)
+
+
+class PipelinedDispatcher:
+    """Run ``tiles → pack → put`` as a stage graph and iterate the staged
+    results in the caller's thread (which owns the dispatch step — the
+    donated accumulator must only ever be touched from one thread).
+
+    Iteration yields whatever ``put`` returned, ends when the encode
+    iterator is exhausted and every staged tile was handed over, and
+    re-raises the first worker error (with the original as cause).
+    """
+
+    def __init__(
+        self,
+        tiles: Iterable,
+        *,
+        pack: Callable,
+        put: Callable,
+        put_workers: int = 1,
+        window: int = 0,
+        name: str = "dedup.h2d",
+    ):
+        window = resolve_dispatch_window(window, put_workers)
+        self._graph = StageGraph(name)
+        # the packed edge is a FIXED two-deep buffer (pack is cheap next
+        # to put+dispatch; two keeps the put pool fed across a pop) — it
+        # must NOT scale with the window, or total resident tiles would
+        # double past the documented window + put_workers + 1 bound
+        packed = self._graph.edge("packed", capacity=2)
+        self._staged = self._graph.edge("staged", capacity=window)
+        self._graph.stage(
+            "pack", source_iter=tiles, fn=pack, out_edge=packed
+        )
+        self._graph.stage(
+            "h2d",
+            in_edge=packed,
+            fn=put,
+            out_edge=self._staged,
+            workers=max(1, put_workers),
+        )
+        self._graph.start()
+
+    @property
+    def error(self) -> BaseException | None:
+        return self._graph.error
+
+    def __iter__(self) -> Iterator:
+        while True:
+            item = self._staged.pop()
+            if item is DONE:
+                if self._graph.error is not None:
+                    raise RuntimeError(
+                        "pipelined dispatch worker died mid-corpus"
+                    ) from self._graph.error
+                return
+            yield item
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop the graph (idempotent; safe mid-iteration on error paths)."""
+        self._graph.stop()
+        self._graph.join(timeout=timeout, raise_error=False)

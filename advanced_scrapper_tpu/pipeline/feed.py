@@ -221,6 +221,11 @@ class DeviceFeed:
         with stages.timed("h2d"):
             t_dev = self._put_device(tok, tok_spec)
             l_dev = self._put_device(lens, len_spec)
+        # always-on device-traffic counters (obs/stages.py): the stream
+        # regime's put count/bytes are gated numerically like the dedup
+        # tile plane's
+        stages.count_device_put(tok.nbytes, "feed")
+        stages.count_device_put(lens.nbytes, "feed")
         self.timer.add(time.perf_counter() - t0, n)
         self._m_batches.inc()
         self._m_docs.inc(n)

@@ -368,7 +368,10 @@ class Stage:
 
     Exactly one of ``source`` (a zero-arg puller returning an item,
     :data:`RETRY`, or :data:`DONE` — shared by all workers, so it must be
-    thread-safe) or ``in_edge`` feeds the stage.  ``fn`` transforms one
+    thread-safe), ``source_iter`` (any iterable; the graph wraps it in a
+    locked puller, so a plain generator feeds a multi-worker stage
+    safely — the pattern every encode-generator call site used to
+    hand-roll), or ``in_edge`` feeds the stage.  ``fn`` transforms one
     item; ``None`` results are filtered, and with ``fan_out=True`` an
     iterable result emits item-by-item.  ``worker_init``/``worker_close``
     bracket per-worker context (a transport, a device handle); when
@@ -383,6 +386,7 @@ class Stage:
     in_edge: Edge | None = None
     out_edge: Edge | None = None
     source: Callable | None = None
+    source_iter: Iterable | None = None
     workers: int = 1
     worker_init: Callable | None = None
     worker_close: Callable | None = None
@@ -464,6 +468,12 @@ class StageGraph:
         if self._started:
             raise RuntimeError("cannot add stages to a started graph")
         st = Stage(name=name, **kw)
+        if st.source_iter is not None:
+            if st.source is not None:
+                raise ValueError(
+                    f"stage '{name}' cannot have both source and source_iter"
+                )
+            st.source = _locked_iter_source(st.source_iter)
         if st.source is None and st.in_edge is None:
             raise ValueError(f"stage '{name}' needs a source or an in_edge")
         if st.source is not None and st.in_edge is not None:
@@ -626,6 +636,21 @@ class StageGraph:
             "stages": stages,
             "edges": [e.snapshot() for e in self._edges.values()],
         }
+
+
+def _locked_iter_source(items: Iterable) -> Callable:
+    """Wrap an iterable as a thread-safe stage source: workers draw items
+    under one lock (generators are not re-entrant), :data:`DONE` on
+    exhaustion.  An exception raised by the iterator propagates out of
+    the puller and fails the graph like any worker error."""
+    it = iter(items)
+    lock = threading.Lock()
+
+    def _pull():
+        with lock:
+            return next(it, DONE)
+
+    return _pull
 
 
 def _describe(item) -> str:

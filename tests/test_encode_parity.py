@@ -98,6 +98,48 @@ def test_signatures_native_vs_python_paths(monkeypatch):
     assert (reps_native == reps_py).all()
 
 
+def test_single_dispatch_backend_parity_through_banding():
+    """scan vs pallas vs the packed single-dispatch path, bit-identical
+    THROUGH BANDING: signatures, coarse+fine candidate keys (the fused
+    epilogue) and resolved representatives must agree across all three
+    routes — the ISSUE 9 backend-parity gate for the fused tile step."""
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    rng = np.random.RandomState(7)
+    # pallas runs interpret-mode on CPU: keep the corpus/block small
+    docs = []
+    for i in range(48):
+        if i >= 4 and rng.rand() < 0.3:
+            docs.append(docs[rng.randint(0, i)])
+        else:
+            docs.append(
+                rng.randint(32, 127, size=int(rng.randint(5, 2000)),
+                            dtype=np.uint8).tobytes()
+            )
+    shape = dict(block_len=1024, batch_size=64)
+    routes = {
+        "scan-packed": DedupConfig(backend="scan", packed_h2d=True, **shape),
+        "scan-legacy": DedupConfig(backend="scan", packed_h2d=False, **shape),
+        "pallas-packed": DedupConfig(
+            backend="pallas", packed_h2d=True, **shape
+        ),
+        "pallas-legacy": DedupConfig(
+            backend="pallas", packed_h2d=False, **shape
+        ),
+    }
+    outs = {}
+    for name, cfg in routes.items():
+        eng = NearDupEngine(cfg)
+        sigs, keys = eng.signatures_and_keys(docs)
+        outs[name] = (sigs, keys, eng.dedup_reps(docs))
+    ref_sigs, ref_keys, ref_reps = outs["scan-packed"]
+    for name, (sigs, keys, reps) in outs.items():
+        assert (sigs == ref_sigs).all(), name
+        assert (keys == ref_keys).all(), name
+        assert (reps == ref_reps).all(), name
+
+
 def test_fused_sharded_block_dedup_matches_engine():
     """The device-fused per-article segment-min (make_sharded_block_dedup)
     must resolve blockwise corpora exactly like the certified engine's

@@ -184,6 +184,19 @@ def test_profile_hostpath_smoke(capsys):
     assert "encode=" in out and "kernel=" in out and "articles/s warm" in out
 
 
+def test_profile_hostpath_device_view_smoke(capsys):
+    """--device renders the per-tile put/dispatch timeline plus the
+    always-on device-counter deltas for the warm corpus."""
+    import profile_hostpath as t
+
+    t.main(n_articles=64, device=True)
+    out = capsys.readouterr().out
+    assert "device view (warm corpus):" in out
+    assert "puts=" in out and "dispatches=" in out and "h2d_bytes=" in out
+    # at least one per-tile timeline row with both phases attributed
+    assert "put=" in out and "dispatch=" in out and "tile " in out
+
+
 def test_obs_top_once_smoke(capsys):
     """obs_top --once against a live StatusServer: one full frame with the
     stage table, gauges and counters rendered."""
@@ -345,6 +358,13 @@ def test_lint_imports_catches_violations(tmp_path):
         "def f():\n"
         "    import advanced_scrapper_tpu.pipeline.dedup\n"
     )
+    # the pack op / fused tile step are pure kernels: the scheduler may
+    # never leak below the pipeline layer (ops ↛ runtime)
+    (pkg / "ops").mkdir()
+    (pkg / "ops" / "bad.py").write_text(
+        "def f():\n"
+        "    from advanced_scrapper_tpu.runtime import StageGraph\n"
+    )
     (pkg / "index" / "bad.py").write_text(
         "def g():\n"
         "    from advanced_scrapper_tpu.pipeline.scraper import run_scraper\n"
@@ -379,9 +399,10 @@ def test_lint_imports_catches_violations(tmp_path):
         "from advanced_scrapper_tpu.obs import telemetry, trace\n"
     )
     problems = lint_imports.lint(str(tmp_path))
-    assert len(problems) == 9, problems
+    assert len(problems) == 10, problems
     assert any("core/ must not import obs/" in p for p in problems)
     assert any("core/ must not import pipeline/" in p for p in problems)
+    assert any("ops/ must not import runtime/" in p for p in problems)
     assert any("index/ must not import pipeline/" in p for p in problems)
     assert any("index/ must not import net/" in p for p in problems)
     assert any("net/ must not import pipeline/" in p for p in problems)

@@ -11,7 +11,7 @@ runtime.
 
 Rules (banned prefixes per source layer)::
 
-    core/, ops/, utils/  must not import  pipeline/, net/, obs/
+    core/, ops/, utils/  must not import  pipeline/, net/, obs/, runtime/
     index/               must not import  pipeline/, net/  (EXCEPT net.rpc:
                          the fleet rides the RPC transport, and ONLY the
                          transport — protocol modules like net.lease stay
@@ -42,9 +42,13 @@ PACKAGE = "advanced_scrapper_tpu"
 
 #: source layer (top-level package dir) → banned target layers
 RULES: dict[str, tuple[str, ...]] = {
-    "core": ("pipeline", "net", "obs"),
-    "ops": ("pipeline", "net", "obs"),
-    "utils": ("pipeline", "net", "obs"),
+    # leaf math layers also must not import runtime/: the dispatch
+    # EXECUTOR (pipeline/dispatch.py) rides the scheduler, but the pack
+    # op and the fused tile step it drives are pure kernels — an ops→
+    # runtime import would drag the scheduler into every kernel test
+    "core": ("pipeline", "net", "obs", "runtime"),
+    "ops": ("pipeline", "net", "obs", "runtime"),
+    "utils": ("pipeline", "net", "obs", "runtime"),
     "index": ("pipeline", "net"),
     "net": ("pipeline",),
     # the stage-graph runtime is workload-blind: pipeline/net/index ride

@@ -9,9 +9,17 @@ warm pass achieves.  CPU-safe (runs on whatever backend jax resolves; use
 and small enough for CI smoke (tests/test_tools.py), so the stage
 decomposition can't rot as the path evolves.
 
+``--device`` adds the dispatch-executor view: a per-tile timeline of the
+warm corpus (width, rows, packed H2D bytes, put and dispatch
+milliseconds — ``NearDupEngine.dispatch_probe``) plus the always-on
+device-traffic counter deltas (puts / dispatches / H2D bytes,
+``obs/stages.py``), so the 1-put/1-dispatch-per-tile contract is
+inspectable per corpus, not just asserted in tests.
+
 Usage:
     python tools/profile_hostpath.py            # 2048 articles
     python tools/profile_hostpath.py 512        # smaller corpus
+    python tools/profile_hostpath.py 512 --device   # + per-tile timeline
 """
 
 from __future__ import annotations
@@ -24,7 +32,7 @@ import numpy as np
 sys.path.insert(0, ".")
 
 
-def main(n_articles: int = 2048) -> None:
+def main(n_articles: int = 2048, device: bool = False) -> None:
     import jax
 
     import bench
@@ -38,11 +46,17 @@ def main(n_articles: int = 2048) -> None:
 
     stages.reset()
     t0 = time.perf_counter()
-    engine.dedup_reps(corpus)
+    # cold pass rides the same async path the warm pass times, so "warm"
+    # is genuinely warm (no fused-resolve compile left for pass 2)
+    np.asarray(engine.dedup_reps_async(corpus))
     t_cold = time.perf_counter() - t0
     cold = stages.snapshot_ms()
 
     corpus2 = bench._ragged_corpus(rng, n_articles)
+    tiles: list[dict] = []
+    if device:
+        engine.dispatch_probe = tiles.append
+    dc0 = stages.device_counters()
     stages.reset()
     t0 = time.perf_counter()
     rep = engine.dedup_reps_async(corpus2)
@@ -50,6 +64,7 @@ def main(n_articles: int = 2048) -> None:
         rep = np.asarray(rep)[:n_articles]
     t_warm = time.perf_counter() - t0
     warm = stages.snapshot_ms()
+    engine.dispatch_probe = None
     assert rep.shape == (n_articles,)
 
     def fmt(d: dict) -> str:
@@ -63,7 +78,30 @@ def main(n_articles: int = 2048) -> None:
         f"→ {n_articles / t_warm:.0f} articles/s warm "
         f"(stage sums overlap by design; see obs/stages.py)"
     )
+    if device:
+        dc = stages.device_counters()
+        print(
+            "device view (warm corpus): "
+            f"puts={int(dc['device_puts'] - dc0['device_puts'])} "
+            f"dispatches="
+            f"{int(dc['device_dispatches'] - dc0['device_dispatches'])} "
+            f"h2d_bytes={int(dc['h2d_bytes'] - dc0['h2d_bytes'])} "
+            f"tiles={len(tiles)} "
+            "(packed async: 1 put + 1 dispatch per tile, +1 put "
+            "[valid mask] and +1 dispatch [fused resolve epilogue] "
+            "per corpus)"
+        )
+        for t in tiles:
+            print(
+                f"  tile {t['tile']:3d}  w={t['width']:5d} "
+                f"rows={t['rows']:5d}  h2d={t['h2d_bytes']:9d}B "
+                f"put={t['put_ms']:7.2f}ms  dispatch={t['dispatch_ms']:7.2f}ms"
+            )
 
 
 if __name__ == "__main__":
-    main(*[int(a) for a in sys.argv[1:2]])
+    args = [a for a in sys.argv[1:] if a != "--device"]
+    main(
+        *[int(a) for a in args[:1]],
+        device="--device" in sys.argv[1:],
+    )
