@@ -116,6 +116,29 @@ class MatchConfig:
     out_dir_suffix: str = "_ticker_matched_articles"  # ref :129
     verify_workers: int = 0  # exact-verify process fan-out; 0 = cpu_count
     #                          (the ref's mp.Pool width, :231-238); 1 = inline
+    packed: bool = True      # screen tiles cross H2D as ONE packed buffer
+    #   (ops/pack.py, SCREEN_PLANES trailer) into ONE fused jitted screen
+    #   (+Myers-bound) dispatch (ops.match.make_screen_step), pipelined
+    #   through the dispatch executor — 1 put + 1 dispatch per tile.
+    #   ASTPU_MATCH_PACKED=0 restores the legacy per-batch screen loop
+    #   (multiple puts + screen-then-bound dispatches), kept byte-identical
+    #   for parity certification and as an escape hatch.
+    dispatch_window: int = 0  # depth-N in-flight screen-tile window in the
+    #   pipelined executor (staged-edge capacity; 0 = auto:
+    #   max(2, put_workers) — same semantics as the dedup knob)
+    put_workers: int = 0     # H2D put threads for screen tiles (0 = the
+    #   transport default, core.mesh.auto_h2d_workers — 4 on the
+    #   serializing axon tunnel, 1 on local backends)
+    screen_tile_bytes: int = 1 << 21  # byte budget per packed screen tile:
+    #   rows-per-tile ≈ budget // row width (power-of-two bucketed, like the
+    #   dedup encoder) — replaces the retired fixed screen_batch=128 tile
+    #   sizing (MIGRATION.md), so narrow news corpora screen thousands of
+    #   rows per dispatch while 64 kB rows still tile shallowly
+    prewarm: int = 0         # compile the packed screen-step shape set at
+    #   run start (pipeline.matcher.prewarm_screen): every width bucket's
+    #   full tile plus its power-of-two tail chunks, screen-only AND fused
+    #   modes.  0 = off (tests must not pay the compile set); pair with
+    #   ASTPU_COMPILE_CACHE to make the warmup survive restarts
 
 
 @dataclass(frozen=True)

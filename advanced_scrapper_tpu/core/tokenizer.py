@@ -54,6 +54,22 @@ def bucket_widths(
     return b
 
 
+def tile_rows_options(bs: int, min_rows: int) -> list[int]:
+    """Every row count a greedy power-of-two tile chunker can emit for a
+    full-tile size ``bs``: the full tile plus the descending
+    power-of-two tail chunks (≥ ``min_rows``; the last one zero-pads).
+    THE single source of the O(log bs) shape set shared by an encode
+    chunker and its prewarm (dedup tiles use ``min_rows=64``, matcher
+    screen tiles ``16``) — deriving it twice is how a chunking tune
+    silently disjoints the prewarmed set."""
+    rows_set = {bs}
+    rows = min_rows
+    while rows < bs:
+        rows_set.add(rows)
+        rows *= 2
+    return sorted(rows_set)
+
+
 def to_bytes(text: str | bytes) -> bytes:
     if isinstance(text, bytes):
         return text

@@ -39,7 +39,15 @@ _hists: dict[str, telemetry.Histogram] = {}
 _baseline: dict[str, float] = {}  # per-stage cumulative sum at last reset()
 
 #: canonical stage names (call sites may add others; these are the bench's)
-STAGES = ("encode", "h2d", "kernel", "resolve", "matcher_build")
+STAGES = (
+    "encode",
+    "h2d",
+    "kernel",
+    "resolve",
+    "matcher_build",
+    "matcher_screen",
+    "matcher_verify",
+)
 
 
 def _hist(stage: str) -> telemetry.Histogram:
@@ -87,16 +95,20 @@ def snapshot_ms() -> dict[str, float]:
 # -- device-traffic counters ---------------------------------------------
 #
 # Always-on (like the stage histograms): dispatch-count wins are gated
-# NUMERICALLY — a tier-1 test asserts the packed dedup path's per-tile
-# traffic is 1 put + 1 dispatch, and the bench emits per-regime deltas —
-# so the counters must exist whether or not telemetry is enabled.  The
-# ``regime`` label names the instrumented call-site plane ("dedup" = the
-# NearDupEngine hot path, "feed" = DeviceFeed staging); bench maps the
-# cumulative deltas onto its own regime keys.  Only EXPLICIT device
-# traffic is counted: ``jax.device_put`` calls and jitted-step dispatches
-# in the instrumented pipelines — implicit transfers (numpy passed
-# straight to a jit) are exactly the shape the packed path exists to
-# avoid, and counting them would hide that.
+# NUMERICALLY — tier-1 tests assert the packed dedup AND matcher paths'
+# per-tile traffic is 1 put + 1 dispatch, and the bench emits per-regime
+# deltas — so the counters must exist whether or not telemetry is
+# enabled.  The ``regime`` label names the instrumented call-site plane
+# ("dedup" = the NearDupEngine hot path, "feed" = DeviceFeed staging,
+# "matcher" = the entity-screen tile plane); bench maps the cumulative
+# deltas onto its own regime keys.  Only EXPLICIT device traffic is
+# counted: ``jax.device_put`` calls and jitted-step dispatches in the
+# instrumented pipelines — implicit transfers (numpy passed straight to
+# a jit) are exactly the shape the packed paths exist to avoid, and
+# counting them would hide that.  (One scoped exception: the LEGACY
+# matcher refine slices count their jit-arg transfers explicitly in
+# ``pipeline.matcher._refine_batch`` so the packed-vs-legacy matcher
+# ledger compares like for like.)
 
 _DEV_NAMES = (
     "astpu_device_puts_total",

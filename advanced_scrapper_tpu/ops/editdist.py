@@ -63,28 +63,10 @@ def build_pattern_masks(patterns: list[bytes]) -> tuple[np.ndarray, np.ndarray, 
     return masks, lens, ok
 
 
-@partial(jax.jit, static_argnames=("block",))
-def semiglobal_dist(
-    masks: jnp.ndarray,   # uint32[B, 256] per-pair pattern masks
-    plens: jnp.ndarray,   # int32[B] pattern lengths (1..32)
-    text: jnp.ndarray,    # uint8[B, L] per-pair text
-    tlens: jnp.ndarray,   # int32[B] text lengths
-    *,
-    block: int = 512,
-) -> jnp.ndarray:
-    """int32[B]: min Levenshtein distance of pattern vs a text substring.
-
-    The scan is *blocked*: the text splits into ``block``-byte tiles with a
-    ``MAX_PATTERN-1``-byte overlap, all tiles advancing in lock-step as
-    extra batch lanes — the sequential scan is ``block+31`` steps instead
-    of ``L`` (Myers' carry chain is inherently sequential per tile, so the
-    parallelism must come from the tile axis).  Every substring of length
-    ≤ ``MAX_PATTERN`` lies inside one tile, so the result equals the true
-    semi-global distance whenever the optimal substring is that short —
-    and is an upper bound on it otherwise, which preserves the
-    partial_ratio bound's soundness (rapidfuzz windows never exceed the
-    pattern length).  Empty text (or ``tlens == 0``) gives ``plens``.
-    """
+def _semiglobal_core(masks, plens, text, tlens, block: int) -> jnp.ndarray:
+    """Traceable body of :func:`semiglobal_dist` (per-pair text rows) —
+    callable from inside an enclosing jit (the fused matcher screen step
+    uses :func:`semiglobal_dist_shared`, the shared-text sibling)."""
     B, L = text.shape
     one = jnp.uint32(1)
     full = jnp.uint32(0xFFFFFFFF)
@@ -132,6 +114,103 @@ def semiglobal_dist(
     init = (jnp.full((B, nb), full), jnp.zeros((B, nb), dtype=jnp.uint32), p0, p0)
     (_, _, _, best), _ = jax.lax.scan(step, init, jnp.arange(block + O))
     return best.min(axis=1)
+
+
+@partial(jax.jit, static_argnames=("block",))
+def semiglobal_dist(
+    masks: jnp.ndarray,   # uint32[B, 256] per-pair pattern masks
+    plens: jnp.ndarray,   # int32[B] pattern lengths (1..32)
+    text: jnp.ndarray,    # uint8[B, L] per-pair text
+    tlens: jnp.ndarray,   # int32[B] text lengths
+    *,
+    block: int = 512,
+) -> jnp.ndarray:
+    """int32[B]: min Levenshtein distance of pattern vs a text substring.
+
+    The scan is *blocked*: the text splits into ``block``-byte tiles with a
+    ``MAX_PATTERN-1``-byte overlap, all tiles advancing in lock-step as
+    extra batch lanes — the sequential scan is ``block+31`` steps instead
+    of ``L`` (Myers' carry chain is inherently sequential per tile, so the
+    parallelism must come from the tile axis).  Every substring of length
+    ≤ ``MAX_PATTERN`` lies inside one tile, so the result equals the true
+    semi-global distance whenever the optimal substring is that short —
+    and is an upper bound on it otherwise, which preserves the
+    partial_ratio bound's soundness (rapidfuzz windows never exceed the
+    pattern length).  Empty text (or ``tlens == 0``) gives ``plens``.
+    """
+    return _semiglobal_core(masks, plens, text, tlens, block)
+
+
+def semiglobal_dist_shared(
+    masks,   # uint32[K, 256] pattern masks (one per pattern, not per pair)
+    plens,   # int32[K] pattern lengths (1..32)
+    text,    # uint8[B, L] text rows
+    tlens,   # int32[B] text lengths
+    *,
+    block: int = 512,
+) -> jnp.ndarray:
+    """int32[B, K]: :func:`semiglobal_dist` of EVERY pattern against
+    EVERY text row, without materialising the ``B×K`` pair texts.
+
+    The per-pair form gathers ``text[pair]`` into a ``[P, L]`` matrix —
+    fine when pairs are sparse (the legacy host-selected survivor set),
+    ruinous for the all-pairs fused screen step (``B·K·L`` bytes).  Here
+    the pattern axis rides as an extra lane dimension over the SAME
+    blocked text tiles: state is ``[K, B, nb]`` and each scan step reads
+    one byte column ``c[B, nb]`` and looks it up in every pattern's mask
+    (``masks[:, c]``), so memory is ``O(K·B·nb)`` state, never
+    ``O(B·K·L)`` text.  Same blocked-tile semantics (and the same
+    soundness argument) as :func:`semiglobal_dist`; traceable, so the
+    fused matcher step calls it inside one jit.  Equality with the
+    per-pair kernel is tested (``tests/test_match_dispatch.py``).
+    """
+    B, L = text.shape
+    K = masks.shape[0]
+    masks = jnp.asarray(masks)  # host constants must trace as device values
+    one = jnp.uint32(1)
+    full = jnp.uint32(0xFFFFFFFF)
+    O = MAX_PATTERN - 1
+    nb = max(1, -(-L // block))
+    padded = jnp.pad(text, ((0, 0), (0, nb * block + O - L)))
+    ext = jnp.stack(
+        [padded[:, s : s + block + O] for s in range(0, nb * block, block)],
+        axis=1,
+    )                                                    # [B, nb, block+O]
+    starts = (jnp.arange(nb) * block).astype(jnp.int32)
+    eff = jnp.clip(tlens[:, None] - starts[None, :], 0, block + O)  # [B, nb]
+
+    plens = jnp.maximum(plens.astype(jnp.int32), 1)
+    high = (one << (plens.astype(jnp.uint32) - one))[:, None, None]  # [K,1,1]
+    p0 = jnp.broadcast_to(plens[:, None, None], (K, B, nb)).astype(jnp.int32)
+
+    def step(carry, j):
+        pv, mv, score, best = carry                      # each [K, B, nb]
+        c = ext[:, :, j].astype(jnp.int32)               # [B, nb]
+        eq = masks[:, c]                                 # [K, B, nb]
+        xv = eq | mv
+        xh = (((eq & pv) + pv) ^ pv) | eq
+        ph = mv | ~(xh | pv)
+        mh = pv & xh
+        score2 = score + ((ph & high) != 0) - ((mh & high) != 0)
+        ph = ph << one                                   # search variant:
+        mh = mh << one                                   # row 0 free (see
+        pv2 = mh | ~(xv | ph)                            # _semiglobal_core)
+        mv2 = ph & xv
+        live = (j < eff)[None, :, :]
+        pv = jnp.where(live, pv2, pv)
+        mv = jnp.where(live, mv2, mv)
+        score = jnp.where(live, score2, score)
+        best = jnp.where(live, jnp.minimum(best, score), best)
+        return (pv, mv, score, best), None
+
+    init = (
+        jnp.full((K, B, nb), full),
+        jnp.zeros((K, B, nb), dtype=jnp.uint32),
+        p0,
+        p0,
+    )
+    (_, _, _, best), _ = jax.lax.scan(step, init, jnp.arange(block + O))
+    return best.min(axis=2).T                            # [B, K]
 
 
 def partial_ratio_bound(dist: np.ndarray, plens: np.ndarray) -> np.ndarray:

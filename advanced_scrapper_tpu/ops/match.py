@@ -90,8 +90,7 @@ def prepare_names(
     }
 
 
-@partial(jax.jit, static_argnames=("nbits", "q"))
-def _screen_impl(
+def _screen_core(
     tokens,
     text_len,
     title_len,
@@ -106,6 +105,10 @@ def _screen_impl(
     nbits: int,
     q: int,
 ):
+    """Traceable screen body — shared by the standalone
+    :func:`match_screen` dispatch and the packed single-dispatch
+    :func:`make_screen_step` (where the name tables are closure
+    constants folded into the compiled step)."""
     h, valid = shingle_hash(tokens, doc_len, q)
     idx = jnp.where(valid, (h % jnp.uint32(nbits)).astype(jnp.int32), nbits)
     B = tokens.shape[0]
@@ -137,6 +140,146 @@ def _screen_impl(
     exact_keep = (count >= kept[None, :]) & (part_max >= m)
 
     return jnp.where(fuzzy[None, :], fuzzy_keep, exact_keep)
+
+
+@partial(jax.jit, static_argnames=("nbits", "q"))
+def _screen_impl(
+    tokens,
+    text_len,
+    title_len,
+    doc_len,
+    grams,
+    kept,
+    total,
+    name_len,
+    fuzzy,
+    threshold,
+    *,
+    nbits: int,
+    q: int,
+):
+    return _screen_core(
+        tokens, text_len, title_len, doc_len, grams, kept, total, name_len,
+        fuzzy, threshold, nbits=nbits, q=q,
+    )
+
+
+#: int32 trailer planes of a packed screen tile, in order: combined
+#: ``title\ntext`` length, text length, title length, per-row flags
+#: (:data:`FLAG_REFINE_OK`), row→article owner (−1 = tail padding).
+SCREEN_PLANES = 5
+
+#: flags-plane bit: the row's text side is refine-eligible (non-empty,
+#: pure ASCII, not overlong) — the byte-level Myers bound is only sound
+#: against the char-level oracle on ASCII text, and that test is host-only.
+FLAG_REFINE_OK = 1
+
+#: survivor-mask bits returned by :func:`make_screen_step` (uint8[B, N]):
+#: bit 0 = the (article, name) pair survives the q-gram screen; bit 1 =
+#: the name's TEXT-side fuzzy score is device-proven ≤ threshold (Myers
+#: bound; only ever set on refine-candidate columns).
+MASK_SCREEN_KEEP = 1
+MASK_TEXT_PRUNED = 2
+
+
+def make_screen_step(
+    tables: dict,
+    refine: tuple | None = None,
+    *,
+    nbits: int = NBITS,
+    q: int = DEFAULT_Q,
+    refine_block: int = 512,
+):
+    """Build the SINGLE-dispatch packed screen step of the matcher path:
+    ``(packed, threshold) -> (mask uint8[rows, N], owners int32[rows])``
+    — unpack the one-buffer tile (``ops.pack``, :data:`SCREEN_PLANES`
+    trailer planes), run the q-gram screen, and (with ``refine``) fold
+    the Myers alignment bound into the SAME dispatch, all inside one
+    jitted call.
+
+    The legacy loop pays ≥2 puts and ≥2 dispatches per batch (screen
+    arrays, then the bound kernel over host-gathered survivor pairs); on
+    a tunneled transport each is a control-channel round trip.  Here the
+    survivor mask never leaves the device between the two stages: the
+    bound consumes it in-kernel and overwrites the refine-candidate
+    columns with the prune verdict (:data:`MASK_TEXT_PRUNED`), so a tile
+    is exactly 1 put + 1 dispatch — the matcher half of the PR 9
+    launch-count ledger.
+
+    ``refine = (masks uint32[K,256], plens int32[K], ok bool[K],
+    cols int64[K])`` is a prebuilt ``editdist.build_pattern_masks``
+    result plus the entry-column index of each refine candidate; the
+    bound runs ALL (row, candidate) pairs via the shared-text kernel
+    (``editdist.semiglobal_dist_shared`` — no ``B×K`` text
+    materialisation) over the combined ``title\\ntext`` row.  Scanning
+    the combined row only ever LOWERS the distance (more substrings), so
+    the bound stays an upper bound on the text-side ``partial_ratio``
+    and pruning on it stays sound; device-side gates (text strictly
+    longer than the pattern, pattern ``ok``) mirror
+    ``editdist.prune_mask_tables``, host-only gates ride the flags
+    plane.  ``refine=None`` builds the screen-only variant — the
+    refine-race controller (``pipeline.matcher.RefineController``) picks
+    between the two compiled MODES, not between separate kernels.
+
+    The name tables are closure-captured (constant-folded into the
+    compiled step) so no per-tile table transfer exists; cache the
+    returned callable per index (``pipeline.matcher`` holds one pair per
+    ``EntityIndex``).  Compiled per static ``(rows, width)`` — callers
+    keep both bucketed (O(log) shapes; ``pipeline.matcher``'s tile
+    chunker and prewarm share one derivation).
+    """
+    from advanced_scrapper_tpu.ops.pack import unpack_tile_planes
+
+    grams = np.asarray(tables["grams"])
+    kept = np.asarray(tables["kept"])
+    total = np.asarray(tables["total"])
+    name_len = np.asarray(tables["name_len"])
+    fuzzy = np.asarray(tables["fuzzy"])
+    if refine is not None and len(refine[3]) == 0:
+        refine = None
+    if refine is not None:
+        r_masks, r_lens, r_ok, r_cols = (np.asarray(a) for a in refine)
+
+    @partial(jax.jit, static_argnames=("rows", "width"))
+    def screen_step(packed, threshold, *, rows: int, width: int):
+        tok, planes = unpack_tile_planes(packed, rows, width, SCREEN_PLANES)
+        doc_len, text_len, title_len, flags, owners = planes
+        keep = _screen_core(
+            tok, text_len, title_len, doc_len, grams, kept, total,
+            name_len, fuzzy, threshold, nbits=nbits, q=q,
+        )
+        mask = keep.astype(jnp.uint8)
+        if refine is not None:
+            from advanced_scrapper_tpu.ops.editdist import (
+                semiglobal_dist_shared,
+            )
+
+            d = semiglobal_dist_shared(
+                r_masks, r_lens, tok, doc_len, block=refine_block
+            )                                            # [rows, K]
+            # bound = 100·(1 − d/(2m)) ≤ threshold, cleared of the
+            # division: 100·d ≥ 2m·(100 − threshold).  Every operand is
+            # a small-int product (d, m ≤ a few hundred), exact in f32.
+            bound_pruned = (
+                d.astype(jnp.float32) * 100.0
+                >= 2.0 * r_lens[None, :].astype(jnp.float32)
+                * (100.0 - threshold)
+            )
+            prunable = (
+                r_ok[None, :]
+                & (text_len[:, None] > r_lens[None, :])
+                & ((flags & FLAG_REFINE_OK) != 0)[:, None]
+                & bound_pruned
+            )
+            # the survivor mask is consumed and overwritten in-kernel:
+            # refine-candidate columns gain the prune bit in place
+            mask = mask.at[:, r_cols].set(
+                mask[:, r_cols]
+                | (prunable.astype(jnp.uint8) << 1)
+            )
+        return mask, owners
+
+    return screen_step
 
 
 def match_screen(

@@ -6,74 +6,92 @@ The dedup hot path used to ship every tile as THREE host arrays —
 On transports where each put is a serialized round trip (the tunneled
 dev chip; DESIGN.md §5) that is three round trips for one tile of work.
 
-:func:`pack_tile` flattens the triple into ONE contiguous ``uint8``
-buffer (tokens first, then the two int32 planes as little-endian byte
-quadruples) so the whole tile crosses the host→device boundary in one
-put; :func:`unpack_tile` re-slices it *inside* the jitted step — the
-reconstruction is a reshape plus three shift-ors per int32 plane, noise
-against the MinHash work that follows, and XLA fuses it into the kernel
-prologue.
+:func:`pack_tile_planes` flattens a ``(tokens, *int32 planes)`` tile
+into ONE contiguous ``uint8`` buffer (tokens first, then each int32
+plane as little-endian byte quadruples) so the whole tile crosses the
+host→device boundary in one put; :func:`unpack_tile_planes` re-slices
+it *inside* the jitted step — the reconstruction is a reshape plus
+three shift-ors per int32 plane, noise against the kernel work that
+follows, and XLA fuses it into the kernel prologue.  The plane count is
+workload-shaped: the dedup tile carries two planes (lengths, owners —
+:func:`pack_tile`/:func:`unpack_tile` keep that form's API), the
+matcher screen tile five (combined length, text length, title length,
+refine-eligibility flags, row→article owners).
 
-Layout (``rows``/``width`` are static per compiled step — the flat
-buffer alone is ambiguous: ``rows·(width+8)`` collides across shapes)::
+Layout (``rows``/``width``/plane count are static per compiled step —
+the flat buffer alone is ambiguous: ``rows·(width+4P)`` collides across
+shapes)::
 
-    [0, rows*width)              tokens, row-major uint8
-    [rows*width, +4*rows)        lengths, int32 little-endian bytes
-    [rows*width+4*rows, +4*rows) owners,  int32 little-endian bytes
+    [0, rows*width)                    tokens, row-major uint8
+    [rows*width + 4*rows*k, +4*rows)   plane k, int32 little-endian bytes
 
-Host-side packing is one preallocated buffer and three ``memcpy``-class
-numpy assignments — no per-row Python work.
+Host-side packing is one preallocated buffer and ``1 + P``
+``memcpy``-class numpy assignments — no per-row Python work.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-#: trailer bytes per row: lengths (4) + owners (4)
+#: trailer bytes per row of the 2-plane dedup tile: lengths (4) + owners (4)
 TRAILER_BYTES_PER_ROW = 8
 
 
-def packed_nbytes(rows: int, width: int) -> int:
-    """Size of a packed tile buffer in bytes."""
-    return rows * (width + TRAILER_BYTES_PER_ROW)
+def packed_nbytes(rows: int, width: int, n_planes: int = 2) -> int:
+    """Size of a packed tile buffer in bytes (``n_planes`` int32 planes)."""
+    return rows * (width + 4 * n_planes)
 
 
-def pack_tile(
-    tok: np.ndarray, lens: np.ndarray, owners: np.ndarray
-) -> np.ndarray:
-    """``uint8[rows*(width+8)]`` single-buffer form of a ``(tokens,
-    lengths, owners)`` tile (see module docstring for the layout)."""
+def pack_tile_planes(tok: np.ndarray, *planes: np.ndarray) -> np.ndarray:
+    """``uint8[rows*(width+4P)]`` single-buffer form of ``(tokens,
+    *int32 planes)`` (see module docstring for the layout)."""
     rows, width = tok.shape
-    buf = np.empty(packed_nbytes(rows, width), np.uint8)
+    buf = np.empty(packed_nbytes(rows, width, len(planes)), np.uint8)
     buf[: rows * width] = tok.reshape(-1)
     off = rows * width
-    buf[off : off + 4 * rows] = np.ascontiguousarray(
-        lens, dtype="<i4"
-    ).view(np.uint8)
-    buf[off + 4 * rows :] = np.ascontiguousarray(
-        owners, dtype="<i4"
-    ).view(np.uint8)
+    for plane in planes:
+        buf[off : off + 4 * rows] = np.ascontiguousarray(
+            plane, dtype="<i4"
+        ).view(np.uint8)
+        off += 4 * rows
     return buf
 
 
-def unpack_tile(packed, rows: int, width: int):
-    """Device-side inverse of :func:`pack_tile` — traceable under jit.
+def unpack_tile_planes(packed, rows: int, width: int, n_planes: int):
+    """Device-side inverse of :func:`pack_tile_planes` — traceable under
+    jit.
 
-    Returns ``(tokens uint8[rows, width], lengths int32[rows],
-    owners int32[rows])``.  The int32 planes are rebuilt from their
-    little-endian bytes arithmetically (bitcast of a trailing uint8 axis
-    is not portable across jax releases; four shift-ors are).
+    Returns ``(tokens uint8[rows, width], [plane int32[rows], …])``.
+    The int32 planes are rebuilt from their little-endian bytes
+    arithmetically (bitcast of a trailing uint8 axis is not portable
+    across jax releases; four shift-ors are).
     """
     import jax.numpy as jnp
 
     tok = packed[: rows * width].reshape(rows, width)
-    words = packed[rows * width :].astype(jnp.uint32).reshape(2, rows, 4)
+    words = packed[rows * width :].astype(jnp.uint32).reshape(n_planes, rows, 4)
     vals = (
         words[..., 0]
         | (words[..., 1] << 8)
         | (words[..., 2] << 16)
         | (words[..., 3] << 24)
     )
-    lens = vals[0].astype(jnp.int32)
-    owners = vals[1].astype(jnp.int32)
+    return tok, [vals[k].astype(jnp.int32) for k in range(n_planes)]
+
+
+def pack_tile(
+    tok: np.ndarray, lens: np.ndarray, owners: np.ndarray
+) -> np.ndarray:
+    """``uint8[rows*(width+8)]`` single-buffer form of the dedup
+    ``(tokens, lengths, owners)`` tile."""
+    return pack_tile_planes(tok, lens, owners)
+
+
+def unpack_tile(packed, rows: int, width: int):
+    """Device-side inverse of :func:`pack_tile` — traceable under jit.
+
+    Returns ``(tokens uint8[rows, width], lengths int32[rows],
+    owners int32[rows])``.
+    """
+    tok, (lens, owners) = unpack_tile_planes(packed, rows, width, 2)
     return tok, lens, owners

@@ -67,13 +67,12 @@ def _tile_bs(cfg: DedupConfig, width: int) -> int:
 def _tile_rows_options(bs: int) -> list[int]:
     """Every row count the greedy chunker can emit for a width bucket:
     the full tile plus the descending power-of-two tail chunks (≥64) —
-    the O(log bs) shape set prewarm compiles."""
-    rows_set = {bs}
-    rows = 64
-    while rows < bs:
-        rows_set.add(rows)
-        rows *= 2
-    return sorted(rows_set)
+    the O(log bs) shape set prewarm compiles
+    (``core.tokenizer.tile_rows_options``, shared with the matcher's
+    screen tile plane)."""
+    from advanced_scrapper_tpu.core.tokenizer import tile_rows_options
+
+    return tile_rows_options(bs, 64)
 
 
 def resolve_put_workers(cfg: DedupConfig) -> int:

@@ -1,13 +1,20 @@
-"""Depth-N pipelined device-dispatch executor for the dedup hot path.
+"""Depth-N pipelined device-dispatch executor for the tile hot paths.
 
-The tile plane of a dedup corpus is a four-stage pipeline —
-**encode** (host blob → width-group tiles), **pack** (one contiguous
+Every device-tile plane in the tree is the same four-stage pipeline —
+**encode** (host rows → width-group tiles), **pack** (one contiguous
 buffer per tile, ``ops/pack.py``), **put** (``jax.device_put``), and
-**dispatch** (the fused jitted accumulate step) — and throughput on a
+**dispatch** (a fused jitted step) — and throughput on a
 transfer-bound link comes from keeping all four saturated at once.
 ``pipeline/dedup.py`` used to hand-roll this twice (an inline loop at
 ``put_workers == 1``, a locked-generator stage graph above it); this
-module is the ONE executor, expressed on the PR 7 runtime:
+module is the ONE executor, expressed on the PR 7 runtime, and it is
+deliberately workload-blind: the dedup signature plane
+(``pipeline/dedup.py``, donated running accumulator) and the matcher
+screen plane (``pipeline/matcher.py``, independent per-tile masks)
+ride the same three stages, as does the legacy multi-array tile
+transport kept alive for parity certification — ``pack``/``put`` are
+caller-supplied callables, the executor knows nothing of either
+workload:
 
 - the ``pack`` stage draws tiles off the encode generator
   (``StageGraph``'s ``source_iter`` wraps it in a locked puller) and
@@ -16,20 +23,21 @@ module is the ONE executor, expressed on the PR 7 runtime:
 - the ``h2d`` stage (``put_workers`` threads) issues the device puts —
   on transports where each put is a serialized round trip (DESIGN.md
   §5) concurrent puts overlap that latency;
-- the caller's thread drains the ``staged`` edge and dispatches; the
-  edge's capacity is the **dispatch window** — how many transferred
-  tiles may wait in flight ahead of the accumulate step.  Total
-  resident tiles are bounded at ``window + put_workers + 1``
-  (buffered + transferring + accumulating) plus at most two packed
-  host buffers awaiting transfer, so backpressure — not the encode
-  rate — sets host memory.
+- the caller's thread drains the ``staged`` edge and dispatches (the
+  caller owns the dispatch because donation needs a single buffer
+  owner — the dedup accumulator — and because matcher mask results
+  must stay with the chunk's thread); the edge's capacity is the
+  **dispatch window** (``ASTPU_DEDUP_DISPATCH_WINDOW`` /
+  ``ASTPU_MATCH_DISPATCH_WINDOW``) — how many transferred tiles may
+  wait in flight ahead of the dispatch.  Total resident tiles are
+  bounded at ``window + put_workers + 1`` (buffered + transferring +
+  dispatching) plus at most two packed host buffers awaiting transfer,
+  so backpressure — not the encode rate — sets host memory.
 
-Because the dedup min-combine is order-independent, out-of-order
-arrival from the put pool never matters; a worker error closes every
-edge and re-raises at the consumer (the runtime's error fan-out).
-The executor is workload-blind: ``pack``/``put`` are caller-supplied,
-so the legacy three-array tile transport rides it exactly like the
-packed single-buffer one (parity certification keeps both alive).
+Out-of-order arrival from the put pool never matters to either rider
+(the dedup min-combine is order-independent; matcher tiles carry their
+row→article owners); a worker error closes every edge and re-raises at
+the consumer (the runtime's error fan-out).
 """
 
 from __future__ import annotations
@@ -51,8 +59,9 @@ def resolve_dispatch_window(window: int, put_workers: int) -> int:
 
 class PipelinedDispatcher:
     """Run ``tiles → pack → put`` as a stage graph and iterate the staged
-    results in the caller's thread (which owns the dispatch step — the
-    donated accumulator must only ever be touched from one thread).
+    results in the caller's thread, which owns the dispatch step (a
+    donated accumulator must only ever be touched from one thread; a
+    per-chunk mask drain must stay with its chunk).
 
     Iteration yields whatever ``put`` returned, ends when the encode
     iterator is exhausted and every staged tile was handed over, and

@@ -42,6 +42,21 @@ without a measurement run screen-only.  The r3 always-on loss
 measures that too, so tunneled transports converge to screen-only
 without a special case (``--no-refine`` still forces it).
 
+The device path rides the SAME single-dispatch plane as the dedup
+engine (PR 9 → PR 10): chunks split into byte-budget width-bucketed
+screen tiles, each crossing H2D as ONE packed buffer
+(``ops/pack.py``, 5 int32 trailer planes) into ONE fused jitted
+screen(+Myers-bound) dispatch (``ops.match.make_screen_step``), all
+pipelined encode/pack → h2d → dispatch through the dispatch executor
+(``pipeline/dispatch.py``) with a bounded in-flight window — so a tile
+is exactly 1 put + 1 dispatch, gated numerically by the always-on
+device counters (tier-1 ``tests/test_match_dispatch.py``, ``bench
+--regime matcher``).  The refine race picks fused-vs-screen-only
+MODES of that one step, not separate kernels.  ``ASTPU_MATCH_PACKED=0``
+keeps the legacy per-batch screen loop (``_legacy_screen``) runnable —
+byte-identical output, certified across screen-only / refine /
+overlong-fallback / pooled-verify modes.
+
 Documented divergences from the reference (both are reference *crashes*):
 - a fuzzy-matched name that is itself an invalid regex falls back to
   escaped-literal position search (the ref raises ``re.error`` mid-chunk);
@@ -223,6 +238,13 @@ class EntityIndex:
         self._refine_tables: tuple | None = None
         self._verify_arena = None
         self._upper_matcher: tuple | None = None
+        #: compiled packed screen steps per mode (False = screen-only,
+        #: True = fused screen+bound) — built lazily by ``_screen_steps``
+        self._packed_steps: dict = {}
+        #: optional per-tile observer ``(dict) -> None`` on the packed
+        #: dispatch loop (tile index, rows, width, h2d_bytes, put/dispatch
+        #: ms) — ``tools/profile_hostpath.py --device`` renders it
+        self.dispatch_probe = None
 
     @classmethod
     def from_info_dir(cls, folder: str) -> "EntityIndex":
@@ -575,6 +597,8 @@ def _refine_batch(
     row_ids = sorted(set(pair_row))
     pos = {r: k for k, r in enumerate(row_ids)}
     tok, ln = encode_batch([batch[r][0] for r in row_ids])
+    from advanced_scrapper_tpu.obs import stages
+
     for start in range(0, len(pair_row), max_pairs):
         rows_s = pair_row[start : start + max_pairs]
         ks = pair_k[start : start + max_pairs]
@@ -583,8 +607,17 @@ def _refine_batch(
         pad = max_pairs - len(rows_s)
         t_ix = np.array([pos[r] for r in rows_s] + [pos[rows_s[0]]] * pad)
         ks_p = np.array(ks + [ks[0]] * pad)
+        t_slice, l_slice = tok[t_ix], ln[t_ix]
+        # ledger instrumentation: the slice's jit args (gathered texts +
+        # the per-pair mask gather) ARE this path's tile traffic — count
+        # them as the two dominant puts plus the bound dispatch so the
+        # packed path's 1+1 contract is a measured subtraction against
+        # comparable legacy numbers
+        stages.count_device_put(t_slice.nbytes, "matcher")
+        stages.count_device_put(len(ks_p) * 256 * 4, "matcher")
+        stages.count_dispatch("matcher")
         pruned = prune_mask_tables(
-            mask_tables, tok[t_ix], ln[t_ix], ks_p, threshold
+            mask_tables, t_slice, l_slice, ks_p, threshold
         )
         for r, k, p in zip(rows_s, ks, pruned):
             if p:
@@ -592,6 +625,413 @@ def _refine_batch(
                     out[r] = set()
                 out[r].add(int(fuzzy_ix[k]))
     return out
+
+
+# -- packed single-dispatch screen tiles (the PR 9 plane) --------------------
+
+
+def _screen_tile_rows(tile_bytes: int, width: int) -> int:
+    """Full-tile row count for a screen width bucket: the byte budget
+    divided by the row width, power-of-two bucketed, clamped to
+    [16, 4096].  THE single source of the formula — the tile chunker and
+    :func:`prewarm_screen` must draw from the same shape set, or
+    prewarming silently compiles a disjoint set and defeats itself
+    (the dedup encoder's `_tile_bs` lesson)."""
+    bs = min(max(tile_bytes // max(width, 1), 16), 4096)
+    return 1 << (int(bs).bit_length() - 1)
+
+
+def _screen_rows_options(bs: int) -> list[int]:
+    """Every row count the greedy tile chunker can emit for a width
+    bucket: the full tile plus the descending power-of-two tail chunks
+    (≥16; the last one zero-pads) — the O(log bs) shape set prewarm
+    compiles (``core.tokenizer.tile_rows_options``, shared with the
+    dedup tile plane)."""
+    from advanced_scrapper_tpu.core.tokenizer import tile_rows_options
+
+    return tile_rows_options(bs, 16)
+
+
+def _screen_steps(index: EntityIndex, use_refine: bool):
+    """The index's compiled packed screen step for one MODE — screen-only
+    or fused screen+Myers-bound (``ops.match.make_screen_step``; the
+    refine-race controller picks between these two modes, not between
+    separate kernels).  Built lazily once per (index, mode) — the name
+    tables constant-fold into the step, so streaming chunks never re-ship
+    them (zero per-tile table traffic)."""
+    cache = getattr(index, "_packed_steps", None)
+    if cache is None:
+        cache = index._packed_steps = {}
+    key = bool(use_refine)
+    step = cache.get(key)
+    if step is None:
+        from advanced_scrapper_tpu.obs import stages
+        from advanced_scrapper_tpu.ops.match import make_screen_step
+
+        with stages.timed("matcher_build"):
+            refine = None
+            if key:
+                fuzzy_ix, _names, mask_tables = _refine_candidates(index)
+                if len(fuzzy_ix):
+                    masks, lens, ok = mask_tables
+                    refine = (masks, lens, ok, fuzzy_ix)
+                else:
+                    # no refine candidates ⇒ the fused mode IS the
+                    # screen-only step — alias it instead of compiling an
+                    # identical kernel under a second jit closure
+                    step = cache[key] = _screen_steps(index, False)
+                    return step
+            step = make_screen_step(index.screen_tables(), refine)
+        cache[key] = step
+    return step
+
+
+def _match_cfg() -> MatchConfig:
+    """Env-resolved matcher knobs (``ASTPU_MATCH_*``) for direct
+    ``match_chunk*`` callers that pass no explicit values — re-read per
+    chunk (cheap: a handful of environ lookups) so tests and sweeps can
+    flip knobs between calls."""
+    from advanced_scrapper_tpu.config import from_env
+
+    return from_env(MatchConfig, "match")
+
+
+def _packed_screen(
+    rows: list,
+    index: EntityIndex,
+    *,
+    use_refine: bool,
+    threshold: float,
+    screen_block: int,
+    tile_bytes: int,
+    window: int,
+    put_workers: int,
+) -> tuple[list, list]:
+    """Screen a chunk through the packed single-dispatch tile plane:
+    width-bucketed rows → byte-budget tiles → ONE ``device_put`` + ONE
+    fused jitted dispatch per tile, pipelined (encode/pack → h2d →
+    dispatch) through the dispatch executor with a bounded in-flight
+    window.  Returns ``(masks, text_prunes)`` in ``match_chunk_async``'s
+    shapes; rows above ``screen_block`` never enter a tile (mask None =
+    full host scan, counted in ``astpu_matcher_overlong_total``).
+
+    Out-of-order tile arrival from the put pool never matters: each
+    tile's rows carry their article owners (packed into the buffer,
+    returned by the step), and the host scatter is per-row."""
+    import jax
+
+    from advanced_scrapper_tpu.obs import stages, telemetry
+    from advanced_scrapper_tpu.ops.match import FLAG_REFINE_OK, MASK_TEXT_PRUNED
+    from advanced_scrapper_tpu.ops.pack import pack_tile_planes
+    from advanced_scrapper_tpu.core.tokenizer import bucket_widths, encode_batch
+    from advanced_scrapper_tpu.pipeline.dispatch import (
+        PipelinedDispatcher,
+        resolve_dispatch_window,
+    )
+
+    n = len(rows)
+    masks: list[np.ndarray | None] = [None] * n
+    prunes: list[set | None] = [None] * n
+    with stages.timed("matcher_screen"):
+        raw = [
+            (title + "\n" + text).encode("utf-8", "replace")
+            for text, title, _, _ in rows
+        ]
+        lens = np.fromiter(map(len, raw), np.int64, count=n)
+        title_len = np.array(
+            [len(t.encode("utf-8", "replace")) for _, t, _, _ in rows],
+            np.int32,
+        )
+        # per-char encoding ⇒ len(title\ntext) = len(title) + 1 + len(text)
+        # exactly, so the text side never pays a second full-article encode
+        text_len = (lens - title_len - 1).astype(np.int32)
+        flags = np.array(
+            [
+                FLAG_REFINE_OK if (t and t.isascii()) else 0
+                for t, _, _, _ in rows
+            ],
+            np.int32,
+        )
+        overlong = lens > screen_block
+        n_overlong = int(overlong.sum())
+        if n_overlong:
+            telemetry.event_counter(
+                "astpu_matcher_overlong_total",
+                "articles above screen_block routed to the full host scan",
+            ).inc(n_overlong)
+        eligible = np.flatnonzero(~overlong)
+    if eligible.size == 0:
+        return masks, prunes
+    widths = bucket_widths(
+        lens[eligible], min_bucket=1024, max_bucket=screen_block
+    )
+    order = np.argsort(widths, kind="stable")
+    sorted_w = widths[order]
+    group_lo = np.flatnonzero(np.r_[True, sorted_w[1:] != sorted_w[:-1]])
+    step = _screen_steps(index, use_refine)
+    probe = getattr(index, "dispatch_probe", None)
+
+    def tiles():
+        for g, lo in enumerate(group_lo):
+            hi = group_lo[g + 1] if g + 1 < len(group_lo) else len(order)
+            idx = eligible[order[lo:hi]]
+            w = int(sorted_w[lo])
+            bs = _screen_tile_rows(tile_bytes, w)  # shared with prewarm
+            start = 0
+            while start < len(idx):
+                remaining = len(idx) - start
+                nrows = bs
+                if remaining < bs:
+                    nrows = 16
+                    while nrows * 2 <= remaining:
+                        nrows *= 2
+                sel = idx[start : start + nrows]
+                with stages.timed("matcher_screen"):
+                    tok, dl = encode_batch(
+                        [raw[j] for j in sel], block_len=w
+                    )
+                    own = sel.astype(np.int32)
+                    if tok.shape[0] < nrows:
+                        pad = nrows - tok.shape[0]
+                        tok = np.concatenate(
+                            [tok, np.zeros((pad, w), np.uint8)]
+                        )
+                        dl = np.concatenate([dl, np.zeros((pad,), np.int32)])
+                        own = np.concatenate(
+                            [own, np.full((pad,), -1, np.int32)]
+                        )
+                    tl = np.zeros((nrows,), np.int32)
+                    ttl = np.zeros((nrows,), np.int32)
+                    fl = np.zeros((nrows,), np.int32)
+                    tl[: len(sel)] = text_len[sel]
+                    ttl[: len(sel)] = title_len[sel]
+                    fl[: len(sel)] = flags[sel]
+                yield tok, dl, tl, ttl, fl, own, w
+                start += nrows
+
+    def pack(item):
+        # plane order is the step's SCREEN_PLANES unpack contract
+        tok, dl, tl, ttl, fl, own, w = item
+        with stages.timed("matcher_screen"):
+            buf = pack_tile_planes(tok, dl, tl, ttl, fl, own)
+        return buf, tok.shape[0], w
+
+    def put(item):
+        buf, nrows, w = item
+        t0 = time.perf_counter()
+        with stages.timed("h2d"):
+            dev = jax.device_put(buf)
+        stages.count_device_put(buf.nbytes, "matcher")
+        return dev, nrows, w, buf.nbytes, time.perf_counter() - t0
+
+    def scatter(result) -> None:
+        mask_dev, own_dev = result
+        m = np.asarray(mask_dev)  # readback sync: waits for THIS tile only
+        own = np.asarray(own_dev)
+        keep = (m & 1).astype(bool)
+        for local in range(m.shape[0]):
+            a = int(own[local])
+            if a >= 0:
+                masks[a] = keep[local]
+        if use_refine:
+            for r, c in zip(*np.nonzero(m & MASK_TEXT_PRUNED)):
+                a = int(own[r])
+                if a < 0:
+                    continue
+                if prunes[a] is None:
+                    prunes[a] = set()
+                prunes[a].add(int(c))
+
+    # Mask readback trails the dispatch loop by a bounded LAG (the
+    # executor's own residency bound) instead of syncing per tile (the
+    # legacy loop's stall) or deferring every tile to end-of-chunk: a
+    # 20k-row chunk against a large entity index would otherwise hold
+    # O(tiles) [rows, N] device masks at once.  Syncing a tile that is
+    # `lag` dispatches behind costs ~nothing — it has almost surely
+    # completed — so the pipeline stays full with device residency
+    # capped at lag mask buffers.
+    lag = resolve_dispatch_window(window, put_workers) + put_workers + 1
+    results: list = []
+    pipe = PipelinedDispatcher(
+        tiles(),
+        pack=pack,
+        put=put,
+        put_workers=put_workers,
+        window=window,
+        name="matcher.h2d",
+    )
+    try:
+        for i, item in enumerate(pipe):
+            dev, nrows, w, nbytes, put_s = item
+            t0 = time.perf_counter()
+            with stages.timed("matcher_screen"):
+                # async dispatch; trailing tiles drain below
+                out = step(dev, threshold, rows=nrows, width=w)
+            stages.count_dispatch("matcher")
+            results.append(out)
+            if probe is not None:
+                probe(
+                    {
+                        "tile": i,
+                        "rows": nrows,
+                        "width": w,
+                        "h2d_bytes": nbytes,
+                        "put_ms": round(put_s * 1e3, 3),
+                        "dispatch_ms": round(
+                            (time.perf_counter() - t0) * 1e3, 3
+                        ),
+                    }
+                )
+            if len(results) > lag:
+                with stages.timed("matcher_screen"):
+                    scatter(results.pop(0))
+    finally:
+        pipe.close()
+    with stages.timed("matcher_screen"):
+        for result in results:
+            scatter(result)
+    return masks, prunes
+
+
+def _legacy_screen(
+    rows: list,
+    index: EntityIndex,
+    *,
+    use_refine: bool,
+    threshold: float,
+    screen_batch: int,
+    screen_block: int,
+) -> tuple[list, list]:
+    """The pre-packed screen loop (``ASTPU_MATCH_PACKED=0``): fixed
+    ``screen_batch`` batches, separate screen and Myers-bound dispatches.
+    Kept byte-identical as the parity oracle and escape hatch, and
+    INSTRUMENTED — its per-batch device traffic (4 array puts + 1 screen
+    dispatch, plus the refine slices' transfers) lands in the same
+    always-on counters as the packed path, so the launch-count drop is a
+    measured subtraction, not prose."""
+    import jax
+
+    from advanced_scrapper_tpu.core.tokenizer import bucket_len, encode_batch
+    from advanced_scrapper_tpu.obs import stages, telemetry
+    from advanced_scrapper_tpu.ops.match import match_screen
+
+    tables = index.screen_tables()
+    fuzzy_ix, fuzzy_names, mask_tables = (
+        _refine_candidates(index) if use_refine else (np.array([]), [], None)
+    )
+    masks: list[np.ndarray | None] = [None] * len(rows)
+    text_prunes: list[set | None] = [None] * len(rows)
+    n_overlong = 0
+    for start in range(0, len(rows), screen_batch):
+        batch = rows[start : start + screen_batch]
+        with stages.timed("matcher_screen"):
+            # bitmap over title+text; part lengths drive the soundness
+            # bounds
+            raw = [
+                (title + "\n" + text).encode("utf-8", "replace")
+                for text, title, _, _ in batch
+            ]
+            text_len = np.array(
+                [len(t.encode("utf-8", "replace")) for t, _, _, _ in batch],
+                np.int32,
+            )
+            title_len = np.array(
+                [len(t.encode("utf-8", "replace")) for _, t, _, _ in batch],
+                np.int32,
+            )
+            overlong = [len(r) > screen_block for r in raw]
+            n_overlong += sum(overlong)
+            # ``screen_block`` is a CAP, not the tile width: the batch
+            # encodes at the longest article's power-of-two bucket, so a
+            # 2 kB news corpus screens on 2 kB rows instead of paying the
+            # 64 kB worst case (measured 88% of matcher wall time was
+            # screening zero padding).  O(log) compiled screen shapes.
+            blk = bucket_len(
+                max(len(r) for r in raw), min_bucket=1024,
+                max_bucket=screen_block,
+            )
+            tok, ln = encode_batch(raw, block_len=blk)
+        # puts land in h2d ONLY (matching the packed path's put stage) —
+        # nesting them inside matcher_screen would double-count transfer
+        # time into the exact stage the packed-vs-legacy A/B compares
+        with stages.timed("h2d"):
+            tok_d = jax.device_put(tok)
+            tl_d = jax.device_put(text_len)
+            ttl_d = jax.device_put(title_len)
+            ln_d = jax.device_put(ln)
+        for arr in (tok, text_len, title_len, ln):
+            stages.count_device_put(arr.nbytes, "matcher")
+        with stages.timed("matcher_screen"):
+            got = match_screen(
+                tok_d, tl_d, ttl_d, ln_d, tables, threshold=threshold
+            )
+            stages.count_dispatch("matcher")
+        for i in range(len(batch)):
+            # articles longer than the screen block fall back to full scan
+            masks[start + i] = None if overlong[i] else got[i]
+        if len(fuzzy_ix):
+            prunes = _refine_batch(
+                batch, got, overlong, fuzzy_ix, fuzzy_names, mask_tables,
+                threshold,
+            )
+            for i, pr in enumerate(prunes):
+                text_prunes[start + i] = pr
+    if n_overlong:
+        telemetry.event_counter(
+            "astpu_matcher_overlong_total",
+            "articles above screen_block routed to the full host scan",
+        ).inc(n_overlong)
+    return masks, text_prunes
+
+
+def prewarm_screen(
+    index: EntityIndex,
+    *,
+    use_refine: bool | None = None,
+    threshold: float = 95.0,
+    screen_block: int = 1 << 16,
+    tile_bytes: int | None = None,
+) -> int:
+    """Compile the packed screen-step shape set ahead of the first chunk
+    (the matcher twin of ``NearDupEngine.prewarm``): every width bucket
+    from 1024 to ``screen_block`` × its O(log bs) tile row options, for
+    the screen-only mode, the fused mode, or both (``use_refine=None``
+    compiles both — the refine race will dispatch whichever wins).
+    Returns the number of shape variants compiled.  With
+    ``ASTPU_COMPILE_CACHE`` set the compiles persist across processes
+    and later prewarms are cache loads."""
+    import jax.numpy as jnp
+
+    from advanced_scrapper_tpu.core.mesh import maybe_enable_compile_cache
+    from advanced_scrapper_tpu.ops.match import SCREEN_PLANES
+    from advanced_scrapper_tpu.ops.pack import packed_nbytes
+
+    maybe_enable_compile_cache()
+    if tile_bytes is None:
+        tile_bytes = _match_cfg().screen_tile_bytes
+    widths = []
+    w = 1024
+    while w < screen_block:
+        widths.append(w)
+        w *= 2
+    widths.append(screen_block)
+    modes = (False, True) if use_refine is None else (bool(use_refine),)
+    compiled = 0
+    warmed: set[int] = set()
+    for mode in modes:
+        step = _screen_steps(index, mode)
+        if id(step) in warmed:
+            continue  # fused mode aliased to screen-only (no candidates)
+        warmed.add(id(step))
+        for w in widths:
+            for rows in _screen_rows_options(_screen_tile_rows(tile_bytes, w)):
+                packed = jnp.zeros(
+                    (packed_nbytes(rows, w, SCREEN_PLANES),), jnp.uint8
+                )
+                mask, _own = step(packed, threshold, rows=rows, width=w)
+                mask.block_until_ready()
+                compiled += 1
+    return compiled
 
 
 def match_chunk_async(
@@ -604,6 +1044,10 @@ def match_chunk_async(
     screen_block: int = 1 << 16,
     threshold: float = 95.0,
     pool=None,
+    packed: bool | None = None,
+    screen_tile_bytes: int | None = None,
+    dispatch_window: int | None = None,
+    screen_put_workers: int | None = None,
 ):
     """Screen + submit a frame NOW; return a zero-arg ``collect()`` whose
     call yields :func:`match_chunk`'s result.
@@ -661,51 +1105,47 @@ def match_chunk_async(
     masks: list[np.ndarray | None] = [None] * len(rows)
     text_prunes: list[set | None] = [None] * len(rows)
     if use_screen and index.entries:
-        from advanced_scrapper_tpu.core.tokenizer import bucket_len, encode_batch
-        from advanced_scrapper_tpu.ops.match import match_screen
+        # knob resolution: explicit args win, else the ASTPU_MATCH_* env
+        # (run_matcher passes its MatchConfig fields through explicitly)
+        if None in (
+            packed, screen_tile_bytes, dispatch_window, screen_put_workers
+        ):
+            _cfg = _match_cfg()
+            packed = _cfg.packed if packed is None else packed
+            if screen_tile_bytes is None:
+                screen_tile_bytes = _cfg.screen_tile_bytes
+            if dispatch_window is None:
+                dispatch_window = _cfg.dispatch_window
+            if screen_put_workers is None:
+                screen_put_workers = _cfg.put_workers
+        if not screen_put_workers:
+            from advanced_scrapper_tpu.core.mesh import auto_h2d_workers
 
-        tables = index.screen_tables()
-        fuzzy_ix, fuzzy_names, mask_tables = (
-            _refine_candidates(index) if use_refine else (np.array([]), [], None)
-        )
+            screen_put_workers = auto_h2d_workers()
         t_screen = time.perf_counter()
-        for start in range(0, len(rows), screen_batch):
-            batch = rows[start : start + screen_batch]
-            # bitmap over title+text; part lengths drive the soundness bounds
-            raw = [
-                (title + "\n" + text).encode("utf-8", "replace")
-                for text, title, _, _ in batch
-            ]
-            text_len = np.array(
-                [len(t.encode("utf-8", "replace")) for t, _, _, _ in batch], np.int32
+        if packed:
+            # the PR 9 plane: byte-budget width-bucketed tiles, ONE packed
+            # put + ONE fused screen(+bound) dispatch per tile, pipelined
+            # (retired screen_batch is ignored here — MIGRATION.md)
+            masks, text_prunes = _packed_screen(
+                rows,
+                index,
+                use_refine=bool(use_refine),
+                threshold=threshold,
+                screen_block=screen_block,
+                tile_bytes=screen_tile_bytes,
+                window=dispatch_window,
+                put_workers=screen_put_workers,
             )
-            title_len = np.array(
-                [len(t.encode("utf-8", "replace")) for _, t, _, _ in batch], np.int32
+        else:
+            masks, text_prunes = _legacy_screen(
+                rows,
+                index,
+                use_refine=bool(use_refine),
+                threshold=threshold,
+                screen_batch=screen_batch,
+                screen_block=screen_block,
             )
-            overlong = [len(r) > screen_block for r in raw]
-            # ``screen_block`` is a CAP, not the tile width: the batch
-            # encodes at the longest article's power-of-two bucket, so a
-            # 2 kB news corpus screens on 2 kB rows instead of paying the
-            # 64 kB worst case (measured 88% of matcher wall time was
-            # screening zero padding).  O(log) compiled screen shapes.
-            blk = bucket_len(
-                max(len(r) for r in raw), min_bucket=1024,
-                max_bucket=screen_block,
-            )
-            tok, ln = encode_batch(raw, block_len=blk)
-            got = match_screen(
-                tok, text_len, title_len, ln, tables, threshold=threshold
-            )
-            for i in range(len(batch)):
-                # articles longer than the screen block fall back to full scan
-                masks[start + i] = None if overlong[i] else got[i]
-            if len(fuzzy_ix):
-                prunes = _refine_batch(
-                    batch, got, overlong, fuzzy_ix, fuzzy_names, mask_tables,
-                    threshold,
-                )
-                for i, pr in enumerate(prunes):
-                    text_prunes[start + i] = pr
         if trace.RECORDER.active:
             trace.record(
                 "span",
@@ -731,8 +1171,12 @@ def match_chunk_async(
         ]
 
         def collect():
+            from advanced_scrapper_tpu.obs import stages
+
             out = []
-            with trace.span("matcher.verify", trace=tid, articles=len(rows)):
+            with stages.timed("matcher_verify"), trace.span(
+                "matcher.verify", trace=tid, articles=len(rows)
+            ):
                 for f in futures:  # slice order == row order
                     out.extend(
                         (ticker, m, rows[i][3]) for ticker, m, i in f.result()
@@ -744,8 +1188,12 @@ def match_chunk_async(
         return collect
 
     def collect():
+        from advanced_scrapper_tpu.obs import stages
+
         out = []
-        with trace.span("matcher.verify", trace=tid, articles=len(rows)):
+        with stages.timed("matcher_verify"), trace.span(
+            "matcher.verify", trace=tid, articles=len(rows)
+        ):
             for (text, title, adate, row), mask, pruned in zip(
                 rows, masks, text_prunes
             ):
@@ -770,6 +1218,10 @@ def match_chunk(
     screen_block: int = 1 << 16,
     threshold: float = 95.0,
     pool=None,
+    packed: bool | None = None,
+    screen_tile_bytes: int | None = None,
+    dispatch_window: int | None = None,
+    screen_put_workers: int | None = None,
 ) -> list[tuple[str, dict, dict]]:
     """Match a frame of articles → [(ticker, matches, row_record), …].
 
@@ -792,6 +1244,10 @@ def match_chunk(
         screen_block=screen_block,
         threshold=threshold,
         pool=pool,
+        packed=packed,
+        screen_tile_bytes=screen_tile_bytes,
+        dispatch_window=dispatch_window,
+        screen_put_workers=screen_put_workers,
     )()
 
 
@@ -976,6 +1432,19 @@ def run_matcher(
         raise ValueError("use_refine requires use_screen (see DESIGN.md §4)")
     if workers is None:
         workers = cfg.verify_workers
+    if cfg.prewarm and cfg.packed and use_screen and index.entries:
+        # compile the screen-step shape set before the first chunk (the
+        # NearDupEngine.prewarm twin; pointless under the legacy loop,
+        # which never dispatches the packed step).  Under "auto" both
+        # modes compile so the refine race can flip without a mid-stream
+        # compile stall; a forced mode prewarms only the mode that can
+        # ever dispatch.
+        prewarm_screen(
+            index,
+            use_refine=None if use_refine == "auto" else bool(use_refine),
+            threshold=cfg.fuzzy_threshold,
+            tile_bytes=cfg.screen_tile_bytes,
+        )
     pool = make_verify_pool(index, workers)  # 0/None normalise to cpu_count
     n_matches = 0
     # the streaming race that calibrates "auto" for THIS backend+corpus:
@@ -1031,6 +1500,10 @@ def run_matcher(
             use_refine=mode,
             threshold=cfg.fuzzy_threshold,
             pool=pool,
+            packed=cfg.packed,
+            screen_tile_bytes=cfg.screen_tile_bytes,
+            dispatch_window=cfg.dispatch_window,
+            screen_put_workers=cfg.put_workers,
         )
         return (collect, mode, time.perf_counter() - t0, len(chunk))
 

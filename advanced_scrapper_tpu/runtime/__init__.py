@@ -22,6 +22,14 @@ order does it all stop).  The runtime answers them once:
   fan-out (the index fleet's per-shard RPCs ride it), so remote hops use
   the same queue abstraction as local stages.
 
+Graphs are cheap enough to be EPHEMERAL: the pipelined dispatch executor
+(``pipeline/dispatch.py``) builds one per dedup corpus ("dedup.h2d") and
+one per matcher chunk ("matcher.h2d") — threads spawn at
+:meth:`StageGraph.start`, die at join, and the flight-recorder registry
+holds graphs weakly, so a firehose of short-lived graphs neither leaks
+nor hides (``obs_top --graph`` shows whichever are live; same-named
+successors simply take over the telemetry series, latest-wins).
+
 Layering: the runtime sits above ``obs`` only — it must never import
 ``pipeline``/``extractors``/``net``/``index`` (enforced by
 ``tools/lint_imports.py``); those layers import *it*.

@@ -29,18 +29,25 @@ Sweep knobs (env):
   ASTPU_DEDUP_DISPATCH_WINDOW=N  in-flight tile window depth (0 = auto)
   ASTPU_DEDUP_PACKED_H2D=0    legacy 3-put/2-dispatch tile transport
                               (parity escape hatch; default = packed)
+  ASTPU_MATCH_PACKED=0        legacy per-batch matcher screen loop
+                              (parity escape hatch; default = packed
+                              single-dispatch screen tiles)
+  ASTPU_MATCH_DISPATCH_WINDOW=N  matcher screen-tile window depth
+  ASTPU_MATCH_SCREEN_TILE_BYTES=N  byte budget per packed screen tile
   ASTPU_COMPILE_CACHE=dir     persistent XLA compilation cache — warmup
                               vs steady-state are reported separately
                               (ragged_warmup_articles_per_sec /
                               stream_warmup_s) so the effect is visible
 
 Per-regime device-traffic accounting (always-on counters,
-obs/stages.py): the ragged/stream JSON carries
+obs/stages.py): the ragged/stream/matcher JSON carries
 ``<regime>_device_puts`` / ``<regime>_device_dispatches`` /
-``<regime>_h2d_bytes`` deltas, and the exact regime names WHICH tier
-served (``exact_backend``; ``exact_backend_reason`` when the native
-tiers were unavailable — the silent-fallback shape behind BENCH_r05's
-0.22× exact reading).
+``<regime>_h2d_bytes`` deltas (matcher: steady-state window only, with
+``matcher_warmup_articles_per_sec`` reported apart like the ragged
+split), and the exact regime names WHICH tier served
+(``exact_backend``; ``exact_backend_reason`` when the native tiers
+were unavailable — the silent-fallback shape behind BENCH_r05's 0.22×
+exact reading).
 
 Observability (the telemetry plane rides the bench):
   --regime NAME               run one regime (uniform|ragged|stream|recall|
@@ -352,17 +359,15 @@ def _bench_exact(n_urls: int) -> tuple[float, float, float, float, str, str]:
     )
 
 
-def _bench_matcher(n_articles: int) -> float:
-    """Articles/s through the second north-star workload: device q-gram
-    screen + pooled host exact-verify over a fixed synthetic entity set
-    (the ``match_keywords.py:159-180`` reroute; previously only a one-off
-    DESIGN.md number, invisible to the driver — VERDICT r2 item 6)."""
+def _matcher_workload(n_articles: int):
+    """``(EntityIndex, articles DataFrame)`` — the matcher regime's fixed
+    synthetic workload, shared with ``tools/profile_hostpath.py --device``
+    so the per-tile timeline decomposes EXACTLY this benchmark's
+    pipeline."""
     import pandas as pd
 
     from advanced_scrapper_tpu.pipeline.matcher import (
         EntityIndex,
-        make_verify_pool,
-        match_chunk,
         process_json_data,
     )
 
@@ -403,19 +408,44 @@ def _bench_matcher(n_articles: int) -> float:
             "datetime": ["2020-01-02 10:00:00" for _ in range(n_articles)],
         }
     )
+    return index, df
+
+
+def _bench_matcher(n_articles: int) -> tuple[float, float, dict]:
+    """``(warmup_rate, steady_rate, device_counter_deltas)`` through the
+    second north-star workload: device q-gram screen + pooled host
+    exact-verify over a fixed synthetic entity set (the
+    ``match_keywords.py:159-180`` reroute).  Like the ragged dedup
+    regime, the first full chunk (which compiles the screen tile-shape
+    set — with ``ASTPU_COMPILE_CACHE`` those become cache loads) is
+    timed separately from the steady best-of-3, and the always-on device
+    counters window ONLY the steady passes — the per-tile 1-put/1-dispatch
+    contract is a reported number, not prose."""
+    from advanced_scrapper_tpu.obs import stages
+    from advanced_scrapper_tpu.pipeline.matcher import (
+        make_verify_pool,
+        match_chunk,
+    )
+
+    index, df = _matcher_workload(n_articles)
     pool = make_verify_pool(index)  # None on single-core hosts
     dt = float("inf")
     try:
-        match_chunk(df.head(64), index, pool=pool)  # warm compile
+        t0 = time.perf_counter()
+        match_chunk(df, index, pool=pool)  # warm compile, full shape set
+        warm_rate = n_articles / (time.perf_counter() - t0)
+        dc0 = stages.device_counters()
         for _ in range(3):  # best-of-N: single-shot swung 38% r3→r4
             t0 = time.perf_counter()
             out = match_chunk(df, index, pool=pool)
             dt = min(dt, time.perf_counter() - t0)
+        dc1 = stages.device_counters()
     finally:
         if pool is not None:
             pool.shutdown()
     assert len(out) >= n_articles // 8, "planted mentions must match"
-    return n_articles / dt
+    deltas = {k: int(dc1[k] - dc0[k]) for k in dc0}
+    return warm_rate, n_articles / dt, deltas
 
 
 def _bench_fleet(n_docs: int, nb: int = 17) -> dict:
@@ -889,12 +919,22 @@ def main(argv=None) -> None:
                     out["exact_backend_reason"] = exact_reason
             if "matcher" in want:
                 stages.reset()
-                matcher = _bench_matcher(256 if quick else 1024)
-                stage_ms["matcher_build"] = stages.snapshot_ms().get(
-                    "matcher_build", 0.0
+                matcher_warm, matcher, matcher_dc = _bench_matcher(
+                    256 if quick else 1024
                 )
-                note(f"matcher done: {matcher:.0f}/s")
+                m_stage = stages.snapshot_ms()
+                for k in ("matcher_build", "matcher_screen", "matcher_verify"):
+                    stage_ms[k] = m_stage.get(k, 0.0)
+                note(
+                    f"matcher done: {matcher:.0f}/s steady "
+                    f"(warmup chunk {matcher_warm:.0f}/s; "
+                    f"{matcher_dc['device_puts']} puts / "
+                    f"{matcher_dc['device_dispatches']} dispatches steady)"
+                )
                 out["matcher_articles_per_sec"] = round(matcher, 1)
+                out["matcher_warmup_articles_per_sec"] = round(matcher_warm, 1)
+                # steady-state window only, matching the rate split
+                out.update({f"matcher_{k}": v for k, v in matcher_dc.items()})
             if "index" in want:
                 idx = _bench_index(8192 if quick else 65536)
                 note(

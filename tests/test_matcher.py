@@ -427,6 +427,10 @@ def test_refine_auto_mode_semantics(monkeypatch):
     ]
     df = pd.DataFrame(rows)
 
+    # the bound's engagement is counted mode-neutrally: on the packed
+    # plane refine IS the fused screen+bound step (no separate kernel
+    # call exists), on the legacy loop it is the prune_mask_tables
+    # dispatch — both land in the same counter
     calls = {"n": 0}
     real = ED.prune_mask_tables
 
@@ -435,6 +439,15 @@ def test_refine_auto_mode_semantics(monkeypatch):
         return real(*a, **kw)
 
     monkeypatch.setattr(ED, "prune_mask_tables", counting)
+
+    real_packed = M._packed_screen
+
+    def counting_packed(rows, index, *, use_refine, **kw):
+        if use_refine:
+            calls["n"] += 1
+        return real_packed(rows, index, use_refine=use_refine, **kw)
+
+    monkeypatch.setattr(M, "_packed_screen", counting_packed)
 
     # uncalibrated auto must not dispatch the bound at all
     out_auto = M.match_chunk(df, idx)  # default is "auto"

@@ -1,9 +1,11 @@
-"""Per-stage ``stage_ms`` regression gate on cpu-fallback (ROADMAP item 3
-interim ask): run the quick ragged bench regime and fail when any stage
-exceeds its checked-in budget (``tests/stage_budgets.json``) by more than
-2× — the on-chip 50k/s reclamation work needs the HOST path pinned while
-the device tunnel is dead, and a silent 5× encode regression would
-otherwise ride along unmeasured until the next on-chip round.
+"""Per-stage ``stage_ms`` regression gates on cpu-fallback (ROADMAP item 3
+interim ask): run the quick bench regimes and fail when any stage exceeds
+its checked-in budget (``tests/stage_budgets.json``) by more than 2× — the
+on-chip 50k/s reclamation work needs the HOST paths pinned while the
+device tunnel is dead, and a silent 5× encode (or matcher-screen)
+regression would otherwise ride along unmeasured until the next on-chip
+round.  Two regimes are gated: ``ragged`` (the dedup tile plane) and
+``matcher`` (the packed screen tile plane, PR 10).
 
 The bench runs as a real subprocess (the exact CLI the driver runs), so
 the gate covers argv plumbing, the cpu-fallback path and the stage
@@ -17,8 +19,13 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BUDGET_FILE = os.path.join(os.path.dirname(__file__), "stage_budgets.json")
+
+with open(BUDGET_FILE) as _fh:
+    _SPEC = json.load(_fh)
 
 
 def _run_bench_regime(regime: str) -> dict:
@@ -41,9 +48,10 @@ def _run_bench_regime(regime: str) -> dict:
     return json.loads(line)
 
 
-def test_ragged_stage_ms_within_budget():
-    with open(BUDGET_FILE) as fh:
-        spec = json.load(fh)
+@pytest.mark.parametrize(
+    "spec", _SPEC["regimes"], ids=[r["regime"] for r in _SPEC["regimes"]]
+)
+def test_stage_ms_within_budget(spec):
     budgets = spec["budgets_ms"]
     out = _run_bench_regime(spec["regime"])
     stage_ms = out["stage_ms"]
@@ -62,5 +70,7 @@ def test_ragged_stage_ms_within_budget():
         "trade, re-baseline tests/stage_budgets.json (see its _comment)"
     )
     # the gate only makes sense if the regime actually exercised the path
-    assert stage_ms.get("kernel", 0.0) > 0.0, stage_ms
-    assert out.get("ragged_articles_per_sec", 0) > 0
+    for stage in spec["require_stages"]:
+        assert stage_ms.get(stage, 0.0) > 0.0, (stage, stage_ms)
+    for key in spec["require_keys"]:
+        assert out.get(key, 0) > 0, (key, out)

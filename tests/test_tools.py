@@ -186,7 +186,9 @@ def test_profile_hostpath_smoke(capsys):
 
 def test_profile_hostpath_device_view_smoke(capsys):
     """--device renders the per-tile put/dispatch timeline plus the
-    always-on device-counter deltas for the warm corpus."""
+    always-on device-counter deltas for the warm corpus — and the
+    matcher tile plane's timeline (the other half of the launch-count
+    ledger)."""
     import profile_hostpath as t
 
     t.main(n_articles=64, device=True)
@@ -195,6 +197,10 @@ def test_profile_hostpath_device_view_smoke(capsys):
     assert "puts=" in out and "dispatches=" in out and "h2d_bytes=" in out
     # at least one per-tile timeline row with both phases attributed
     assert "put=" in out and "dispatch=" in out and "tile " in out
+    # matcher section: counter deltas + its own per-tile rows
+    assert "matcher device view (warm chunk):" in out
+    m_tail = out.split("matcher device view")[1]
+    assert "puts=" in m_tail and "tiles=" in m_tail and "tile " in m_tail
 
 
 def test_obs_top_once_smoke(capsys):
@@ -365,6 +371,16 @@ def test_lint_imports_catches_violations(tmp_path):
         "def f():\n"
         "    from advanced_scrapper_tpu.runtime import StageGraph\n"
     )
+    # the matcher-side shape of the same inversion: the fused screen step
+    # (ops/match.py) must never reach for the executor it rides — the
+    # pipeline layer drives ops, never the reverse
+    (pkg / "ops" / "bad_match.py").write_text(
+        "def screen():\n"
+        "    from advanced_scrapper_tpu.pipeline.dispatch import (\n"
+        "        PipelinedDispatcher,\n"
+        "    )\n"
+        "    import advanced_scrapper_tpu.runtime.graph\n"
+    )
     (pkg / "index" / "bad.py").write_text(
         "def g():\n"
         "    from advanced_scrapper_tpu.pipeline.scraper import run_scraper\n"
@@ -399,10 +415,18 @@ def test_lint_imports_catches_violations(tmp_path):
         "from advanced_scrapper_tpu.obs import telemetry, trace\n"
     )
     problems = lint_imports.lint(str(tmp_path))
-    assert len(problems) == 10, problems
+    assert len(problems) == 12, problems
     assert any("core/ must not import obs/" in p for p in problems)
     assert any("core/ must not import pipeline/" in p for p in problems)
     assert any("ops/ must not import runtime/" in p for p in problems)
+    assert any(
+        "bad_match.py" in p and "ops/ must not import pipeline/" in p
+        for p in problems
+    )
+    assert any(
+        "bad_match.py" in p and "ops/ must not import runtime/" in p
+        for p in problems
+    )
     assert any("index/ must not import pipeline/" in p for p in problems)
     assert any("index/ must not import net/" in p for p in problems)
     assert any("net/ must not import pipeline/" in p for p in problems)

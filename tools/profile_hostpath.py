@@ -14,12 +14,15 @@ warm corpus (width, rows, packed H2D bytes, put and dispatch
 milliseconds — ``NearDupEngine.dispatch_probe``) plus the always-on
 device-traffic counter deltas (puts / dispatches / H2D bytes,
 ``obs/stages.py``), so the 1-put/1-dispatch-per-tile contract is
-inspectable per corpus, not just asserted in tests.
+inspectable per corpus, not just asserted in tests.  The same flag also
+runs the MATCHER tile plane (``bench._matcher_workload`` through
+``EntityIndex.dispatch_probe``) and prints its per-tile timeline and
+counter deltas — the matcher half of the launch-count ledger.
 
 Usage:
     python tools/profile_hostpath.py            # 2048 articles
     python tools/profile_hostpath.py 512        # smaller corpus
-    python tools/profile_hostpath.py 512 --device   # + per-tile timeline
+    python tools/profile_hostpath.py 512 --device   # + per-tile timelines
 """
 
 from __future__ import annotations
@@ -92,6 +95,34 @@ def main(n_articles: int = 2048, device: bool = False) -> None:
             "per corpus)"
         )
         for t in tiles:
+            print(
+                f"  tile {t['tile']:3d}  w={t['width']:5d} "
+                f"rows={t['rows']:5d}  h2d={t['h2d_bytes']:9d}B "
+                f"put={t['put_ms']:7.2f}ms  dispatch={t['dispatch_ms']:7.2f}ms"
+            )
+
+        # the matcher tile plane: same ledger, the screen workload
+        from advanced_scrapper_tpu.pipeline.matcher import match_chunk
+
+        index, df = bench._matcher_workload(max(64, n_articles // 8))
+        match_chunk(df, index)  # warm the screen-step shapes
+        m_tiles: list[dict] = []
+        index.dispatch_probe = m_tiles.append
+        dm0 = stages.device_counters()
+        match_chunk(df, index)
+        dm = stages.device_counters()
+        index.dispatch_probe = None
+        print(
+            "matcher device view (warm chunk): "
+            f"puts={int(dm['device_puts'] - dm0['device_puts'])} "
+            f"dispatches="
+            f"{int(dm['device_dispatches'] - dm0['device_dispatches'])} "
+            f"h2d_bytes={int(dm['h2d_bytes'] - dm0['h2d_bytes'])} "
+            f"tiles={len(m_tiles)} "
+            "(packed: 1 put + 1 fused screen dispatch per tile, "
+            "nothing else per chunk)"
+        )
+        for t in m_tiles:
             print(
                 f"  tile {t['tile']:3d}  w={t['width']:5d} "
                 f"rows={t['rows']:5d}  h2d={t['h2d_bytes']:9d}B "
