@@ -642,11 +642,26 @@ class ShardedIndexClient:
 
     # -- RPC fan-out internals --------------------------------------------
 
-    def _shard_probe(self, sh: _Shard, keys: np.ndarray) -> np.ndarray:
+    def _shard_probe(
+        self, sh: _Shard, keys: np.ndarray, tctx=None
+    ) -> np.ndarray:
         """Probe one shard's key subset → int64 min doc per key (-1 miss).
         Prefers the write target (it holds everything acked); falls back
         across replicas; a fully-dark shard answers from the overlay only
-        and counts the degradation."""
+        and counts the degradation.
+
+        ``tctx`` is the CALLER's trace context, captured before the
+        fan-out (pool threads have no ambient context of their own): the
+        per-shard span and every RPC under it stitch into the corpus
+        trace, and the latency histogram keeps the trace id as its
+        slow-call exemplar."""
+        from advanced_scrapper_tpu.obs import trace
+
+        with trace.trace_context(*(tctx or (None, None))):
+            with trace.span("fleet.probe", shard=sh.sid, keys=int(keys.size)):
+                return self._shard_probe_inner(sh, keys, tctx)
+
+    def _shard_probe_inner(self, sh: _Shard, keys: np.ndarray, tctx) -> np.ndarray:
         t0 = time.perf_counter()
         hist = self._m_rpc_s[(sh.sid, "probe")]
         order: list[_Node] = []
@@ -703,7 +718,7 @@ class ShardedIndexClient:
             docs = np.where(
                 hit & miss, ov, np.where(hit, np.minimum(docs, ov), docs)
             )
-        hist.observe(time.perf_counter() - t0)
+        hist.observe(time.perf_counter() - t0, trace=tctx[0] if tctx else None)
         return docs
 
     def _replicated_insert(
@@ -714,13 +729,33 @@ class ShardedIndexClient:
         rid: str,
         *,
         allow_spill: bool = True,
+        tctx=None,
     ) -> bool:
         """Write one shard's postings to EVERY live node (same request
         id).  True iff at least one node — including a freshly promoted
         one — durably applied it; on total failure the batch spills
         (unless this IS the replay path).  Nodes that missed an ACKED
         write get the batch recorded in their gap ledger: they must
-        absorb it before they may rejoin (``_try_revive``)."""
+        absorb it before they may rejoin (``_try_revive``).
+
+        ``tctx`` restores the caller's trace context on the fan-out pool
+        thread (None = inherit whatever is ambient, the direct-call and
+        replay paths)."""
+        from advanced_scrapper_tpu.obs import trace
+
+        if tctx is None:
+            tctx = trace.current_context()
+        with trace.trace_context(*(tctx or (None, None))):
+            with trace.span(
+                "fleet.insert", shard=sh.sid, postings=int(keys.size)
+            ):
+                return self._replicated_insert_inner(
+                    sh, keys, docs, rid, allow_spill, tctx
+                )
+
+    def _replicated_insert_inner(
+        self, sh, keys, docs, rid, allow_spill, tctx
+    ) -> bool:
         t0 = time.perf_counter()
         hist = self._m_rpc_s[(sh.sid, "insert")]
         target = self._ensure_write_target(sh)
@@ -754,7 +789,7 @@ class ShardedIndexClient:
                     acked_ix.add(sh.nodes.index(target))
                 except RpcUnavailable:
                     self._note_failure(sh, target)
-        hist.observe(time.perf_counter() - t0)
+        hist.observe(time.perf_counter() - t0, trace=tctx[0] if tctx else None)
         acked = bool(acked_ix)
         if acked:
             with sh.lock:
@@ -813,6 +848,9 @@ class ShardedIndexClient:
         flat = keys.ravel()
         shard_of = ring_assign(flat, len(self._shards), self.vnodes)
         best = np.full(flat.shape, _I64_MAX, np.int64)
+        from advanced_scrapper_tpu.obs import trace
+
+        tctx = trace.current_context()  # captured HERE: pool threads have none
         futures = []
         for sid in range(len(self._shards)):
             ix = np.flatnonzero(shard_of == sid)
@@ -822,7 +860,7 @@ class ShardedIndexClient:
                 (
                     ix,
                     self._pool.submit(
-                        self._shard_probe, self._shards[sid], flat[ix]
+                        self._shard_probe, self._shards[sid], flat[ix], tctx
                     ),
                 )
             )
@@ -844,6 +882,9 @@ class ShardedIndexClient:
             self._floor = max(self._floor, int(docs.max()) + 1)
             self._postings_written += int(keys.size)
         shard_of = ring_assign(keys, len(self._shards), self.vnodes)
+        from advanced_scrapper_tpu.obs import trace
+
+        tctx = trace.current_context()
         futures = []
         for sid in range(len(self._shards)):
             ix = np.flatnonzero(shard_of == sid)
@@ -853,7 +894,8 @@ class ShardedIndexClient:
             rid = f"ins-{self._token}-{self._fid}-{sid}-{self._next_wid()}"
             futures.append(
                 self._pool.submit(
-                    self._replicated_insert, sh, keys[ix], docs[ix], rid
+                    self._replicated_insert,
+                    sh, keys[ix], docs[ix], rid, tctx=tctx,
                 )
             )
         for fut in futures:

@@ -59,9 +59,17 @@ class IndexShardServer:
         max_frame: int = rpc.DEFAULT_MAX_FRAME,
         frame_deadline: float = 30.0,
         name: str = "",
+        status_port: int | None = None,
     ):
+        """``status_port`` mirrors the lease server's observability
+        sidecar: a small HTTP server beside the RPC socket serving ``GET
+        /metrics`` + ``/status`` (0 = ephemeral port, None = only when
+        telemetry is enabled) — the per-process endpoint the fleet
+        metrics collector (``obs/collector.py``) scrapes."""
         self.dir = directory
         self.name = name or os.path.basename(directory.rstrip("/")) or "shard"
+        self._status_port = status_port
+        self.status_server = None
         self._lock = threading.Lock()
         self._stopped = False
         self.indexes: dict[str, PersistentIndex] = {
@@ -100,12 +108,34 @@ class IndexShardServer:
 
     def start(self) -> "IndexShardServer":
         self.server.start()
+        from advanced_scrapper_tpu.obs import telemetry, trace
+
+        # the shard announces itself in the flight-recorder ring: a chaos
+        # dump harvested centrally must NAME the dead shard, not just its
+        # pid (obs/collector.py reads this event out of the sidecar)
+        trace.record(
+            "event", "shard.serve", shard=self.name, port=self.server.port
+        )
+        if self._status_port is not None or telemetry.enabled():
+            self.status_server = telemetry.StatusServer(
+                port=self._status_port or 0,
+                name=f"shard-{self.name}",
+                extra_status=lambda: {
+                    "shard": self.name,
+                    "spaces": {
+                        sp: idx.stats() for sp, idx in self.indexes.items()
+                    },
+                },
+            ).start()
         return self
 
     def stop(self) -> None:
         """Idempotent: tests stop a 'killed' node and sweep everything
         again in teardown."""
         self.server.stop()
+        if self.status_server is not None:
+            self.status_server.stop()
+            self.status_server = None
         with self._lock:
             if self._stopped:
                 return
@@ -322,7 +352,23 @@ def serve_main(argv=None) -> int:
     ap.add_argument("--cut-postings", type=int, default=1 << 16)
     ap.add_argument("--compact-segments", type=int, default=8)
     ap.add_argument("--name", default="")
+    ap.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="serve GET /metrics + /status beside the RPC socket "
+        "(0 = ephemeral; omit = only under ASTPU_TELEMETRY)",
+    )
+    ap.add_argument(
+        "--metrics-port-file", default=None,
+        help="write the bound metrics port here (atomic, after listen) — "
+        "how a parent wires the fleet collector to forked shards",
+    )
     args = ap.parse_args(argv)
+
+    if args.metrics_port_file is not None and args.metrics_port is None:
+        # asking where the metrics port landed IS asking for the sidecar:
+        # a parent waiting on the file must never hang because
+        # --metrics-port was omitted and telemetry happened to be off
+        args.metrics_port = 0
 
     srv = IndexShardServer(
         args.dir,
@@ -333,11 +379,18 @@ def serve_main(argv=None) -> int:
         compact_segments=args.compact_segments,
         compact_inline=True,  # forked shards: deterministic compaction,
         name=args.name,       # a chaos/SIGKILL target like everything else
+        status_port=args.metrics_port,
     ).start()
     if args.port_file:
         from advanced_scrapper_tpu.storage.fsio import atomic_replace
 
         atomic_replace(args.port_file, str(srv.port).encode())
+    if args.metrics_port_file and srv.status_server is not None:
+        from advanced_scrapper_tpu.storage.fsio import atomic_replace
+
+        atomic_replace(
+            args.metrics_port_file, str(srv.status_server.port).encode()
+        )
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_a: stop.set())

@@ -262,6 +262,7 @@ class LeaseServer:
         if self._status_port is not None or telemetry.enabled():
             self.status_server = telemetry.StatusServer(
                 port=self._status_port or 0,
+                name=f"lease-{self.port}",
                 extra_status=lambda: {"lease": self.fleet_status()},
             ).start()
         return self
@@ -382,6 +383,8 @@ class LeaseServer:
                         pass
 
     def _handle_client(self, conn: socket.socket, cid: int) -> None:
+        from advanced_scrapper_tpu.obs import trace as _trace
+
         reader = _LineReader(conn, max_line=self.cfg.max_frame_bytes)
         wlock = threading.Lock()
         try:
@@ -392,15 +395,27 @@ class LeaseServer:
                 with self._lock:
                     self._last_seen[cid] = time.monotonic()
                 kind = msg.get("type")
+                # propagated trace context (the client stamps its frames):
+                # server-side lease spans stitch into the worker's trace
+                tctx = _trace.context_from_wire(msg.pop("_trace", None))
                 if kind == "heartbeat":
                     continue  # liveness only; the stamp above is the point
                 if kind == "request_tasks":
                     self.stats.record_request()
-                    urls = self._lease(cid, int(msg.get("num_urls", 1)))
+                    with _trace.trace_context(*(tctx or (None, None))):
+                        with _trace.span("lease.lease", client=cid):
+                            urls = self._lease(
+                                cid, int(msg.get("num_urls", 1))
+                            )
                     _send_json(conn, wlock, {"type": "task_batch", "urls": urls})
                 elif kind == "result":
                     self.stats.record_response()
                     url = msg.get("url")
+                    if tctx is not None:
+                        _trace.record(
+                            "event", "lease.result",
+                            client=cid, url=msg.get("url"), trace=tctx[0],
+                        )
                     with self._lock:
                         # accept only urls this client actually holds: a
                         # duplicate or stray result (a client racing its
@@ -553,6 +568,21 @@ class LeaseClient:
         Stops when the server's queue is drained (an empty ``task_batch``)
         and all local work is done, or after ``max_seconds``.
         """
+        from advanced_scrapper_tpu.obs import trace as _trace
+
+        # one trace per client run (inheriting an ambient one if the
+        # caller opened it): every frame this worker sends is stamped, so
+        # the server's lease/result spans stitch to THIS worker
+        ctx = _trace.current_context()
+        if ctx is None and _trace.enabled():
+            ctx = (_trace.new_trace_id(), _trace.new_span_id())
+        tfrag = {"t": ctx[0], "s": ctx[1]} if ctx else None
+
+        def _stamp(obj: dict) -> dict:
+            if tfrag is not None:
+                obj["_trace"] = tfrag
+            return obj
+
         self._sock = self._connect_with_backoff()
         reader = _LineReader(self._sock, max_line=self.cfg.max_frame_bytes)
         fetched = 0
@@ -612,7 +642,9 @@ class LeaseClient:
                     _send_json(
                         self._sock,
                         self._wlock,
-                        {"type": "result", "url": url, "html_content": html},
+                        _stamp(
+                            {"type": "result", "url": url, "html_content": html}
+                        ),
                     )
                     fetched += 1
                 except (ConnectionError, OSError):
@@ -651,10 +683,12 @@ class LeaseClient:
                         _send_json(
                             self._sock,
                             self._wlock,
-                            {
-                                "type": "request_tasks",
-                                "num_urls": self.cfg.batch_size,
-                            },
+                            _stamp(
+                                {
+                                    "type": "request_tasks",
+                                    "num_urls": self.cfg.batch_size,
+                                }
+                            ),
                         )
                         last_frame = time.monotonic()
                     except (ConnectionError, OSError):

@@ -263,6 +263,26 @@ class RpcServer:
             "astpu_rpc_server_errors_total", "handler exceptions answered as errors",
             server=self.name,
         )
+        self._m_seconds: dict[str, object] = {}  # method → latency histogram
+
+    def _method_seconds(self, method: str):
+        """Per-method server-side latency histogram (lazy: the method set
+        is the handler table, but only methods actually called pay a
+        series).  Observations carry the propagated trace id as a
+        slow-call exemplar, so a p99 outlier on ``/metrics`` names the
+        stitched trace that caused it."""
+        h = self._m_seconds.get(method)
+        if h is None:
+            from advanced_scrapper_tpu.obs import telemetry
+
+            h = telemetry.histogram(
+                "astpu_rpc_server_seconds",
+                "server-side handler wall clock, by method",
+                server=self.name,
+                method=method,
+            )
+            self._m_seconds[method] = h
+        return h
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -354,6 +374,8 @@ class RpcServer:
             return "mine", None
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        from advanced_scrapper_tpu.obs import trace as _trace
+
         with self._conns_lock:
             self._conns.add(conn)
         try:
@@ -375,11 +397,22 @@ class RpcServer:
                 header, arrays = frame
                 rid = header.get("id")
                 method = header.get("method", "")
+                # propagated trace context (popped: handlers never see the
+                # transport's trace plumbing in their header dict)
+                tctx = _trace.context_from_wire(header.pop("_trace", None))
                 if rid is not None:
                     state, val = self._claim(rid)
                     if state == "hit":
                         self.replays += 1
                         self._m_replays.inc()
+                        # the retry carried the SAME trace header as the
+                        # original attempt; record the replay under it so
+                        # a stitched trace shows the dedup, not a gap
+                        _trace.record(
+                            "event", "rpc.replay",
+                            server=self.name, method=method, rid=rid,
+                            **({"trace": tctx[0]} if tctx else {}),
+                        )
                         send_frame(conn, val[0], val[1])
                         continue
                     if state == "wait":
@@ -407,8 +440,18 @@ class RpcServer:
                         "etype": "KeyError",
                     }
                 else:
+                    # server-side span under the PROPAGATED context: the
+                    # handler thread has no ambient trace of its own, so a
+                    # span here carrying the client's trace id proves the
+                    # id crossed the socket — the stitched-trace half of
+                    # the observability plane
+                    t0 = time.perf_counter()
                     try:
-                        out = self.handlers[method](header, arrays)
+                        with _trace.trace_context(*(tctx or (None, None))):
+                            with _trace.span(
+                                f"rpc.{method}", server=self.name, rid=rid
+                            ):
+                                out = self.handlers[method](header, arrays)
                         if isinstance(out, tuple):
                             resp_h, resp_a = dict(out[0]), list(out[1])
                         else:
@@ -424,6 +467,10 @@ class RpcServer:
                             "error": str(e),
                             "etype": type(e).__name__,
                         }
+                    self._method_seconds(method).observe(
+                        time.perf_counter() - t0,
+                        trace=tctx[0] if tctx else None,
+                    )
                 # remember BEFORE sending: a cut mid-response must replay
                 # the same bytes, not re-execute the handler
                 if rid is not None:
@@ -551,6 +598,14 @@ class RpcClient:
         req = dict(header or {})
         req["id"] = rid
         req["method"] = method
+        # trace propagation: the ambient context rides the request header,
+        # FIXED across retries (the header is built once) — a retried call
+        # replayed from the server cache still belongs to the same trace
+        from advanced_scrapper_tpu.obs import trace as _trace
+
+        tctx = _trace.wire_context()
+        if tctx is not None:
+            req["_trace"] = tctx
         attempts = (self.retries + 1) if idempotent else 1
         delays = backoff_delays(
             attempts - 1,

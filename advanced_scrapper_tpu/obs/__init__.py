@@ -1,12 +1,14 @@
 from advanced_scrapper_tpu.obs.stats import StatsTracker
 from advanced_scrapper_tpu.obs.console import ConsoleMux, green, red
-from advanced_scrapper_tpu.obs import telemetry, trace
+from advanced_scrapper_tpu.obs import collector, slo, telemetry, trace
 
 __all__ = [
     "StatsTracker",
     "ConsoleMux",
     "green",
     "red",
+    "collector",
+    "slo",
     "telemetry",
     "trace",
 ]

@@ -86,12 +86,16 @@ class _Noop:
     def set(self, v):
         pass
 
-    def observe(self, v):
+    def observe(self, v, trace=None):
         pass
 
     @property
     def value(self):
         return 0.0
+
+    @property
+    def exemplar(self):
+        return None
 
 
 NOOP = _Noop()
@@ -166,7 +170,10 @@ class Histogram:
     """
 
     kind = "histogram"
-    __slots__ = ("name", "labels", "help", "_lock", "_buckets", "_sum", "_count")
+    __slots__ = (
+        "name", "labels", "help", "_lock", "_buckets", "_sum", "_count",
+        "_max_bucket", "_exemplar",
+    )
 
     def __init__(self, name: str, labels: dict, help: str = ""):
         self.name = name
@@ -177,6 +184,8 @@ class Histogram:
         self._buckets = [0] * (len(BUCKET_BOUNDS) + 1)
         self._sum = 0.0
         self._count = 0
+        self._max_bucket = -1       # highest occupied bucket index so far
+        self._exemplar: dict | None = None  # slow-call exemplar (see observe)
 
     @staticmethod
     def _bucket_index(v: float) -> int:
@@ -188,12 +197,33 @@ class Histogram:
         i = e - _BUCKET_LO_EXP
         return i if i < len(BUCKET_BOUNDS) else len(BUCKET_BOUNDS)
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace: str | None = None) -> None:
+        """Record one observation.  ``trace`` attaches a *slow-call
+        exemplar*: when the observation lands in (or above) the highest
+        bucket this histogram has ever occupied — i.e. it is one of the
+        p99-tail outliers — the trace id is kept as the series' exemplar,
+        so a dashboard can jump from "p99 spiked" straight to the one
+        stitched trace that caused it.  O(1), one compare on the hot
+        path."""
         i = self._bucket_index(v)
         with self._lock:
             self._buckets[i] += 1
             self._sum += v
             self._count += 1
+            if i >= self._max_bucket:
+                self._max_bucket = i
+                if trace is not None:
+                    self._exemplar = {
+                        "trace": trace,
+                        "value": v,
+                        "ts": time.time(),
+                    }
+
+    @property
+    def exemplar(self) -> dict | None:
+        """The current slow-call exemplar (``{trace, value, ts}``) or None."""
+        with self._lock:
+            return dict(self._exemplar) if self._exemplar else None
 
     @property
     def sum(self) -> float:
@@ -424,6 +454,16 @@ class Registry:
                 lines.append(f"{m.name}_bucket{lab} {cum}")
                 lines.append(f"{m.name}_sum{_fmt_labels(m.labels)} {repr(total)}")
                 lines.append(f"{m.name}_count{_fmt_labels(m.labels)} {count}")
+                ex = m.exemplar
+                if ex is not None:
+                    # comment line (not OpenMetrics exemplar syntax): every
+                    # Prometheus text parser skips it, and the collector's
+                    # parser picks it back up to stitch fleet-wide
+                    lines.append(
+                        f"# exemplar {m.name}{_fmt_labels(m.labels)} "
+                        f'trace="{ex["trace"]}" value={repr(ex["value"])} '
+                        f"ts={repr(ex['ts'])}"
+                    )
             else:
                 lines.append(
                     f"{m.name}{_fmt_labels(m.labels)} {_fmt_value(m.value)}"
@@ -445,6 +485,9 @@ class Registry:
                 entry["count"] = count
                 entry["sum"] = total
                 entry.update(m.percentiles_ms())
+                ex = m.exemplar
+                if ex is not None:
+                    entry["exemplar"] = ex
             else:
                 entry["value"] = m.value
             out.append(entry)
@@ -616,6 +659,7 @@ class StatusServer:
         *,
         registry: Registry | None = None,
         extra_status=None,
+        name: str | None = None,
     ):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -643,14 +687,40 @@ class StatusServer:
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
+        self.name = name or f"pid{os.getpid()}"
         self._thread: threading.Thread | None = None
+        self._endpoint_file: str | None = None
 
     def start(self) -> "StatusServer":
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+        # fleet discovery: under ASTPU_OBS_DIR every exporter announces
+        # its endpoint as a one-line file the metrics collector
+        # (obs/collector.py) watches — no port registry, no race against
+        # ephemeral binds (the file appears only after listen succeeded)
+        obs_dir = os.environ.get("ASTPU_OBS_DIR")
+        if obs_dir:
+            try:
+                self.announce(obs_dir)
+            except OSError:
+                pass  # discovery is best-effort, serving is not
         return self
+
+    def announce(self, obs_dir: str, name: str | None = None) -> str:
+        """Write ``<obs_dir>/<name>.endpoint`` containing this server's
+        base url, atomically (tmp + rename) so a concurrently-scanning
+        collector never reads a half-written line.  Returns the path."""
+        name = name or self.name
+        os.makedirs(obs_dir, exist_ok=True)
+        path = os.path.join(obs_dir, f"{name}.endpoint")
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(f"http://{self.host}:{self.port}\n")
+        os.replace(tmp, path)
+        self._endpoint_file = path
+        return path
 
     def stop(self) -> None:
         self._httpd.shutdown()
@@ -658,3 +728,9 @@ class StatusServer:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._endpoint_file is not None:
+            try:
+                os.unlink(self._endpoint_file)
+            except OSError:
+                pass
+            self._endpoint_file = None

@@ -24,6 +24,7 @@ line-by-line.
 
 from __future__ import annotations
 
+import contextvars
 import itertools
 import json
 import os
@@ -42,6 +43,12 @@ __all__ = [
     "span",
     "record",
     "new_trace_id",
+    "new_span_id",
+    "current_context",
+    "current_trace_id",
+    "trace_context",
+    "wire_context",
+    "context_from_wire",
     "dump",
     "dump_on_fault",
     "add_fault_hook",
@@ -64,12 +71,79 @@ def add_fault_hook(fn) -> None:
 _TRUTHY = ("1", "true", "yes", "on")
 
 _trace_ids = itertools.count(1)
+_span_ids = itertools.count(1)
 
 
 def new_trace_id() -> str:
     """Process-unique trace id (pid-qualified so multi-process sweeps can
     interleave their sidecars without collision)."""
     return f"{os.getpid():x}-{next(_trace_ids):x}"
+
+
+def new_span_id() -> str:
+    """Process-unique span id (same pid-qualified scheme as trace ids:
+    server-side spans of a propagated trace are minted in ANOTHER
+    process, and the stitched view must never alias two of them)."""
+    return f"s{os.getpid():x}.{next(_span_ids):x}"
+
+
+# -- trace context -----------------------------------------------------------
+#
+# The ambient (trace_id, span_id) pair, carried by contextvars so it flows
+# through nested spans on one thread but NOT across threads or sockets by
+# accident — a server-side span whose trace id matches a client's proves the
+# id travelled over the wire (the RPC ``_trace`` header), not through
+# shared process state.  ``span`` inherits and extends the context; RPC
+# clients serialize it with :func:`wire_context` and servers restore it
+# with :func:`context_from_wire`.
+
+_CTX: contextvars.ContextVar = contextvars.ContextVar("astpu_trace", default=None)
+
+
+def current_context() -> tuple[str, str] | None:
+    """The ambient ``(trace_id, span_id)`` pair, or None outside a trace."""
+    return _CTX.get()
+
+
+def current_trace_id() -> str | None:
+    ctx = _CTX.get()
+    return ctx[0] if ctx else None
+
+
+@contextmanager
+def trace_context(trace_id: str | None, span_id: str | None = None):
+    """Run the body under an explicit trace context (the server-side
+    entry point: restore a propagated context, or start a fresh corpus
+    trace).  ``trace_id=None`` clears the context for the body."""
+    if trace_id is None:
+        token = _CTX.set(None)
+    else:
+        token = _CTX.set((trace_id, span_id or new_span_id()))
+    try:
+        yield
+    finally:
+        _CTX.reset(token)
+
+
+def wire_context() -> dict | None:
+    """The ambient context as a JSON-able header fragment (``None`` when
+    there is nothing to propagate) — what RPC/lease clients attach."""
+    ctx = _CTX.get()
+    if ctx is None:
+        return None
+    return {"t": ctx[0], "s": ctx[1]}
+
+
+def context_from_wire(frag) -> tuple[str, str] | None:
+    """Parse a :func:`wire_context` fragment from a request header;
+    malformed fragments (an old peer, a fuzzer) are dropped, never raised
+    — trace propagation must not be able to fail a request."""
+    if not isinstance(frag, dict):
+        return None
+    t, s = frag.get("t"), frag.get("s")
+    if not isinstance(t, str) or not t:
+        return None
+    return (t, s if isinstance(s, str) and s else new_span_id())
 
 
 class FlightRecorder:
@@ -110,6 +184,12 @@ class FlightRecorder:
         if not self.active:
             return
         ev = {"ts": time.time(), "kind": kind, "name": name}
+        if "trace" not in fields:
+            # events inherit the ambient trace id so failover/spill/replay
+            # records stitch into the corpus trace that triggered them
+            ctx = _CTX.get()
+            if ctx is not None:
+                ev["trace"] = ctx[0]
         ev.update(fields)
         with self._lock:
             self._ring.append(ev)
@@ -117,11 +197,28 @@ class FlightRecorder:
     @contextmanager
     def span(self, name: str, **fields):
         """Timed span; on any exit (including exception) the duration and
-        outcome land in the ring.  ``trace``/``batch`` fields carry IDs
-        across stages."""
+        outcome land in the ring.
+
+        Spans participate in the trace context: an explicit ``trace=``
+        field starts/continues that trace; otherwise the ambient context's
+        trace id is inherited.  Either way the body runs under a fresh
+        span id (with the previous span recorded as ``parent``), so
+        nested spans — and RPC calls, which serialize the context into
+        their request headers — chain into one stitched corpus trace.
+        """
         if not self.active:
             yield
             return
+        parent = _CTX.get()
+        tid = fields.get("trace") or (parent[0] if parent else None)
+        token = None
+        if tid is not None:
+            sid = new_span_id()
+            fields["trace"] = tid
+            fields["span"] = sid
+            if parent is not None and parent[0] == tid:
+                fields["parent"] = parent[1]
+            token = _CTX.set((tid, sid))
         t0 = time.perf_counter()
         try:
             yield
@@ -134,6 +231,9 @@ class FlightRecorder:
                 **fields,
             )
             raise
+        finally:
+            if token is not None:
+                _CTX.reset(token)
         self.record(
             "span",
             name,

@@ -126,14 +126,14 @@ class ScraperEngine:
             eid = str(ScraperEngine._seq)
             ScraperEngine._seq += 1
         telemetry.gauge_fn(
-            "astpu_scraper_success_total",
+            "astpu_scraper_fetch_success",
             lambda e: e.stats.get_cumulative_stats()[0],
             owner=self,
             help="cumulative successful fetches this run",
             engine=eid,
         )
         telemetry.gauge_fn(
-            "astpu_scraper_fail_total",
+            "astpu_scraper_fetch_fail",
             lambda e: e.stats.get_cumulative_stats()[1],
             owner=self,
             help="cumulative failed fetches this run",

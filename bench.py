@@ -61,6 +61,13 @@ Observability (the telemetry plane rides the bench):
                               numbers, by construction (obs/stages.py)
   ASTPU_TRACE_DIR=DIR         wrap the measured regimes in
                               jax.profiler.trace(DIR) (obs/profiler.xla_trace)
+
+Every run's JSON also carries ``telemetry``: the end-of-run aggregated
+series ledger (always-on device counters, stage histograms with
+percentiles, event counters — the full registry under ASTPU_TELEMETRY)
+plus the declared-SLO verdict (``obs/slo.py``: per-stage p99 ceilings,
+RPC error-ratio budget), so a BENCH_*.json is a complete record, not just
+headline rates.
 """
 
 from __future__ import annotations
@@ -734,6 +741,50 @@ def _jax_or_cpu_fallback(timeout_s: float = 240.0):
     _reexec_cpu_fallback()
 
 
+def _bench_slo_engine():
+    """The bench's declared SLO set (``obs/slo.py``), evaluated over the
+    live registry at regime start and end so the result JSON carries a
+    machine-readable verdict, not just rates: per-stage p99 ceilings
+    (generous on cpu — the ceilings are the on-chip contract the tunnel
+    rounds will tighten) and the RPC error-ratio budget the fleet regime
+    exercises."""
+    from advanced_scrapper_tpu.obs.slo import SloEngine
+
+    objectives = [
+        {
+            "name": f"stage_{s}_p99",
+            "kind": "p99_latency_max",
+            "metric": "astpu_stage_seconds",
+            "labels": {"stage": s},
+            "threshold": 1.0,  # seconds per batch, p99
+        }
+        for s in ("encode", "h2d", "kernel", "resolve")
+    ]
+    objectives.append(
+        {
+            "name": "rpc_error_ratio",
+            "kind": "ratio_max",
+            "metric": "astpu_rpc_server_errors_total",
+            "denominator": "astpu_rpc_server_calls_total",
+            "threshold": 0.01,
+        }
+    )
+    return SloEngine(objectives)
+
+
+def _telemetry_ledger(slo_engine) -> dict:
+    """End-of-run aggregated series for the result JSON: EVERY live
+    series (always-on device counters, stage histograms with
+    percentiles, event counters — plus the full registry when
+    ASTPU_TELEMETRY is on) and the final SLO verdict.  BENCH_*.json
+    carries a complete ledger, not just headline rates."""
+    from advanced_scrapper_tpu.obs import telemetry
+
+    verdict = slo_engine.evaluate() if slo_engine is not None else None
+    series = telemetry.REGISTRY.status()["metrics"]
+    return {"series": series, "slo": verdict}
+
+
 REGIMES = (
     "uniform", "ragged", "stream", "recall", "exact", "matcher", "index",
     "fleet",
@@ -811,6 +862,11 @@ def main(argv=None) -> None:
     }
     if args.regime != "all":
         out["regime"] = args.regime
+
+    # declared SLOs: baseline evaluation here, final after the regimes —
+    # rate/ratio/windowed-p99 objectives need the two points
+    slo_engine = _bench_slo_engine()
+    slo_engine.evaluate()
 
     try:
         # device enumeration + mesh build dispatch against the tunnel too —
@@ -965,6 +1021,7 @@ def main(argv=None) -> None:
         raise
 
     out["stage_ms"] = stage_ms
+    out["telemetry"] = _telemetry_ledger(slo_engine)
     if uniform is not None:
         # MFU-style utilisation is only meaningful against the v5e peak the
         # constant describes — null on cpu-fallback rounds

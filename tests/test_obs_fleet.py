@@ -1,0 +1,635 @@
+"""The fleet observability plane: trace propagation, the metrics
+collector, and the declarative SLO engine.
+
+The acceptance shape (ISSUE 11): one corpus run against a live 2×2 fleet
+produces ONE stitched trace spanning client fan-out and server-side shard
+spans; the collector serves a merged ``/metrics`` covering ≥3 distinct
+processes under per-process labels; and a declared p99-latency objective
+is observably violated-then-recovered via injected RPC delay, with the
+``astpu_slo_*`` burn-rate series moving accordingly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from advanced_scrapper_tpu.index.fleet import ShardedIndexClient
+from advanced_scrapper_tpu.index.remote import IndexShardServer
+from advanced_scrapper_tpu.net.rpc import RpcClient, RpcServer
+from advanced_scrapper_tpu.obs import collector as obs_collector
+from advanced_scrapper_tpu.obs import stages, telemetry, trace
+from advanced_scrapper_tpu.obs.collector import FleetCollector, parse_prometheus_text
+from advanced_scrapper_tpu.obs.slo import SloEngine, SloObjective, load_objectives
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def clean_telemetry():
+    telemetry.REGISTRY.reset()
+    stages._clear_for_tests()
+    telemetry.set_enabled(True)
+    trace.set_enabled(True)
+    trace.RECORDER.clear()
+    yield
+    trace.RECORDER.clear()
+    trace.RECORDER.set_dump_path(None)
+    telemetry.REGISTRY.reset()
+    stages._clear_for_tests()
+    telemetry.set_enabled(None)
+    trace.set_enabled(None)
+
+
+def _fleet(tmp_path, shards=2, replicas=2, **client_kw):
+    servers = []
+    parts = []
+    for s in range(shards):
+        nodes = []
+        for r in range(replicas):
+            srv = IndexShardServer(
+                str(tmp_path / f"s{s}n{r}"),
+                spaces=("bands",),
+                cut_postings=96,
+                compact_inline=True,
+                name=f"s{s}n{r}",
+            ).start()
+            servers.append(srv)
+            nodes.append(f"127.0.0.1:{srv.port}")
+        parts.append("|".join(nodes))
+    kw = dict(
+        space="bands",
+        spill_dir=str(tmp_path / "spill"),
+        timeout=2.0,
+        retries=1,
+        health_timeout=0.2,
+    )
+    kw.update(client_kw)
+    client = ShardedIndexClient(";".join(parts), **kw)
+    return servers, client
+
+
+def _teardown(servers, client):
+    client.close()
+    for s in servers:
+        s.stop()
+
+
+# -- trace propagation ------------------------------------------------------
+
+def test_stitched_trace_spans_client_fanout_and_shard_execution(tmp_path):
+    """THE acceptance trace: one corpus batch against a live 2×2 fleet →
+    client-side fan-out spans AND server-side shard-execution spans all
+    carry the SAME trace id.  The server handler threads have no ambient
+    context (contextvars do not cross threads), so a matching trace id on
+    an ``rpc.*`` span can only have travelled inside the request header —
+    the wire propagation this PR exists for."""
+    servers, client = _fleet(tmp_path)
+    try:
+        tid = trace.new_trace_id()
+        rng = np.random.default_rng(3)
+        keys = rng.integers(0, 500, size=(32, 8)).astype(np.uint64)
+        with trace.trace_context(tid):
+            ids = client.allocate_doc_ids(32)
+            client.check_and_add_batch(keys, ids)
+            client.probe_batch(keys)
+        events = trace.RECORDER.snapshot()
+        fanout = [
+            e for e in events
+            if e.get("name") in ("fleet.probe", "fleet.insert")
+        ]
+        shard_side = [
+            e for e in events
+            if str(e.get("name", "")).startswith("rpc.")
+            and e.get("kind") == "span"
+        ]
+        assert fanout and shard_side
+        assert {e.get("trace") for e in fanout} == {tid}
+        assert {e.get("trace") for e in shard_side} == {tid}
+        # fan-out covered BOTH shards, and shard spans cover probe+insert
+        assert {e.get("shard") for e in fanout} == {0, 1}
+        assert {e["name"] for e in shard_side} >= {"rpc.probe", "rpc.insert"}
+        # span ids are all distinct (a stitched trace, not one smeared span)
+        span_ids = [e.get("span") for e in fanout + shard_side]
+        assert len(span_ids) == len(set(span_ids))
+        # slow-call exemplars: the fleet latency histograms kept the trace
+        exes = [
+            h.exemplar
+            for h in telemetry.REGISTRY.find("astpu_fleet_rpc_seconds")
+            if h.exemplar is not None
+        ]
+        assert exes and all(e["trace"] == tid for e in exes)
+    finally:
+        _teardown(servers, client)
+
+
+def test_trace_id_survives_rpc_retry_with_replay():
+    """Cut the connection after the request is delivered but before the
+    response is read: the client retries under the SAME request id AND
+    the same trace header; the server executes once, replays once, and
+    both the single execution span and the replay event carry the
+    original trace id."""
+    calls = {"n": 0}
+
+    def echo(header, arrays):
+        calls["n"] += 1
+        return {"echo": header.get("x")}
+
+    srv = RpcServer({"echo": echo}, name="replay-t").start()
+    real_connect = socket.create_connection
+    cut_once = {"done": False}
+
+    class CutAfterSend:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def sendall(self, data):
+            self._inner.sendall(data)
+            if not cut_once["done"]:
+                cut_once["done"] = True
+                self._inner.close()  # response can never arrive
+                raise ConnectionResetError("injected post-send cut")
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    cli = RpcClient(
+        ("127.0.0.1", srv.port),
+        timeout=5.0,
+        retries=3,
+        backoff_base=0.001,
+        connect=lambda addr: CutAfterSend(real_connect(addr, timeout=5)),
+    )
+    try:
+        tid = trace.new_trace_id()
+        with trace.trace_context(tid):
+            h, _ = cli.call("echo", {"x": 7})
+        assert h["echo"] == 7
+        # wait for the first (cut) delivery's handler thread to finish
+        deadline = time.monotonic() + 5
+        while srv.replays < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert calls["n"] == 1, "cut+retry must not double-execute"
+        assert srv.replays >= 1, "the retry must be answered by replay"
+        events = trace.RECORDER.snapshot()
+        spans = [e for e in events if e.get("name") == "rpc.echo"]
+        replays = [e for e in events if e.get("name") == "rpc.replay"]
+        assert len(spans) == 1 and spans[0]["trace"] == tid
+        assert replays and replays[0]["trace"] == tid
+    finally:
+        cli.close()
+        srv.stop()
+
+
+def test_lease_server_side_span_carries_worker_trace():
+    """The NDJSON lease plane propagates too: a worker frame stamped with
+    ``_trace`` opens the server-side lease span under that trace."""
+    from advanced_scrapper_tpu.config import FeedConfig
+    from advanced_scrapper_tpu.net.lease import LeaseServer
+
+    cfg = FeedConfig(host="127.0.0.1", port=0, batch_size=2)
+    server = LeaseServer(cfg, ["https://x/a", "https://x/b"]).start()
+    try:
+        tid = trace.new_trace_id()
+        sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        sock.sendall(
+            (json.dumps(
+                {
+                    "type": "request_tasks",
+                    "num_urls": 2,
+                    "_trace": {"t": tid, "s": "s1"},
+                }
+            ) + "\n").encode()
+        )
+        buf = b""
+        while b"\n" not in buf:
+            buf += sock.recv(65536)
+        reply = json.loads(buf.split(b"\n", 1)[0])
+        assert reply["type"] == "task_batch" and len(reply["urls"]) == 2
+        spans = [
+            e for e in trace.RECORDER.snapshot() if e.get("name") == "lease.lease"
+        ]
+        assert spans and spans[0]["trace"] == tid
+        sock.close()
+    finally:
+        server.stop()
+
+
+# -- collector --------------------------------------------------------------
+
+def test_collector_merges_live_fleet_under_concurrent_scrapes(tmp_path):
+    """A live 2×2 loopback fleet with a per-shard ``/metrics`` sidecar:
+    the collector's merged view keeps every series distinct under
+    ``instance`` labels (identical (name, labels) pairs from different
+    processes NEVER collide), and stays coherent while N threads hammer
+    its own ``/metrics``/``/status`` endpoints mid-scrape."""
+    servers, client = _fleet(tmp_path)
+    fc = None
+    try:
+        # shard sidecars came up automatically (telemetry enabled)
+        assert all(s.status_server is not None for s in servers)
+        fc = FleetCollector(
+            [
+                (s.name, f"http://127.0.0.1:{s.status_server.port}")
+                for s in servers
+            ]
+        )
+        fc.serve(interval=0.05)
+
+        errors: list[Exception] = []
+
+        def hammer():
+            try:
+                for _ in range(10):
+                    with urllib.request.urlopen(
+                        f"http://{fc.host}:{fc.port}/metrics", timeout=5
+                    ) as r:
+                        assert r.status == 200
+                        parse_prometheus_text(r.read().decode())
+                    with urllib.request.urlopen(
+                        f"http://{fc.host}:{fc.port}/status", timeout=5
+                    ) as r:
+                        json.loads(r.read())
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        # concurrent fleet traffic while the scrapes run
+        rng = np.random.default_rng(5)
+        for i in range(5):
+            keys = rng.integers(0, 800, size=(16, 8)).astype(np.uint64)
+            client.check_and_add_batch(keys, client.allocate_doc_ids(16))
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+
+        samples, _types = fc.merged_samples()
+        # per-shard sidecars of ONE process export the same registry —
+        # the instance label is what keeps the merged series apart
+        per_series: dict[tuple, set] = {}
+        for name, labels, _v in samples:
+            if name.startswith("astpu_collector_"):
+                continue
+            key = (name, tuple(sorted(
+                (k, v) for k, v in labels.items() if k != "instance"
+            )))
+            per_series.setdefault(key, set()).add(labels.get("instance"))
+        multi = [k for k, insts in per_series.items() if len(insts) == 4]
+        assert multi, "identical series must fan out across all 4 instances"
+        # and the full (name, labels) tuples are unique — zero collisions
+        full = [
+            (n, tuple(sorted(l.items()))) for n, l, _v in samples
+        ]
+        assert len(full) == len(set(full))
+    finally:
+        if fc is not None:
+            fc.stop()
+        _teardown(servers, client)
+
+
+def test_scrape_during_failover_is_partial_with_staleness_marker(tmp_path):
+    """Kill one endpoint: the next scrape round completes within the
+    timeout budget (no blocking), the dead endpoint's last-known samples
+    are still served, and the staleness marker
+    (``astpu_collector_endpoint_up`` + ``/status`` ``stale``) flips."""
+    s1 = telemetry.StatusServer(name="alive").start()
+    s2 = telemetry.StatusServer(name="dying").start()
+    telemetry.REGISTRY.counter(
+        "astpu_obsft_ops_total", "t", always=True
+    ).inc(3)
+    fc = FleetCollector(
+        [
+            ("alive", f"http://127.0.0.1:{s1.port}"),
+            ("dying", f"http://127.0.0.1:{s2.port}"),
+        ],
+        timeout=1.0,
+        stale_after=0.0,
+    )
+    try:
+        fc.scrape_once()
+        assert all(
+            e["ok"] for e in fc.status()["endpoints"]
+        )
+        s2.stop()  # the failover
+        t0 = time.monotonic()
+        fc.scrape_once()
+        assert time.monotonic() - t0 < 5.0, "a dead endpoint must not block"
+        st = fc.status()
+        dead = next(e for e in st["endpoints"] if e["name"] == "dying")
+        alive = next(e for e in st["endpoints"] if e["name"] == "alive")
+        assert not dead["ok"] and dead["stale"]
+        assert alive["ok"]
+        samples, _ = fc.merged_samples()
+        # partial results: the live endpoint's fresh series AND the dead
+        # endpoint's cached ones are both present
+        insts = {
+            l.get("instance")
+            for n, l, _v in samples
+            if n == "astpu_obsft_ops_total"
+        }
+        assert insts == {"alive", "dying"}
+        up = {
+            l["instance"]: v
+            for n, l, v in samples
+            if n == "astpu_collector_endpoint_up"
+        }
+        assert up == {"alive": 1.0, "dying": 0.0}
+    finally:
+        fc.stop()
+        s1.stop()
+
+
+def test_collector_merged_metrics_covers_three_processes(tmp_path):
+    """The acceptance merge: two REAL shard subprocesses (each with a
+    ``--metrics-port`` sidecar) plus this process — the collector's one
+    ``/metrics`` covers all three under per-process labels."""
+    procs = []
+    endpoints = [("self", None)]  # filled below
+    own = telemetry.StatusServer(name="self").start()
+    endpoints[0] = ("self", f"http://127.0.0.1:{own.port}")
+    try:
+        for i in range(2):
+            pf = tmp_path / f"s{i}.port"
+            mf = tmp_path / f"s{i}.mport"
+            p = subprocess.Popen(
+                [
+                    sys.executable, "-m", "advanced_scrapper_tpu.index.remote",
+                    "--dir", str(tmp_path / f"shard{i}"),
+                    "--port", "0", "--port-file", str(pf),
+                    "--spaces", "bands",
+                    "--metrics-port", "0", "--metrics-port-file", str(mf),
+                    "--name", f"sub{i}",
+                ],
+                env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                cwd=REPO,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            procs.append(p)
+            deadline = time.monotonic() + 30
+            while not mf.exists():
+                assert p.poll() is None, "shard subprocess died at start"
+                assert time.monotonic() < deadline, "metrics port never bound"
+                time.sleep(0.02)
+            endpoints.append(
+                (f"sub{i}", f"http://127.0.0.1:{mf.read_text().strip()}")
+            )
+        fc = FleetCollector(endpoints)
+        fc.scrape_once()
+        samples, _ = fc.merged_samples()
+        uptime_instances = {
+            l.get("instance")
+            for n, l, _v in samples
+            if n == "astpu_process_uptime_seconds"
+        }
+        assert uptime_instances == {"self", "sub0", "sub1"}, uptime_instances
+        txt = fc.prometheus_text()
+        for inst in ("self", "sub0", "sub1"):
+            assert f'instance="{inst}"' in txt
+    finally:
+        own.stop()
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_endpoint_discovery_via_obs_dir(tmp_path, monkeypatch):
+    """Exporters under ASTPU_OBS_DIR announce themselves; the collector's
+    discovery pass picks the file up without explicit wiring."""
+    obs_dir = tmp_path / "obs"
+    monkeypatch.setenv("ASTPU_OBS_DIR", str(obs_dir))
+    srv = telemetry.StatusServer(name="announced").start()
+    try:
+        assert (obs_dir / "announced.endpoint").exists()
+        fc = FleetCollector(obs_dir=str(obs_dir))
+        assert fc.discover() == 1
+        fc.scrape_once()
+        st = fc.status()
+        assert [e["name"] for e in st["endpoints"]] == ["announced"]
+        assert st["endpoints"][0]["ok"]
+    finally:
+        srv.stop()
+    # a stopped server withdraws its announcement
+    assert not (obs_dir / "announced.endpoint").exists()
+
+
+def test_sidecar_harvest_names_dead_shard(tmp_path):
+    """A chaos-killed shard's flight-recorder dump, pulled centrally: the
+    harvest names the shard (the ``shard.serve`` event lands it in the
+    ring at start) and surfaces the fault reason."""
+    srv = IndexShardServer(
+        str(tmp_path / "doomed"), spaces=("bands",), name="doomed-7"
+    ).start()
+    srv.stop()
+    trace.RECORDER.set_dump_path(str(tmp_path / "side" / "doomed.flight.jsonl"))
+    os.makedirs(tmp_path / "side", exist_ok=True)
+    trace.dump_on_fault("chaos exit inside wal append")
+    fc = FleetCollector(sidecar_dir=str(tmp_path / "side"))
+    harvested = fc.harvest_sidecars()
+    assert len(harvested) == 1
+    assert harvested[0]["shards"] == ["doomed-7"]
+    assert "chaos exit" in harvested[0]["reasons"][0]
+    assert fc.dead_shards() == ["doomed-7"]
+    st = fc.status()
+    assert st["dead_shards"] == ["doomed-7"]
+
+
+def test_exemplar_rides_prometheus_text_and_collector():
+    """A slow-call exemplar written by a histogram survives the round
+    trip: rendered as a comment on ``/metrics``, parsed back by the
+    collector, re-served with the instance label."""
+    h = telemetry.REGISTRY.histogram("astpu_obsft_lat_seconds", "t", plane="q")
+    for _ in range(50):
+        h.observe(0.001)
+    h.observe(0.8, trace="feed-beef-1")
+    txt = telemetry.REGISTRY.prometheus_text()
+    assert '# exemplar astpu_obsft_lat_seconds{plane="q"} trace="feed-beef-1"' in txt
+    samples, types, exemplars = parse_prometheus_text(txt)
+    assert types["astpu_obsft_lat_seconds"] == "histogram"
+    assert any(
+        e["name"] == "astpu_obsft_lat_seconds" and e["trace"] == "feed-beef-1"
+        for e in exemplars
+    )
+    srv = telemetry.StatusServer(name="exm").start()
+    fc = FleetCollector([("exm", f"http://127.0.0.1:{srv.port}")])
+    try:
+        fc.scrape_once()
+        merged = fc.prometheus_text()
+        assert 'trace="feed-beef-1"' in merged
+        assert 'instance="exm"' in merged
+    finally:
+        srv.stop()
+
+
+# -- SLO engine -------------------------------------------------------------
+
+def test_slo_objective_declaration_validation():
+    with pytest.raises(ValueError, match="unknown kind"):
+        SloObjective(name="x", kind="nope", metric="m", threshold=1)
+    with pytest.raises(ValueError, match="denominator"):
+        SloObjective(name="x", kind="ratio_max", metric="m", threshold=1)
+    with pytest.raises(ValueError, match="duplicate"):
+        load_objectives(
+            [
+                {"name": "a", "kind": "gauge_min", "metric": "m", "threshold": 1},
+                {"name": "a", "kind": "gauge_min", "metric": "m", "threshold": 2},
+            ]
+        )
+
+
+def test_slo_rate_and_ratio_objectives():
+    eng = SloEngine(
+        [
+            {
+                "name": "tput", "kind": "rate_min",
+                "metric": "astpu_obsft_docs_total", "threshold": 10.0,
+            },
+            {
+                "name": "errs", "kind": "ratio_max",
+                "metric": "astpu_obsft_err_total",
+                "denominator": "astpu_obsft_docs_total",
+                "threshold": 0.1,
+            },
+        ],
+        export=False,
+    )
+
+    def samples(docs, errs):
+        return [
+            ("astpu_obsft_docs_total", {}, float(docs)),
+            ("astpu_obsft_err_total", {}, float(errs)),
+        ]
+
+    t0 = 1000.0
+    v = eng.evaluate(samples(0, 0), now=t0)
+    assert v["objectives"][0]["ok"] is None  # no rate on first sight
+    # 100 docs, 1 err over 2s → 50/s, ratio 0.01 → both ok
+    v = eng.evaluate(samples(100, 1), now=t0 + 2)
+    assert v["objectives"][0]["ok"] is True
+    assert v["objectives"][0]["value"] == pytest.approx(50.0)
+    assert v["objectives"][1]["ok"] is True
+    # 10 docs, 5 errs over 2s → 5/s (below floor), ratio 0.5 (over budget)
+    v = eng.evaluate(samples(110, 6), now=t0 + 4)
+    assert v["objectives"][0]["ok"] is False
+    assert v["objectives"][1]["ok"] is False
+    assert not v["ok"]
+
+
+def test_slo_shards_healthy_flips_on_fleet_kill(tmp_path):
+    """The fleet-health floor objective over the LIVE registry: kill a
+    shard primary, let the client observe it, and the gauge_min objective
+    flips within one evaluation."""
+    servers, client = _fleet(tmp_path)
+    try:
+        eng = SloEngine(
+            [
+                {
+                    "name": "shards_healthy", "kind": "gauge_min",
+                    "metric": "astpu_fleet_shards_healthy",
+                    "threshold": 2, "agg": "min",
+                }
+            ]
+        )
+        rng = np.random.default_rng(9)
+        keys = rng.integers(0, 500, size=(16, 8)).astype(np.uint64)
+        client.check_and_add_batch(keys, client.allocate_doc_ids(16))
+        assert eng.evaluate()["ok"]
+        servers[0].stop()  # s0n0: shard 0's write target
+        client.probe_batch(keys)  # reads fail over; shard 0 enters promotion
+        v = eng.evaluate()
+        assert not v["ok"]
+        assert v["objectives"][0]["value"] == 1.0
+        # exported series moved with it
+        compliant = telemetry.REGISTRY.find("astpu_slo_compliant")
+        assert [c.value for c in compliant] == [0.0]
+        # a write proves the replica and heals the shard
+        keys2 = rng.integers(500, 900, size=(16, 8)).astype(np.uint64)
+        client.check_and_add_batch(keys2, client.allocate_doc_ids(16))
+        assert eng.evaluate()["ok"]
+        assert [c.value for c in compliant] == [1.0]
+    finally:
+        _teardown(servers, client)
+
+
+def test_p99_slo_violated_then_recovered_via_injected_rpc_delay(tmp_path):
+    """THE acceptance SLO: a declared p99-latency ceiling on the fleet
+    RPC histogram, evaluated over the live registry.  Injected server-
+    side delay violates it; removing the delay recovers it; the
+    ``astpu_slo_burn_rate`` series rise and fall with the windows."""
+    servers, client = _fleet(tmp_path, timeout=10.0)
+    try:
+        eng = SloEngine(
+            [
+                {
+                    "name": "probe_p99", "kind": "p99_latency_max",
+                    "metric": "astpu_fleet_rpc_seconds",
+                    "labels": {"method": "probe"},
+                    "threshold": 0.08,
+                    "budget": 0.25,
+                    "fast_window": 60.0,
+                    "slow_window": 600.0,
+                }
+            ]
+        )
+        rng = np.random.default_rng(11)
+        keys = rng.integers(0, 500, size=(16, 8)).astype(np.uint64)
+        t0 = 5000.0
+        for _ in range(5):
+            client.probe_batch(keys)
+        v1 = eng.evaluate(now=t0)
+        assert v1["objectives"][0]["ok"] is True
+
+        # inject delay INSIDE every shard's probe handler
+        originals = []
+        for srv in servers:
+            orig = srv.server.handlers["probe"]
+            originals.append((srv, orig))
+
+            def slow(header, arrays, _orig=orig):
+                time.sleep(0.12)
+                return _orig(header, arrays)
+
+            srv.server.handlers["probe"] = slow
+        for _ in range(3):
+            client.probe_batch(keys)
+        v2 = eng.evaluate(now=t0 + 10)
+        o = v2["objectives"][0]
+        assert o["ok"] is False and o["value"] > 0.08
+        assert o["burn_fast"] > 1.0, "the fast window must be burning"
+        burn = {
+            g.labels["window"]: g.value
+            for g in telemetry.REGISTRY.find("astpu_slo_burn_rate")
+        }
+        assert burn["fast"] > 1.0
+
+        # remove the delay: the WINDOWED p99 must recover (a cumulative
+        # histogram would stay poisoned forever — the window delta is the
+        # point of the SLO evaluation)
+        for srv, orig in originals:
+            srv.server.handlers["probe"] = orig
+        for _ in range(10):
+            client.probe_batch(keys)
+        v3 = eng.evaluate(now=t0 + 120)  # fast window has slid past the spike
+        o3 = v3["objectives"][0]
+        assert o3["ok"] is True and o3["value"] < 0.08
+        assert o3["burn_fast"] < 1.0, "the fast burn must fall back"
+        assert o3["burn_slow"] > 0.0, "the slow window still remembers"
+        compliant = telemetry.REGISTRY.find("astpu_slo_compliant")
+        assert [c.value for c in compliant] == [1.0]
+    finally:
+        _teardown(servers, client)

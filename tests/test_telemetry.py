@@ -488,11 +488,11 @@ def test_scraper_stats_bridge(global_telemetry):
     eng.stats.record_fail()
     text = telemetry.REGISTRY.prometheus_text()
     assert any(
-        line.startswith("astpu_scraper_success_total") and line.endswith(" 2")
+        line.startswith("astpu_scraper_fetch_success") and line.endswith(" 2")
         for line in text.splitlines()
     )
     assert any(
-        line.startswith("astpu_scraper_fail_total") and line.endswith(" 1")
+        line.startswith("astpu_scraper_fetch_fail") and line.endswith(" 1")
         for line in text.splitlines()
     )
     eng.pause.trigger(10.0)

@@ -437,3 +437,161 @@ def test_lint_imports_catches_violations(tmp_path):
     assert not any("ok.py" in p for p in problems), (
         "net.rpc is exempt for index/, and runtime/ may use obs/"
     )
+
+
+def test_lint_metrics_clean_tree():
+    """Tier-1 series-naming gate over the REAL tree: astpu_ prefix, unit
+    suffixes (_total for counters, _seconds/_bytes for histograms), one
+    registering module per series outside the shared event families."""
+    import lint_metrics
+
+    problems = lint_metrics.lint()
+    assert problems == [], "\n".join(problems)
+
+
+def test_lint_metrics_catches_violations(tmp_path):
+    """Prefix, suffix, duplicate-owner and kind-conflict findings — at
+    any nesting depth, through telemetry.* and REGISTRY.* spellings."""
+    import lint_metrics
+
+    pkg = tmp_path / "advanced_scrapper_tpu"
+    (pkg / "alpha").mkdir(parents=True)
+    (pkg / "beta").mkdir()
+    (pkg / "alpha" / "bad.py").write_text(
+        "from advanced_scrapper_tpu.obs import telemetry\n"
+        "def f():\n"
+        "    telemetry.counter('my_counter', 'no prefix')\n"
+        "    telemetry.counter('astpu_alpha_things', 'counter sans _total')\n"
+        "    telemetry.histogram('astpu_alpha_latency', 'no unit suffix')\n"
+        "    telemetry.gauge('astpu_alpha_done_total', 'gauge w/ _total')\n"
+        "    telemetry.gauge_fn('astpu_alpha_heap_bytes_used', lambda: 0)\n"
+        "    telemetry.REGISTRY.counter('astpu_shared_ops_total', 'ok')\n"
+    )
+    (pkg / "beta" / "bad.py").write_text(
+        "from advanced_scrapper_tpu.obs import telemetry\n"
+        "def g():\n"
+        "    telemetry.counter('astpu_shared_ops_total', 'dup owner')\n"
+        "    telemetry.gauge('astpu_alpha_things', 'kind conflict')\n"
+    )
+    problems = lint_metrics.lint(str(tmp_path))
+    assert any("'my_counter'" in p and "astpu_" in p for p in problems)
+    assert any(
+        "'astpu_alpha_things'" in p and "_total" in p for p in problems
+    )
+    assert any(
+        "'astpu_alpha_latency'" in p and "_seconds" in p for p in problems
+    )
+    assert any(
+        "'astpu_alpha_done_total'" in p and "not monotone" in p
+        for p in problems
+    )
+    assert any(
+        "'astpu_alpha_heap_bytes_used'" in p and "_bytes" in p
+        for p in problems
+    )
+    assert any(
+        "'astpu_shared_ops_total'" in p and "2 modules" in p for p in problems
+    )
+    assert any(
+        "'astpu_alpha_things'" in p and "conflicting kinds" in p
+        for p in problems
+    )
+
+
+def test_obs_fleet_once_smoke(capsys):
+    """obs_fleet --once against two live exporters: endpoint table, merged
+    series count, and an SLO verdict when --slo is given."""
+    import json as _json
+
+    import obs_fleet
+
+    from advanced_scrapper_tpu.obs import telemetry
+
+    telemetry.REGISTRY.reset()
+    telemetry.set_enabled(True)
+    s1 = s2 = None
+    try:
+        telemetry.REGISTRY.counter("astpu_obsft_tool_total", "t").inc(4)
+        s1 = telemetry.StatusServer(name="a").start()
+        s2 = telemetry.StatusServer(name="b").start()
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False
+        ) as fh:
+            _json.dump(
+                [
+                    {
+                        "name": "endpoints_up", "kind": "gauge_min",
+                        "metric": "astpu_collector_endpoint_up",
+                        "threshold": 2, "agg": "sum",
+                    }
+                ],
+                fh,
+            )
+            slo_path = fh.name
+        rc = obs_fleet.main(
+            [
+                "--endpoints",
+                f"a=http://127.0.0.1:{s1.port},b=http://127.0.0.1:{s2.port}",
+                "--slo", slo_path, "--once",
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "obs_fleet @" in out
+        assert "up" in out and "merged series:" in out
+        assert "slo ok=True" in out
+        assert "endpoints_up" in out
+        os.unlink(slo_path)
+    finally:
+        for s in (s1, s2):
+            if s is not None:
+                s.stop()
+        telemetry.REGISTRY.reset()
+        telemetry.set_enabled(None)
+
+
+def test_obs_top_fleet_once_smoke(capsys):
+    """obs_top --fleet --once against a serving collector: per-endpoint
+    health lines and the SLO block from the merged view."""
+    import obs_top
+
+    from advanced_scrapper_tpu.obs import telemetry
+    from advanced_scrapper_tpu.obs.collector import FleetCollector
+    from advanced_scrapper_tpu.obs.slo import SloEngine
+
+    telemetry.REGISTRY.reset()
+    telemetry.set_enabled(True)
+    srv = fc = None
+    try:
+        telemetry.REGISTRY.counter(
+            "astpu_rpc_server_calls_total", "t", server="s"
+        ).inc(7)
+        srv = telemetry.StatusServer(name="node0").start()
+        eng = SloEngine(
+            [
+                {
+                    "name": "calls_floor", "kind": "gauge_min",
+                    "metric": "astpu_rpc_server_calls_total", "threshold": 1,
+                }
+            ]
+        )
+        eng.evaluate()
+        fc = FleetCollector([("node0", f"http://127.0.0.1:{srv.port}")])
+        fc.serve(interval=0.2)
+        rc = obs_top.main(
+            ["--url", f"http://{fc.host}:{fc.port}", "--fleet", "--once"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "obs_top --fleet @" in out
+        assert "node0" in out and "up" in out
+        assert "slo:" in out and "calls_floor" in out and "OK" in out
+    finally:
+        if fc is not None:
+            fc.stop()
+        if srv is not None:
+            srv.stop()
+        telemetry.REGISTRY.reset()
+        telemetry.set_enabled(None)

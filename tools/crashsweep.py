@@ -441,6 +441,13 @@ def _fleet_spawn_server(
     env.pop("ASTPU_CHAOS_FS", None)
     if chaos:
         env["ASTPU_CHAOS_FS"] = chaos
+    # crash-sidecar harvesting: a chaos-exit INSIDE the shard dumps its
+    # flight recorder here (SIGKILL leaves no dump — the CLIENT's own
+    # sidecar names those kills via its failover events); the collector
+    # pulls every *.flight.jsonl from the case dir centrally afterwards
+    env["ASTPU_FLIGHT_RECORDER"] = os.path.join(
+        case_dir, f"s{sid}n{rep}.flight.jsonl"
+    )
 
     def _pdeathsig():
         ctypes.CDLL(None).prctl(1, signal.SIGKILL)  # PR_SET_PDEATHSIG
@@ -483,6 +490,12 @@ def child_fleet(case_dir: str, seed: int) -> int:
     import numpy as np
 
     from advanced_scrapper_tpu.index.fleet import FleetSpec, ShardedIndexClient
+    from advanced_scrapper_tpu.obs import trace
+    from advanced_scrapper_tpu.obs.slo import SloEngine
+
+    # the client's own sidecar: its failover/spill/replay events name the
+    # SIGKILLed shard (a SIGKILLed server can't dump; the survivor can)
+    trace.set_dump_path(os.path.join(case_dir, "client.flight.jsonl"))
 
     rng = random.Random(f"fleet-child|{seed}")
     mode = FLEET_KILL_MODES[seed % len(FLEET_KILL_MODES)]
@@ -529,6 +542,21 @@ def child_fleet(case_dir: str, seed: int) -> int:
             health_timeout=0.3,
         )
         _touch_marker(case_dir)
+        # declared fleet SLO: every shard must keep a proven write target;
+        # evaluated after EVERY batch so the report records the exact
+        # batch the objective flipped (one "scrape interval" = one batch)
+        slo = SloEngine(
+            [
+                {
+                    "name": "shards_healthy",
+                    "kind": "gauge_min",
+                    "metric": "astpu_fleet_shards_healthy",
+                    "threshold": FLEET_SHARDS,
+                    "agg": "min",
+                }
+            ]
+        )
+        slo_flipped_batch = None
         ann: list[int] = []
         for b in range(n_batches):
             if b == kill_batch and mode in ("insert", "probe", "promotion"):
@@ -545,6 +573,18 @@ def child_fleet(case_dir: str, seed: int) -> int:
                     client.probe_batch(
                         np.stack([_fleet_doc_keys(0), _fleet_doc_keys(1)])
                     )
+                # the SLO "scrape interval" right after the kill: a probe
+                # wide enough to touch every ring slice makes the client
+                # OBSERVE the dead node (reads fail over instantly; the
+                # shard stays in promotion until the next write proves a
+                # target), and the declared shards_healthy floor must
+                # flip HERE — before the healing write lands
+                client.probe_batch(
+                    np.stack([_fleet_doc_keys(i) for i in range(8)])
+                )
+                verdict = slo.evaluate()
+                if slo_flipped_batch is None and not verdict["ok"]:
+                    slo_flipped_batch = b
             if b == revive_batch and mode == "promotion":
                 # the restarted node recovers its index from disk at the
                 # SAME address; the client's next touches revive it,
@@ -558,7 +598,20 @@ def child_fleet(case_dir: str, seed: int) -> int:
             keys = np.stack([_fleet_doc_keys(i) for i in rows])
             ids = client.allocate_doc_ids(len(keys))
             ann += np.asarray(client.check_and_add_batch(keys, ids)).tolist()
+            verdict = slo.evaluate()
+            if slo_flipped_batch is None and not verdict["ok"]:
+                slo_flipped_batch = b
         client.checkpoint()  # recovery probe: drains any remaining spill
+        final_verdict = slo.evaluate()
+        # dump the client's ring and harvest EVERY sidecar centrally —
+        # the collector must be able to name the dead shard from dumps
+        # alone (the chaos-integration contract verify_fleet asserts)
+        trace.dump(reason="fleet sweep end")
+        from advanced_scrapper_tpu.obs.collector import FleetCollector
+
+        harvester = FleetCollector(sidecar_dir=case_dir)
+        harvester.harvest_sidecars()
+        primary_died = procs[(kill_shard, 0)].poll() is not None
         report = {
             "mode": mode,
             "kill_shard": kill_shard,
@@ -572,6 +625,11 @@ def child_fleet(case_dir: str, seed: int) -> int:
             "spill_pending": sum(
                 int(k.size) for sh in client._shards for (_r, k, _d) in sh.pending
             ),
+            "slo_flipped_batch": slo_flipped_batch,
+            "slo_final_ok": final_verdict["ok"],
+            "slo_burn_fast": final_verdict["objectives"][0]["burn_fast"],
+            "dead_shards": harvester.dead_shards(),
+            "primary_died": primary_died,
         }
         client.close()
         from advanced_scrapper_tpu.storage.fsio import atomic_replace
@@ -858,6 +916,33 @@ def verify_fleet(case_dir: str) -> list[str]:
                 f"(spilled={report.get('spilled')}, "
                 f"replayed={report.get('replayed')})"
             )
+    # observability-plane integration: the kill must be ATTRIBUTABLE from
+    # the collector's harvested sidecars and the declared SLO alone
+    if report.get("primary_died"):
+        dead = [str(s) for s in report.get("dead_shards", [])]
+        kill_names = {str(report.get("kill_shard")), f"s{report.get('kill_shard')}n0"}
+        if not kill_names & set(dead):
+            problems.append(
+                f"harvested flight-recorder dumps never named the killed "
+                f"shard {sorted(kill_names)} (got {dead})"
+            )
+        if report.get("slo_flipped_batch") is None:
+            problems.append(
+                "shards_healthy SLO never flipped although the primary died"
+            )
+        elif mode in ("insert", "probe", "promotion") and (
+            report["slo_flipped_batch"] > report.get("kill_batch", 0) + 1
+        ):
+            problems.append(
+                f"shards_healthy SLO flipped at batch "
+                f"{report['slo_flipped_batch']}, more than one interval after "
+                f"the kill at batch {report.get('kill_batch')}"
+            )
+    if not report.get("slo_final_ok", True):
+        problems.append(
+            "shards_healthy SLO still violated at sweep end (fleet never "
+            "recovered a proven write target per shard)"
+        )
     return problems
 
 

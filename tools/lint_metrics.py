@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Metric-naming linter: mechanical enforcement of the telemetry scheme.
+
+The observability plane's value is that every series is predictable:
+``astpu_<layer>_<what>[_total|_seconds|_bytes]`` (``obs/telemetry.py``
+docstring).  Nothing enforced it until now — one ``my_counter`` or a
+``_seconds``-less histogram and the fleet collector's merged view (and
+every SLO objective keyed on a name) silently fragments.
+
+Rules, applied to every metric registration found by walking the AST
+(``telemetry.counter/gauge/histogram/event_counter/gauge_fn`` and
+``REGISTRY.*`` calls with a literal name — at any nesting depth, so a
+function-local registration cannot dodge them):
+
+- **prefix**: every name starts ``astpu_`` and matches
+  ``^astpu_[a-z][a-z0-9_]*$`` (Prometheus-safe, grep-safe);
+- **unit suffixes**: counters end ``_total`` (units like ``_bytes`` /
+  ``_seconds`` go BEFORE it: ``astpu_h2d_bytes_total``); histograms end
+  ``_seconds`` or ``_bytes``; gauges never end ``_total`` (a gauge is not
+  monotone), and a gauge measuring bytes/seconds says so
+  (``..._bytes`` / ``..._seconds``);
+- **one owner per series**: a metric name may be registered from ONE
+  module only (two modules feeding the same name is how double counting
+  ships), except the explicitly shared event families in
+  ``SHARED_SERIES``;
+- **one kind per series**: the same name registered as two different
+  kinds anywhere is always an error.
+
+Wired as a tier-1 test in ``tests/test_tools.py``; run standalone::
+
+    python tools/lint_metrics.py          # exit 0 clean, 1 with findings
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = "advanced_scrapper_tpu"
+
+#: registration attr → metric kind
+KIND_OF = {
+    "counter": "counter",
+    "event_counter": "counter",
+    "gauge": "gauge",
+    "gauge_fn": "gauge",
+    "histogram": "histogram",
+}
+
+#: event families deliberately fired from more than one module (the
+#: quarantine and fault-injection planes span storage + net by design) —
+#: plus the stage histogram, which obs/stages.py re-exposes as a view.
+SHARED_SERIES = {
+    "astpu_quarantine_total",
+    "astpu_fault_injected_total",
+    "astpu_stage_seconds",
+}
+
+NAME_RE = re.compile(r"^astpu_[a-z][a-z0-9_]*$")
+
+
+def _receiver(node: ast.expr) -> str:
+    """Dotted receiver of an attribute chain (``telemetry.REGISTRY`` for
+    ``telemetry.REGISTRY.counter``); empty when unnameable."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_registry_call(call: ast.Call) -> str | None:
+    """The metric kind when ``call`` is a registration on the telemetry
+    plane, else None."""
+    fn = call.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in KIND_OF:
+        return None
+    recv = _receiver(fn.value)
+    if (
+        "telemetry" in recv
+        or "REGISTRY" in recv
+        or recv in ("reg", "self._reg", "registry")
+    ):
+        return KIND_OF[fn.attr]
+    return None
+
+
+def _check_name(name: str, kind: str) -> list[str]:
+    problems = []
+    if not NAME_RE.match(name):
+        problems.append(
+            f"{name!r}: must match {NAME_RE.pattern} (astpu_ prefix, "
+            "lowercase, Prometheus-safe)"
+        )
+        return problems
+    if kind == "counter":
+        if not name.endswith("_total"):
+            problems.append(f"{name!r}: counters must end _total")
+    elif kind == "histogram":
+        if not (name.endswith("_seconds") or name.endswith("_bytes")):
+            problems.append(f"{name!r}: histograms must end _seconds or _bytes")
+    elif kind == "gauge":
+        if name.endswith("_total"):
+            problems.append(f"{name!r}: gauges must not end _total (not monotone)")
+        else:
+            base = name[: -len("_ratio")] if name.endswith("_ratio") else name
+            for unit, suffix in (("bytes", "_bytes"), ("seconds", "_seconds")):
+                if unit in base and not base.endswith(suffix):
+                    problems.append(
+                        f"{name!r}: a gauge measuring {unit} must end {suffix}"
+                    )
+    return problems
+
+
+def check_file(path: str):
+    """``(problems, registrations)`` for one file; a registration is
+    ``(name, kind, lineno)``."""
+    with open(path, "rb") as fh:
+        try:
+            tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError as e:
+            return [f"{path}: unparseable ({e})"], []
+    problems: list[str] = []
+    regs: list[tuple[str, str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _is_registry_call(node)
+        if kind is None:
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            continue  # computed names are the caller's responsibility
+        name = arg.value
+        regs.append((name, kind, node.lineno))
+        for p in _check_name(name, kind):
+            problems.append(f"{path}:{node.lineno}: {p}")
+    return problems, regs
+
+
+def lint(root: str = REPO) -> list[str]:
+    problems: list[str] = []
+    owners: dict[str, set[str]] = {}   # name → modules registering it
+    kinds: dict[str, dict[str, str]] = {}  # name → {kind: first site}
+    pkg_root = os.path.join(root, PACKAGE)
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            mod = os.path.relpath(path, root)
+            file_problems, regs = check_file(path)
+            problems += file_problems
+            for name, kind, lineno in regs:
+                owners.setdefault(name, set()).add(mod)
+                kinds.setdefault(name, {}).setdefault(kind, f"{mod}:{lineno}")
+    for name, mods in sorted(owners.items()):
+        if len(mods) > 1 and name not in SHARED_SERIES:
+            problems.append(
+                f"{name!r}: registered from {len(mods)} modules "
+                f"({', '.join(sorted(mods))}) — one owner per series "
+                "(or add to SHARED_SERIES with a reason)"
+            )
+    for name, by_kind in sorted(kinds.items()):
+        if len(by_kind) > 1:
+            sites = ", ".join(f"{k} at {s}" for k, s in sorted(by_kind.items()))
+            problems.append(f"{name!r}: registered as conflicting kinds ({sites})")
+    return problems
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=REPO, help="repo root to lint")
+    args = ap.parse_args(argv)
+    problems = lint(args.root)
+    for p in problems:
+        print(p, file=sys.stderr)
+    if not problems:
+        print("lint_metrics: series naming clean")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""obs_fleet — run the fleet metrics collector + SLO engine as a process.
+
+Discovers fleet endpoints, scrapes every ``/metrics``, serves the merged
+fleet-wide registry on its own ``GET /metrics`` + ``/status``, harvests
+crash flight-recorder sidecars, and (with ``--slo``) evaluates a declared
+SLO file against the merged view each round — the operator-facing half of
+``obs/collector.py`` + ``obs/slo.py``.
+
+Endpoint sources (combinable):
+  --endpoints name=url,name=url    explicit list (bare host:port ok)
+  --obs-dir DIR                    ``*.endpoint`` announcement files
+                                   (every StatusServer under
+                                   ASTPU_OBS_DIR writes one)
+  --sidecar-dir DIR                flight-recorder JSONL dumps to harvest
+
+SLO file (``--slo slo.json``): a JSON list of objective dicts
+(``obs/slo.py`` — name/kind/metric/threshold/labels/budget/windows);
+verdicts export as ``astpu_slo_*`` series on this process's merged
+``/metrics`` and print on ``--once``.
+
+Usage:
+  python tools/obs_fleet.py --endpoints 127.0.0.1:9100,127.0.0.1:9101
+  python tools/obs_fleet.py --obs-dir /tmp/obs --port 9200 --interval 2
+  python tools/obs_fleet.py --obs-dir /tmp/obs --once   # one merged frame
+  # then: python tools/obs_top.py --url http://127.0.0.1:9200 --fleet
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def build_collector(args):
+    from advanced_scrapper_tpu.obs.collector import (
+        FleetCollector,
+        parse_endpoint_list,
+    )
+
+    endpoints = parse_endpoint_list(args.endpoints) if args.endpoints else []
+    return FleetCollector(
+        endpoints,
+        timeout=args.timeout,
+        obs_dir=args.obs_dir,
+        sidecar_dir=args.sidecar_dir,
+        stale_after=args.stale_after,
+    )
+
+
+def build_slo(args):
+    if not args.slo:
+        return None
+    from advanced_scrapper_tpu.obs.slo import SloEngine
+
+    with open(args.slo, encoding="utf-8") as fh:
+        return SloEngine(json.load(fh))
+
+
+def render_once(collector, engine) -> str:
+    st = collector.status()
+    lines = [f"obs_fleet @ {time.strftime('%H:%M:%S')}  "
+             f"endpoints={len(st['endpoints'])}"]
+    for ep in st["endpoints"]:
+        mark = "up" if ep["ok"] else ("STALE" if ep["stale"] else "down")
+        age = f" age={ep['age_s']:.1f}s" if ep["age_s"] is not None else ""
+        err = f"  ({ep['error']})" if ep["error"] else ""
+        lines.append(
+            f"  {ep['name']:<20} {mark:<5} series={ep['series']}{age}{err}"
+        )
+    if st["dead_shards"]:
+        lines.append(f"  dead shards (harvested dumps): {st['dead_shards']}")
+    for sc in st["sidecars"]:
+        lines.append(
+            f"  sidecar {sc['name']}: pid={sc['pid']} dumps={sc['dumps']} "
+            f"shards={sc['shards']} reasons={sc['reasons']}"
+        )
+    if engine is not None:
+        verdict = engine.evaluate(collector.merged_samples()[0])
+        lines.append(f"  slo ok={verdict['ok']} alerting={verdict['alerting']}")
+        for o in verdict["objectives"]:
+            lines.append(
+                f"    {o['name']:<24} ok={o['ok']} value={o['value']} "
+                f"thr={o['threshold']} burn fast={o['burn_fast']} "
+                f"slow={o['burn_slow']}"
+            )
+    lines.append(f"  merged series: {len(st['metrics'])}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--endpoints", default="", help="name=url,name=url | host:port,...")
+    ap.add_argument("--obs-dir", default=None, help="*.endpoint discovery dir")
+    ap.add_argument("--sidecar-dir", default=None, help="flight-dump harvest dir")
+    ap.add_argument("--slo", default=None, help="JSON file of SLO objectives")
+    ap.add_argument("--port", type=int, default=0, help="serve port (0=ephemeral)")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--timeout", type=float, default=2.0)
+    ap.add_argument("--stale-after", type=float, default=15.0)
+    ap.add_argument("--once", action="store_true", help="one frame, then exit")
+    ap.add_argument(
+        "--frames", type=int, default=0, help="stop after N rounds (0 = forever)"
+    )
+    args = ap.parse_args(argv)
+    if not (args.endpoints or args.obs_dir):
+        ap.error("need --endpoints and/or --obs-dir")
+
+    collector = build_collector(args)
+    engine = build_slo(args)
+
+    if args.once:
+        collector.scrape_once()
+        print(render_once(collector, engine))
+        return 0
+
+    local = None
+    if engine is not None:
+        # the SLO engine exports astpu_slo_* into THIS process's registry;
+        # registering our own exporter as one more endpoint folds the
+        # verdict series into the merged fleet view like any other process
+        from advanced_scrapper_tpu.obs import telemetry
+
+        local = telemetry.StatusServer(name="slo").start()
+        collector.add_endpoint("slo", f"http://{local.host}:{local.port}")
+    collector.serve(port=args.port, interval=args.interval)
+    print(
+        f"obs_fleet: merged /metrics + /status on "
+        f"http://{collector.host}:{collector.port}",
+        file=sys.stderr, flush=True,
+    )
+    n = 0
+    try:
+        while True:
+            if engine is not None:
+                engine.evaluate(collector.merged_samples()[0])
+            n += 1
+            if args.frames and n >= args.frames:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        collector.stop()
+        if local is not None:
+            local.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

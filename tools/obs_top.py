@@ -14,6 +14,10 @@ Two modes:
   every live graph with its edges (depth/capacity, items in/out, put/get
   stall seconds) and stages (throughput, busy time), straight from the
   scheduler's own ``astpu_edge_*`` / ``astpu_stage_*`` series.
+- ``--fleet`` (combinable with ``--once``): the fleet view — point
+  ``--url`` at a metrics collector (``tools/obs_fleet.py``) for
+  per-process endpoint health/staleness, per-instance headline rates,
+  harvested crash sidecars (which shard died), and SLO verdicts.
 - live (default): the :class:`obs.console.ConsoleMux` idiom — a sticky
   one-line summary repainted in place (per-stage rates computed from
   successive histogram snapshots, queue depths, fleet health) with notable
@@ -208,6 +212,91 @@ def render_graph_frame(
     return lines
 
 
+def render_fleet_frame(status: dict) -> list[str]:
+    """The fleet view (``--fleet``): point --url at a running collector
+    (``tools/obs_fleet.py`` / ``obs.collector.FleetCollector.serve``) and
+    get the per-process breakdown — endpoint health + staleness, each
+    instance's headline series, harvested crash sidecars, and the SLO
+    verdict series if an engine is feeding the merge."""
+    lines: list[str] = []
+    eps = status.get("endpoints")
+    if eps is None:
+        return ["  (no collector fields — is --url a FleetCollector?)"]
+    for ep in eps:
+        mark = "up" if ep.get("ok") else ("STALE" if ep.get("stale") else "down")
+        age = f" age={ep['age_s']:.1f}s" if ep.get("age_s") is not None else ""
+        err = f"  ({ep['error']})" if ep.get("error") else ""
+        lines.append(
+            f"  {ep['name']:<22} {mark:<5} series={ep.get('series', 0)}{age}{err}"
+        )
+    dead = status.get("dead_shards") or []
+    if dead:
+        lines.append(f"  dead shards (harvested dumps): {dead}")
+    for sc in status.get("sidecars", []):
+        lines.append(
+            f"  sidecar {sc.get('name')}: pid={sc.get('pid')} "
+            f"dumps={sc.get('dumps')} shards={sc.get('shards')}"
+        )
+    # per-instance headline counters (rate column in live mode)
+    by_inst: dict[str, list] = {}
+    for m in status.get("metrics", []):
+        inst = (m.get("labels") or {}).get("instance")
+        if inst and m["name"] in (
+            "astpu_rpc_server_calls_total",
+            "astpu_dedup_docs_total",
+            "astpu_feed_docs_total",
+            "astpu_lease_results_total",
+        ):
+            by_inst.setdefault(inst, []).append(m)
+    for inst in sorted(by_inst):
+        parts = [
+            f"{m['name'].replace('astpu_', '')}={m['value']:.0f}"
+            for m in by_inst[inst]
+        ]
+        lines.append(f"    {inst:<20} {'  '.join(parts)}")
+    slo = [
+        m for m in status.get("metrics", []) if m["name"] == "astpu_slo_compliant"
+    ]
+    if slo:
+        lines.append("  slo:")
+        for m in sorted(slo, key=_series_key):
+            obj = (m.get("labels") or {}).get("objective", "?")
+            burn = {
+                (x.get("labels") or {}).get("window"): x["value"]
+                for x in status.get("metrics", [])
+                if x["name"] == "astpu_slo_burn_rate"
+                and (x.get("labels") or {}).get("objective") == obj
+            }
+            v = m["value"]
+            state = "NO-DATA" if v < 0 else ("OK " if v else "VIOLATED")
+            lines.append(
+                f"    {obj:<24} {state} burn fast={burn.get('fast', 0):.2f} "
+                f"slow={burn.get('slow', 0):.2f}"
+            )
+    return lines
+
+
+def fleet_summary_line(status: dict, prev: dict | None, dt: float) -> str:
+    """Sticky one-liner for live ``--fleet`` mode: up/total endpoints,
+    dead shards, violated objectives."""
+    eps = status.get("endpoints")
+    if eps is None:
+        return "(not a collector endpoint)"
+    up = sum(1 for e in eps if e.get("ok"))
+    parts = [f"fleet {up}/{len(eps)} up"]
+    dead = status.get("dead_shards") or []
+    if dead:
+        parts.append(f"dead: {','.join(dead)}")
+    bad = [
+        (m.get("labels") or {}).get("objective", "?")
+        for m in status.get("metrics", [])
+        if m["name"] == "astpu_slo_compliant" and not m["value"]
+    ]
+    if bad:
+        parts.append(f"slo violated: {','.join(sorted(bad))}")
+    return " | ".join(parts)
+
+
 def graph_summary_line(status: dict, prev: dict | None, dt: float) -> str:
     """Sticky one-liner for live ``--graph`` mode: total edge depth and
     the hottest stall side per graph."""
@@ -295,6 +384,13 @@ def main(argv=None) -> int:
         "throughput from the runtime's own gauges",
     )
     ap.add_argument(
+        "--fleet",
+        action="store_true",
+        help="fleet view: point --url at a metrics collector "
+        "(tools/obs_fleet.py) for per-process health, harvested crash "
+        "sidecars and SLO verdicts",
+    )
+    ap.add_argument(
         "--frames", type=int, default=0, help="stop after N polls (0 = forever)"
     )
     args = ap.parse_args(argv)
@@ -305,9 +401,15 @@ def main(argv=None) -> int:
         except OSError as e:
             print(f"obs_top: cannot reach {args.url}: {e}", file=sys.stderr)
             return 1
-        lines = render_graph_frame(status) if args.graph else render_frame(status)
-        if args.graph:
-            head = f"obs_top --graph @ {time.strftime('%H:%M:%S', time.localtime(status.get('ts')))}"
+        if args.fleet:
+            lines = render_fleet_frame(status)
+        elif args.graph:
+            lines = render_graph_frame(status)
+        else:
+            lines = render_frame(status)
+        if args.graph or args.fleet:
+            mode = "--fleet" if args.fleet else "--graph"
+            head = f"obs_top {mode} @ {time.strftime('%H:%M:%S', time.localtime(status.get('ts')))}"
             lines = [head] + lines
         print("\n".join(lines))
         return 0
@@ -330,11 +432,13 @@ def main(argv=None) -> int:
             dt = now - t_prev if prev is not None else 0.0
             for msg, bad in watch_events(status, prev):
                 mux.event(red(msg) if bad else green(msg))
-            mux.stats(
-                graph_summary_line(status, prev, dt)
-                if args.graph
-                else summary_line(status, prev, dt)
-            )
+            if args.fleet:
+                sticky = fleet_summary_line(status, prev, dt)
+            elif args.graph:
+                sticky = graph_summary_line(status, prev, dt)
+            else:
+                sticky = summary_line(status, prev, dt)
+            mux.stats(sticky)
             prev, t_prev = status, now
             n += 1
             if args.frames and n >= args.frames:
