@@ -59,7 +59,11 @@ import numpy as np
 from advanced_scrapper_tpu.index.store import NO_DOC, resolve_intra_batch
 from advanced_scrapper_tpu.runtime import FanoutPool
 from advanced_scrapper_tpu.index.wal import WriteAheadLog, replay_wal
-from advanced_scrapper_tpu.net.rpc import RpcClient, RpcUnavailable
+from advanced_scrapper_tpu.net.rpc import (
+    RpcClient,
+    RpcOverloaded,
+    RpcUnavailable,
+)
 
 __all__ = [
     "FleetSpec",
@@ -240,6 +244,9 @@ class ShardedIndexClient:
         connect=None,
         seed: int = 0,
         fs=None,
+        overload_backoff_cap: float = 2.0,
+        overload_budget: float = 45.0,
+        sleep=time.sleep,
     ):
         """``spill_dir`` holds one journal per shard (``shardN-<space>
         .spill``); ``None`` disables the durable journal (spills are then
@@ -253,6 +260,13 @@ class ShardedIndexClient:
         self.health_checks = health_checks
         self.health_timeout = health_timeout
         self.vnodes = vnodes
+        #: overload discipline: an RpcOverloaded answer (or a deadline
+        #: miss while the node still answers pings) backs off IN PLACE —
+        #: capped per wait, bounded per call by ``overload_budget``
+        #: seconds — and never counts toward failover/promotion
+        self.overload_backoff_cap = float(overload_backoff_cap)
+        self.overload_budget = float(overload_budget)
+        self._sleep = sleep
         from advanced_scrapper_tpu.storage.fsio import default_fs
 
         self._fs = fs or default_fs()
@@ -281,6 +295,12 @@ class ShardedIndexClient:
                                 retries=retries,
                                 connect=connect,
                                 seed=seed * 1000 + sid * 10 + k,
+                                # the fleet owns the backoff budget: the
+                                # client's INTERNAL retry-after honoring
+                                # must sleep in fleet-cap units, or one
+                                # call() could overshoot _node_call's
+                                # deadline by retries × its own 5 s cap
+                                overload_wait_cap=self.overload_backoff_cap,
                             ),
                         )
                         for k, addr in enumerate(nodes)
@@ -347,6 +367,20 @@ class ShardedIndexClient:
             "astpu_fleet_backfilled_postings_total",
             "acked-elsewhere postings delivered to returning nodes before "
             "their rejoin",
+        )
+        # always-on (not gated by ASTPU_TELEMETRY): the overload-vs-dead
+        # distinction is exactly what an operator audits in an incident
+        self._m_overload = telemetry.REGISTRY.counter(
+            "astpu_fleet_overload_backoff_total",
+            "node calls answered RpcOverloaded and backed off in place "
+            "(never a failover)",
+            always=True, fleet=fid,
+        )
+        self._m_slow = telemetry.REGISTRY.counter(
+            "astpu_fleet_slow_node_backoff_total",
+            "calls that missed their deadline while the node still "
+            "answered pings — treated as overload, not death",
+            always=True, fleet=fid,
         )
         telemetry.gauge_fn(
             "astpu_fleet_gap_postings",
@@ -520,17 +554,21 @@ class ShardedIndexClient:
             n_done = 0
             for rid, keys, docs in gap:
                 try:
-                    node.client.call(
-                        "insert",
-                        {"space": self.space},
+                    # ONE call-timeout of backoff, not the 45 s default:
+                    # _try_revive runs inline from the probe/insert hot
+                    # path — a returning-but-overloaded node must cost a
+                    # bounded beat, with the next revive round (not this
+                    # caller) finishing the backfill
+                    self._node_call(
+                        sh, node, "insert", {"space": self.space},
                         [keys, docs],
-                        timeout=self.timeout,
                         request_id=f"{rid}@{node.address[0]}:{node.address[1]}",
+                        budget=self.timeout,
                     )
                     n_done += 1
                     backfilled += int(keys.size)
-                except RpcUnavailable:
-                    break
+                except (RpcUnavailable, RpcOverloaded):
+                    break  # node stays out this round
             with sh.lock:
                 # appends-only discipline (like _replay): drop exactly the
                 # prefix we delivered; anything appended meanwhile — or
@@ -642,6 +680,64 @@ class ShardedIndexClient:
 
     # -- RPC fan-out internals --------------------------------------------
 
+    def _node_call(
+        self,
+        sh: _Shard,
+        node: _Node,
+        method: str,
+        header: dict,
+        arrays=(),
+        *,
+        request_id: str | None = None,
+        budget: float | None = None,
+    ):
+        """One node RPC under the overload-vs-dead discipline:
+
+        - :class:`RpcOverloaded` (the node REFUSED admission — provably
+          alive) backs off in place, honoring the retry-after hint,
+          bounded by ``budget`` (default ``overload_budget``) seconds;
+        - :class:`RpcUnavailable` (deadline/connection fault) is only
+          allowed to propagate — and so mark the node dead — when the
+          node ALSO fails a ping; a node that still answers pings is
+          alive-but-slow and gets the same in-place backoff, because
+          failing over a healthy shard under load amplifies the storm
+          onto the survivors and can cascade the fleet.
+
+        Raises :class:`RpcOverloaded` when the budget runs out with the
+        node still alive (the caller decides: another replica, spill, or
+        propagate), :class:`RpcUnavailable` only on true unreachability.
+        """
+        deadline = time.monotonic() + (
+            self.overload_budget if budget is None else budget
+        )
+        wait = 0.05
+        while True:
+            try:
+                return node.client.call(
+                    method,
+                    header,
+                    arrays,
+                    timeout=self.timeout,
+                    request_id=request_id,
+                )
+            except RpcOverloaded as e:
+                self._m_overload.inc()
+                wait = min(
+                    max(e.retry_after, wait), self.overload_backoff_cap
+                )
+            except RpcUnavailable:
+                if not node.client.ping(timeout=self.health_timeout):
+                    raise  # truly dark: the caller's failover path owns it
+                self._m_slow.inc()
+                wait = min(wait * 2, self.overload_backoff_cap)
+            if time.monotonic() + wait > deadline:
+                raise RpcOverloaded(
+                    f"{method} to {node.address[0]}:{node.address[1]} still "
+                    "overloaded after the in-place backoff budget",
+                    retry_after=wait,
+                )
+            self._sleep(wait)
+
     def _shard_probe(
         self, sh: _Shard, keys: np.ndarray, tctx=None
     ) -> np.ndarray:
@@ -664,43 +760,60 @@ class ShardedIndexClient:
     def _shard_probe_inner(self, sh: _Shard, keys: np.ndarray, tctx) -> np.ndarray:
         t0 = time.perf_counter()
         hist = self._m_rpc_s[(sh.sid, "probe")]
-        order: list[_Node] = []
-        with sh.lock:
-            wt = sh.nodes[sh.write_target]
-        if wt.alive and not sh.promoting:
-            order.append(wt)
-        order += [n for n in sh.live_nodes() if n not in order]
+        deadline = time.monotonic() + self.overload_budget
         docs = None
-        for node in order:
-            try:
-                _h, (docs,) = node.client.call(
-                    "probe",
-                    {"space": self.space},
-                    [keys],
-                    timeout=self.timeout,
-                )
-                break
-            except RpcUnavailable:
-                # transport fault only: a deterministic handler error
-                # (RpcRemoteError — bad space, operator typo) must stay
-                # LOUD, never quietly mark a healthy node dead
-                self._note_failure(sh, node)
-        if docs is None:
-            # promotion may still rescue a replica that was merely unproven
-            target = self._ensure_write_target(sh)
-            if target is not None:
+        while docs is None:
+            order: list[_Node] = []
+            with sh.lock:
+                wt = sh.nodes[sh.write_target]
+            if wt.alive and not sh.promoting:
+                order.append(wt)
+            order += [n for n in sh.live_nodes() if n not in order]
+            saw_overload = False
+            # per-node slice of the budget, NOT the whole remainder: an
+            # overloaded write target must not absorb the full 45 s
+            # before an idle replica gets a chance — one call-timeout of
+            # in-place backoff per node per round, then rotate
+            node_budget = max(0.5, min(deadline - time.monotonic(), self.timeout))
+            for node in order:
                 try:
-                    _h, (docs,) = target.client.call(
-                        "probe", {"space": self.space}, [keys],
-                        timeout=self.timeout,
+                    _h, (docs,) = self._node_call(
+                        sh, node, "probe", {"space": self.space}, [keys],
+                        budget=node_budget,
                     )
+                    break
+                except RpcOverloaded:
+                    # alive but refusing/slow: try the next replica, and
+                    # NEVER mark the node dead — an overloaded shard
+                    # failed over would cascade the storm
+                    saw_overload = True
                 except RpcUnavailable:
-                    self._note_failure(sh, target)
-        if docs is None:
-            self._m_degraded.inc(int(keys.size))
-            docs = np.full(keys.shape, -1, np.int64)
-        else:
-            docs = np.asarray(docs, np.int64)
+                    # transport fault with pings also failing: a
+                    # deterministic handler error (RpcRemoteError — bad
+                    # space, operator typo) must stay LOUD, never quietly
+                    # mark a healthy node dead
+                    self._note_failure(sh, node)
+            if docs is None:
+                # promotion may still rescue a replica that was merely
+                # unproven
+                target = self._ensure_write_target(sh)
+                if target is not None and target not in order:
+                    try:
+                        _h, (docs,) = self._node_call(
+                            sh, target, "probe", {"space": self.space},
+                            [keys], budget=node_budget,
+                        )
+                    except RpcOverloaded:
+                        saw_overload = True
+                    except RpcUnavailable:
+                        self._note_failure(sh, target)
+            if docs is None:
+                if saw_overload and time.monotonic() < deadline:
+                    self._sleep(0.05)  # every node overloaded: one more round
+                    continue
+                self._m_degraded.inc(int(keys.size))
+                docs = np.full(keys.shape, -1, np.int64)
+        docs = np.asarray(docs, np.int64)
         with sh.lock:
             # O(probed keys) lookups under the lock — never a full-dict
             # copy, which would make every degraded probe O(spill size)
@@ -760,18 +873,29 @@ class ShardedIndexClient:
         hist = self._m_rpc_s[(sh.sid, "insert")]
         target = self._ensure_write_target(sh)
         acked_ix: set[int] = set()
+        # the overload budget is a PER-CALL bound (the _node_call
+        # docstring's promise): slice it across the replica fan-out so a
+        # 2-replica overloaded shard stalls one insert ~overload_budget
+        # total, not 2× (+ another on the promotion retry)
+        n_live = max(1, len(sh.live_nodes()))
+        node_budget = max(self.timeout, self.overload_budget / (n_live + 1))
         for ix, node in enumerate(list(sh.nodes)):
             if not node.alive:
                 continue
             try:
-                node.client.call(
-                    "insert",
-                    {"space": self.space},
-                    [keys, docs],
-                    timeout=self.timeout,
+                self._node_call(
+                    sh, node, "insert", {"space": self.space}, [keys, docs],
                     request_id=f"{rid}@{node.address[0]}:{node.address[1]}",
+                    budget=node_budget,
                 )
                 acked_ix.add(ix)
+            except RpcOverloaded:
+                # alive but refusing past the in-place budget: missed
+                # this write — the gap-ledger loop below treats any
+                # non-acked node identically (the live-node invariant is
+                # unconditional) — but NO failover count, no
+                # health-check demotion
+                pass
             except RpcUnavailable:
                 self._note_failure(sh, node)
         if not acked_ix and target is not None:
@@ -779,14 +903,15 @@ class ShardedIndexClient:
             target = self._ensure_write_target(sh)
             if target is not None:
                 try:
-                    target.client.call(
-                        "insert",
-                        {"space": self.space},
+                    self._node_call(
+                        sh, target, "insert", {"space": self.space},
                         [keys, docs],
-                        timeout=self.timeout,
                         request_id=f"{rid}@{target.address[0]}:{target.address[1]}",
+                        budget=node_budget,
                     )
                     acked_ix.add(sh.nodes.index(target))
+                except RpcOverloaded:
+                    pass  # still alive: falls through to spill below
                 except RpcUnavailable:
                     self._note_failure(sh, target)
         hist.observe(time.perf_counter() - t0, trace=tctx[0] if tctx else None)
@@ -795,8 +920,19 @@ class ShardedIndexClient:
             with sh.lock:
                 for ix in range(len(sh.nodes)):
                     if ix not in acked_ix:
+                        # an overloaded node that missed an ACKED write
+                        # must still absorb it before it may serve again —
+                        # the live-node invariant (live ⇒ holding every
+                        # acked posting) holds unconditionally, so
+                        # _gap_append sidelines it until the backfill
+                        # drains.  With the in-place budget this is the
+                        # rare tail, not the storm steady state.
                         self._gap_append(sh, ix, rid, keys, docs)
         elif allow_spill:
+            # fully refused (all nodes overloaded) or fully dark: the
+            # spill journal absorbs the batch and replays later — counted
+            # backpressure, never data loss, and for pure overload the
+            # nodes stay alive and unpromoted
             self._spill(sh, keys, docs, rid)
         return acked
 
@@ -951,11 +1087,13 @@ class ShardedIndexClient:
         ids = None
         if target is not None:
             try:
-                _h, (ids,) = target.client.call(
-                    "allocate",
+                _h, (ids,) = self._node_call(
+                    sh, target, "allocate",
                     {"space": self.space, "n": int(n), "floor": floor},
-                    timeout=self.timeout,
                 )
+            except RpcOverloaded:
+                pass  # alive but refusing: degrade like darkness below,
+                #       WITHOUT marking the allocator shard dead
             except RpcUnavailable:
                 self._note_failure(sh, target)
         synced = ids is not None
@@ -986,12 +1124,12 @@ class ShardedIndexClient:
         target = self._ensure_write_target(sh)
         if target is not None:
             try:
-                h, _ = target.client.call(
-                    "floor", {"space": self.space}, timeout=self.timeout
-                )
+                h, _ = self._node_call(sh, target, "floor", {"space": self.space})
                 with self._floor_lock:
                     self._floor_known = True
                     self._floor = max(self._floor, int(h["floor"]))
+            except RpcOverloaded:
+                pass  # keep the cached floor; never a death signal
             except RpcUnavailable:
                 self._note_failure(sh, target)
         with self._floor_lock:
@@ -1008,12 +1146,13 @@ class ShardedIndexClient:
         if target is None:
             return
         try:
-            target.client.call(
-                "log_names",
+            self._node_call(
+                sh, target, "log_names",
                 {"space": self.space, "names": [str(x) for x in names]},
                 [np.asarray(doc_ids, np.uint64)],
-                timeout=self.timeout,
             )
+        except RpcOverloaded:
+            pass  # best-effort sidecar: drop under overload, stay alive
         except RpcUnavailable:
             self._note_failure(sh, target)
 
@@ -1026,9 +1165,12 @@ class ShardedIndexClient:
                 self._ensure_write_target(sh)
             for node in sh.live_nodes():
                 try:
-                    node.client.call(
-                        "checkpoint", {"space": self.space}, timeout=self.timeout
+                    self._node_call(
+                        sh, node, "checkpoint", {"space": self.space},
+                        budget=self.timeout,
                     )
+                except RpcOverloaded:
+                    pass  # durability point deferred, node NOT dead
                 except RpcUnavailable:
                     self._note_failure(sh, node)
 
@@ -1045,20 +1187,21 @@ class ShardedIndexClient:
                 try:
                     off = 0
                     while True:
-                        h, (k, d) = target.client.call(
-                            "dump",
+                        h, (k, d) = self._node_call(
+                            sh, target, "dump",
                             {
                                 "space": self.space,
                                 "offset": off,
                                 "limit": self.REPLAY_CHUNK_POSTINGS,
                             },
-                            timeout=self.timeout,
                         )
                         parts_k.append(np.asarray(k, np.uint64))
                         parts_d.append(np.asarray(d, np.uint64))
                         off += int(np.asarray(k).size)
                         if off >= int(h.get("total", off)) or np.asarray(k).size == 0:
                             break
+                except RpcOverloaded:
+                    pass  # partial dump; verification reruns quiescently
                 except RpcUnavailable:
                     self._note_failure(sh, target)
             with sh.lock:
@@ -1077,9 +1220,12 @@ class ShardedIndexClient:
             st = None
             if target is not None:
                 try:
-                    st, _ = target.client.call(
-                        "stats", {"space": self.space}, timeout=self.timeout
+                    st, _ = self._node_call(
+                        sh, target, "stats", {"space": self.space},
+                        budget=self.timeout,
                     )
+                except RpcOverloaded:
+                    pass
                 except RpcUnavailable:
                     self._note_failure(sh, target)
             out["shards"].append(st)

@@ -60,18 +60,75 @@ class IndexShardServer:
         frame_deadline: float = 30.0,
         name: str = "",
         status_port: int | None = None,
+        max_inflight_inserts: int = 32,
+        insert_rate: float = 0.0,
+        ladder=None,
     ):
         """``status_port`` mirrors the lease server's observability
         sidecar: a small HTTP server beside the RPC socket serving ``GET
         /metrics`` + ``/status`` (0 = ephemeral port, None = only when
         telemetry is enabled) — the per-process endpoint the fleet
-        metrics collector (``obs/collector.py``) scrapes."""
+        metrics collector (``obs/collector.py``) scrapes.
+
+        ``max_inflight_inserts`` bounds concurrently executing write
+        handlers (``insert``/``check_and_add``): request number N+1 gets
+        a counted ``RpcOverloaded`` reject with a retry-after hint
+        instead of a thread and a WAL contention slot — the shard sheds
+        instead of wedging (0 disables).  ``insert_rate`` adds a
+        token-bucket rate cap on the same methods (writes/s; 0 = none).
+        Probes, health pings and the control surface are never gated:
+        an overloaded shard stays readable and provably alive.
+        ``ladder`` (optional
+        :class:`~advanced_scrapper_tpu.runtime.admission.DegradationLadder`)
+        receives the admission pressure signal, so sustained write
+        pressure walks the declared brownout steps."""
         self.dir = directory
         self.name = name or os.path.basename(directory.rstrip("/")) or "shard"
         self._status_port = status_port
         self.status_server = None
         self._lock = threading.Lock()
         self._stopped = False
+        self.admission = None
+        if max_inflight_inserts > 0 or insert_rate > 0:
+            from advanced_scrapper_tpu.runtime.admission import (
+                AdmissionController,
+                DegradationLadder,
+            )
+
+            if ladder is None:
+                # every admission-bounded shard exports a live
+                # astpu_degraded_step series (the SLO engine's brownout
+                # signal).  ONE step only: the shard's sole brownout
+                # lever is shedding low-priority work — declaring the
+                # engine steps (shrink_window/skip_rerank/fewer_bands)
+                # here would emit phantom transitions for degradations
+                # a shard cannot perform, and delay shed_low behind
+                # three inert dwell climbs
+                from advanced_scrapper_tpu.runtime.admission import (
+                    LadderStep,
+                )
+
+                ladder = DegradationLadder(
+                    [LadderStep("shed_low", 0.98, 0.75)],
+                    name=f"shard:{self.name}",
+                )
+            from advanced_scrapper_tpu.runtime.admission import (
+                PRIORITY_NORMAL,
+            )
+
+            self.admission = AdmissionController(
+                rate=insert_rate,
+                max_inflight=max_inflight_inserts,
+                ladder=ladder,
+                # gated writes arrive at NORMAL priority (no per-method
+                # mapping here), so the shed step must shed AT normal or
+                # it would be a declared lever that moves nothing —
+                # under sustained ≥98% write pressure the shard refuses
+                # ALL writes outright (reads/pings untouched) until
+                # pressure calms below the exit threshold
+                shed_at=PRIORITY_NORMAL,
+                name=f"shard:{self.name}",
+            )
         self.indexes: dict[str, PersistentIndex] = {
             sp: PersistentIndex(
                 os.path.join(directory, sp),
@@ -98,6 +155,12 @@ class IndexShardServer:
             max_frame=max_frame,
             frame_deadline=frame_deadline,
             name=f"shard:{self.name}",
+            admission=self.admission,
+            # ONLY the write plane is gated: probes must keep answering
+            # under a write storm (reads are cheap and the fleet's
+            # byte-equality depends on them), and the control surface
+            # (floor/stats/checkpoint) is how operators see the overload
+            admission_methods=frozenset({"insert", "check_and_add"}),
         )
 
     # -- lifecycle ---------------------------------------------------------
@@ -353,6 +416,16 @@ def serve_main(argv=None) -> int:
     ap.add_argument("--compact-segments", type=int, default=8)
     ap.add_argument("--name", default="")
     ap.add_argument(
+        "--max-inflight-inserts", type=int, default=32,
+        help="admission bound on concurrently executing write handlers "
+        "(insert/check_and_add); beyond it requests get a counted "
+        "RpcOverloaded reject with a retry-after hint (0 = unbounded)",
+    )
+    ap.add_argument(
+        "--insert-rate", type=float, default=0.0,
+        help="token-bucket cap on admitted writes/s (0 = unlimited)",
+    )
+    ap.add_argument(
         "--metrics-port", type=int, default=None,
         help="serve GET /metrics + /status beside the RPC socket "
         "(0 = ephemeral; omit = only under ASTPU_TELEMETRY)",
@@ -380,6 +453,8 @@ def serve_main(argv=None) -> int:
         compact_inline=True,  # forked shards: deterministic compaction,
         name=args.name,       # a chaos/SIGKILL target like everything else
         status_port=args.metrics_port,
+        max_inflight_inserts=args.max_inflight_inserts,
+        insert_rate=args.insert_rate,
     ).start()
     if args.port_file:
         from advanced_scrapper_tpu.storage.fsio import atomic_replace

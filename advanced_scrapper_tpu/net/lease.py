@@ -98,12 +98,23 @@ class LeaseServer:
         host: str | None = None,
         port: int | None = None,
         status_port: int | None = None,
+        admission=None,
     ):
         """``status_port`` mirrors the control plane's observability
         endpoints (``GET /metrics`` + ``GET /status``) on a small HTTP
         server beside the TCP lease socket: 0 = ephemeral port, None =
-        only when telemetry is enabled (``ASTPU_TELEMETRY``)."""
+        only when telemetry is enabled (``ASTPU_TELEMETRY``).
+
+        ``admission`` (an
+        :class:`~advanced_scrapper_tpu.runtime.admission.AdmissionController`)
+        sheds lease *grants* under pressure: a refused ``request_tasks``
+        gets an EMPTY ``task_batch`` carrying ``shed: true`` and a
+        ``retry_after`` hint — the client backs its refill loop off
+        instead of hammering, results/heartbeats flow untouched, and the
+        shed is counted.  Leases already held are never reclaimed by
+        admission (that is the TTL reaper's job)."""
         self.cfg = cfg
+        self.admission = admission
         self.host = host if host is not None else cfg.host
         self.port = port if port is not None else cfg.port
         self._status_port = status_port
@@ -176,6 +187,12 @@ class LeaseServer:
             "clients whose leases were reclaimed on heartbeat timeout "
             "(hung-but-connected workers)",
             always=always, server=sid,
+        )
+        self._m_shed = telemetry.REGISTRY.counter(
+            "astpu_lease_shed_grants_total",
+            "lease requests refused admission under pressure (answered "
+            "empty with a retry-after hint)",
+            always=True, server=sid,
         )
         telemetry.gauge_fn(
             "astpu_lease_pending",
@@ -402,11 +419,42 @@ class LeaseServer:
                     continue  # liveness only; the stamp above is the point
                 if kind == "request_tasks":
                     self.stats.record_request()
-                    with _trace.trace_context(*(tctx or (None, None))):
-                        with _trace.span("lease.lease", client=cid):
-                            urls = self._lease(
-                                cid, int(msg.get("num_urls", 1))
+                    adm = None
+                    if self.admission is not None:
+                        depth = None
+                        if self.admission.max_queue > 0:
+                            # only computed when a queue limit will read
+                            # it: summing lens under the lock (no set
+                            # copies) — the refill path is hot under
+                            # exactly the load admission protects
+                            with self._lock:
+                                depth = sum(
+                                    len(u) for u in self._assigned.values()
+                                )
+                        adm = self.admission.admit(queue_depth=depth)
+                        if not adm.admitted:
+                            # shed the GRANT, not the client: empty batch
+                            # + retry-after, counted; the url queue keeps
+                            # its work for whoever is admitted next
+                            self._m_shed.inc()
+                            _send_json(
+                                conn, wlock,
+                                {
+                                    "type": "task_batch", "urls": [],
+                                    "shed": True,
+                                    "retry_after": adm.retry_after,
+                                },
                             )
+                            continue
+                    try:
+                        with _trace.trace_context(*(tctx or (None, None))):
+                            with _trace.span("lease.lease", client=cid):
+                                urls = self._lease(
+                                    cid, int(msg.get("num_urls", 1))
+                                )
+                    finally:
+                        if adm is not None:
+                            self.admission.release(adm)
                     _send_json(conn, wlock, {"type": "task_batch", "urls": urls})
                 elif kind == "result":
                     self.stats.record_response()
@@ -522,6 +570,8 @@ class LeaseClient:
         self._inflight_lock = threading.Lock()
         self._stop = threading.Event()
         self._drained = threading.Event()  # server sent an empty batch
+        self._shed_until = 0.0  # monotonic: no lease requests before this
+        #   (the server shed our grant and told us when to come back)
         self._sock: socket.socket | None = None
         self._wlock = threading.Lock()
         self._threads: list[threading.Thread] = []
@@ -596,7 +646,22 @@ class LeaseClient:
                         return
                     if msg.get("type") == "task_batch":
                         urls = msg.get("urls", [])
-                        if not urls:
+                        if msg.get("shed"):
+                            # an overload shed, NOT a drained queue: honor
+                            # the retry-after before the next request (a
+                            # shed misread as drained would end the run
+                            # with work still queued)
+                            self._shed_until = time.monotonic() + float(
+                                msg.get("retry_after", 0.0)
+                            )
+                            from advanced_scrapper_tpu.obs import telemetry
+
+                            telemetry.event_counter(
+                                "astpu_lease_shed_honored_total",
+                                "shed lease grants whose retry-after the "
+                                "client honored",
+                            ).inc()
+                        elif not urls:
                             self._drained.set()
                         for u in urls:
                             self._tasks.put(u)
@@ -678,7 +743,10 @@ class LeaseClient:
                     and inflight == 0
                 ):
                     break
-                if self._tasks.qsize() < self.cfg.min_queue_length:
+                if (
+                    self._tasks.qsize() < self.cfg.min_queue_length
+                    and time.monotonic() >= self._shed_until
+                ):
                     try:
                         _send_json(
                             self._sock,

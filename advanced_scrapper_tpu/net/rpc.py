@@ -47,6 +47,7 @@ __all__ = [
     "DEFAULT_MAX_FRAME",
     "RpcClient",
     "RpcError",
+    "RpcOverloaded",
     "RpcRemoteError",
     "RpcServer",
     "RpcUnavailable",
@@ -76,6 +77,19 @@ class RpcRemoteError(RpcError):
     def __init__(self, etype: str, message: str):
         super().__init__(f"{etype}: {message}")
         self.etype = etype
+
+
+class RpcOverloaded(RpcError):
+    """The peer refused admission (overload shed), answering a counted
+    reject with a ``retry_after`` hint instead of timing out.  Retriable
+    — the handler never executed — and NEVER a node-death signal: the
+    fleet client backs off in place on this, it must not fail over or
+    promote (an overloaded-but-alive shard failed over would dump its
+    load onto the survivors and cascade)."""
+
+    def __init__(self, message: str, retry_after: float = 0.0):
+        super().__init__(message)
+        self.retry_after = max(0.0, float(retry_after))
 
 
 class FrameTooLarge(RpcError):
@@ -213,6 +227,18 @@ class RpcServer:
 
     Every server answers ``__ping__`` natively — the health-check /
     promotion probe needs no handler wiring.
+
+    **Admission** (``admission=``, a
+    :class:`~advanced_scrapper_tpu.runtime.admission.AdmissionController`):
+    each request to a gated method (``admission_methods``; None = all)
+    must be admitted before it may claim the idempotency table or run a
+    handler; a refusal answers a counted ``RpcOverloaded`` error frame
+    carrying the retry-after hint, and is deliberately NOT cached under
+    the request id — the same id retried later must get a fresh
+    admission decision.  ``__ping__`` always bypasses admission: an
+    overloaded server must stay provably alive, or overload becomes
+    indistinguishable from death and triggers failover.
+    ``method_priority`` maps method → priority class (default NORMAL).
     """
 
     def __init__(
@@ -226,8 +252,16 @@ class RpcServer:
         idle_timeout: float = 300.0,
         idempotent_cache: int = 512,
         name: str = "rpc",
+        admission=None,
+        admission_methods=None,
+        method_priority: dict[str, int] | None = None,
     ):
         self.handlers = dict(handlers)
+        self.admission = admission
+        self.admission_methods = (
+            None if admission_methods is None else frozenset(admission_methods)
+        )
+        self.method_priority = dict(method_priority or {})
         self.host = host
         self.port = port
         self.max_frame = max_frame
@@ -246,6 +280,7 @@ class RpcServer:
         self._conns_lock = threading.Lock()
         self.calls = 0          # handler executions (not replays)
         self.replays = 0        # idempotent cache hits
+        self.overload_rejects = 0  # admission refusals answered
         self._instrument()
 
     def _instrument(self) -> None:
@@ -263,7 +298,23 @@ class RpcServer:
             "astpu_rpc_server_errors_total", "handler exceptions answered as errors",
             server=self.name,
         )
+        # always-on (like every admission counter): an overload reject
+        # during an incident must be visible with telemetry off
+        self._m_overload: dict[str, object] = {}  # method → reject counter
         self._m_seconds: dict[str, object] = {}  # method → latency histogram
+
+    def _overload_counter(self, method: str):
+        c = self._m_overload.get(method)
+        if c is None:
+            from advanced_scrapper_tpu.obs import telemetry
+
+            c = telemetry.REGISTRY.counter(
+                "astpu_rpc_overload_rejects_total",
+                "requests refused admission and answered RpcOverloaded",
+                always=True, server=self.name, method=method,
+            )
+            self._m_overload[method] = c
+        return c
 
     def _method_seconds(self, method: str):
         """Per-method server-side latency histogram (lazy: the method set
@@ -400,82 +451,10 @@ class RpcServer:
                 # propagated trace context (popped: handlers never see the
                 # transport's trace plumbing in their header dict)
                 tctx = _trace.context_from_wire(header.pop("_trace", None))
-                if rid is not None:
-                    state, val = self._claim(rid)
-                    if state == "hit":
-                        self.replays += 1
-                        self._m_replays.inc()
-                        # the retry carried the SAME trace header as the
-                        # original attempt; record the replay under it so
-                        # a stitched trace shows the dedup, not a gap
-                        _trace.record(
-                            "event", "rpc.replay",
-                            server=self.name, method=method, rid=rid,
-                            **({"trace": tctx[0]} if tctx else {}),
-                        )
-                        send_frame(conn, val[0], val[1])
-                        continue
-                    if state == "wait":
-                        # a timeout retry of a request whose FIRST
-                        # execution is still running: executing again
-                        # would double-apply, so wait for its result and
-                        # replay; if it outlives the frame budget, drop
-                        # this connection — the next retry finds the cache
-                        if val.wait(self.frame_deadline):
-                            hit = self._cached(rid)
-                            if hit is not None:
-                                self.replays += 1
-                                self._m_replays.inc()
-                                send_frame(conn, hit[0], hit[1])
-                                continue
-                        return
-                resp_h: dict
-                resp_a: list = []
-                if method == "__ping__":
-                    resp_h = {"id": rid, "ok": True, "pong": True}
-                elif method not in self.handlers:
-                    resp_h = {
-                        "id": rid,
-                        "error": f"no such method {method!r}",
-                        "etype": "KeyError",
-                    }
-                else:
-                    # server-side span under the PROPAGATED context: the
-                    # handler thread has no ambient trace of its own, so a
-                    # span here carrying the client's trace id proves the
-                    # id crossed the socket — the stitched-trace half of
-                    # the observability plane
-                    t0 = time.perf_counter()
-                    try:
-                        with _trace.trace_context(*(tctx or (None, None))):
-                            with _trace.span(
-                                f"rpc.{method}", server=self.name, rid=rid
-                            ):
-                                out = self.handlers[method](header, arrays)
-                        if isinstance(out, tuple):
-                            resp_h, resp_a = dict(out[0]), list(out[1])
-                        else:
-                            resp_h, resp_a = dict(out or {}), []
-                        resp_h.setdefault("ok", True)
-                        resp_h["id"] = rid
-                        self.calls += 1
-                        self._m_calls.inc()
-                    except Exception as e:  # answered, not fatal
-                        self._m_errors.inc()
-                        resp_h = {
-                            "id": rid,
-                            "error": str(e),
-                            "etype": type(e).__name__,
-                        }
-                    self._method_seconds(method).observe(
-                        time.perf_counter() - t0,
-                        trace=tctx[0] if tctx else None,
-                    )
-                # remember BEFORE sending: a cut mid-response must replay
-                # the same bytes, not re-execute the handler
-                if rid is not None:
-                    self._remember(rid, (resp_h, resp_a))
-                send_frame(conn, resp_h, resp_a)
+                if not self._handle_request(
+                    conn, header, arrays, rid, method, tctx
+                ):
+                    return
         except (ConnectionError, OSError, json.JSONDecodeError):
             pass
         finally:
@@ -485,6 +464,159 @@ class RpcServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _unclaim(self, rid: str) -> None:
+        """Withdraw an in-flight claim that will never execute (admission
+        refused it): wake any waiting duplicate — it finds no cached
+        response, drops its connection, and the NEXT retry claims and
+        re-attempts admission fresh."""
+        with self._cache_lock:
+            ev = self._inflight.pop(rid, None)
+        if ev is not None:
+            ev.set()
+
+    def _handle_request(self, conn, header, arrays, rid, method, tctx) -> bool:
+        """Claim → admit → execute-or-replay → respond for one request.
+        Returns False when the connection must be dropped (a
+        still-in-flight duplicate outlived the frame budget).
+
+        Order is load-bearing: the idempotency claim comes FIRST, so the
+        replay and wait-for-duplicate paths — which run no handler —
+        never consume an admission slot (a retried slow insert parked in
+        ``val.wait`` must not hold a ``max_inflight`` seat and amplify
+        the very storm admission damps); only the "mine" executor pays
+        admission, and a refusal withdraws the claim so waiters and
+        later retries get a fresh decision."""
+        from advanced_scrapper_tpu.obs import trace as _trace
+
+        if rid is not None:
+            state, val = self._claim(rid)
+            if state == "hit":
+                self.replays += 1
+                self._m_replays.inc()
+                # the retry carried the SAME trace header as the
+                # original attempt; record the replay under it so
+                # a stitched trace shows the dedup, not a gap
+                _trace.record(
+                    "event", "rpc.replay",
+                    server=self.name, method=method, rid=rid,
+                    **({"trace": tctx[0]} if tctx else {}),
+                )
+                send_frame(conn, val[0], val[1])
+                return True
+            if state == "wait":
+                # a timeout retry of a request whose FIRST
+                # execution is still running: executing again
+                # would double-apply, so wait for its result and
+                # replay; if it outlives the frame budget, drop
+                # this connection — the next retry finds the cache
+                if val.wait(self.frame_deadline):
+                    hit = self._cached(rid)
+                    if hit is not None:
+                        self.replays += 1
+                        self._m_replays.inc()
+                        send_frame(conn, hit[0], hit[1])
+                        return True
+                return False
+        adm = None
+        if (
+            self.admission is not None
+            and method != "__ping__"
+            and (
+                self.admission_methods is None
+                or method in self.admission_methods
+            )
+        ):
+            from advanced_scrapper_tpu.runtime.admission import (
+                PRIORITY_NORMAL,
+            )
+
+            adm = self.admission.admit(
+                self.method_priority.get(method, PRIORITY_NORMAL)
+            )
+            if not adm.admitted:
+                # counted reject + retry-after hint.  Deliberately NOT
+                # remembered under rid (claim withdrawn): a later retry
+                # of the same request must get a fresh admission
+                # decision, never a replayed refusal.
+                if rid is not None:
+                    self._unclaim(rid)
+                self.overload_rejects += 1
+                self._overload_counter(method).inc()
+                send_frame(
+                    conn,
+                    {
+                        "id": rid,
+                        "error": (
+                            f"{self.name}: {method} refused "
+                            f"admission ({adm.reason})"
+                        ),
+                        "etype": "RpcOverloaded",
+                        "retry_after": adm.retry_after,
+                    },
+                )
+                return True
+        try:
+            return self._execute_and_respond(
+                conn, header, arrays, rid, method, tctx
+            )
+        finally:
+            if adm is not None:
+                self.admission.release(adm)
+
+    def _execute_and_respond(
+        self, conn, header, arrays, rid, method, tctx
+    ) -> bool:
+        from advanced_scrapper_tpu.obs import trace as _trace
+
+        resp_h: dict
+        resp_a: list = []
+        if method == "__ping__":
+            resp_h = {"id": rid, "ok": True, "pong": True}
+        elif method not in self.handlers:
+            resp_h = {
+                "id": rid,
+                "error": f"no such method {method!r}",
+                "etype": "KeyError",
+            }
+        else:
+            # server-side span under the PROPAGATED context: the
+            # handler thread has no ambient trace of its own, so a
+            # span here carrying the client's trace id proves the
+            # id crossed the socket — the stitched-trace half of
+            # the observability plane
+            t0 = time.perf_counter()
+            try:
+                with _trace.trace_context(*(tctx or (None, None))):
+                    with _trace.span(
+                        f"rpc.{method}", server=self.name, rid=rid
+                    ):
+                        out = self.handlers[method](header, arrays)
+                if isinstance(out, tuple):
+                    resp_h, resp_a = dict(out[0]), list(out[1])
+                else:
+                    resp_h, resp_a = dict(out or {}), []
+                resp_h.setdefault("ok", True)
+                resp_h["id"] = rid
+                self.calls += 1
+                self._m_calls.inc()
+            except Exception as e:  # answered, not fatal
+                self._m_errors.inc()
+                resp_h = {
+                    "id": rid,
+                    "error": str(e),
+                    "etype": type(e).__name__,
+                }
+            self._method_seconds(method).observe(
+                time.perf_counter() - t0,
+                trace=tctx[0] if tctx else None,
+            )
+        # remember BEFORE sending: a cut mid-response must replay
+        # the same bytes, not re-execute the handler
+        if rid is not None:
+            self._remember(rid, (resp_h, resp_a))
+        send_frame(conn, resp_h, resp_a)
+        return True
 
 
 class RpcClient:
@@ -510,12 +642,19 @@ class RpcClient:
         connect: Callable | None = None,
         seed: int = 0,
         sleep=time.sleep,
+        overload_wait_cap: float = 5.0,
     ):
         self.address = tuple(address)
         self.timeout = timeout
         self.retries = retries
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
+        #: ceiling on any single retry-after honor: a peer hinting "come
+        #: back in 200 s" (a triggered pause, a near-zero insert_rate)
+        #: must not park one call() for that long — the client sleeps at
+        #: most this, retries, and surfaces RpcOverloaded (hint intact)
+        #: if still refused, letting the CALLER's budget discipline rule
+        self.overload_wait_cap = float(overload_wait_cap)
         self.max_frame = max_frame
         self.sleep = sleep
         self._connect = connect
@@ -541,6 +680,19 @@ class RpcClient:
         self._m_retries = telemetry.counter(
             "astpu_rpc_client_retries_total",
             "call attempts beyond the first (timeouts + connection faults)",
+        )
+        # always-on: overload behaviour must be auditable in an incident
+        # (the loadgen/crashsweep acceptance reads these to prove the
+        # client actually honored the server's retry-after hints)
+        self._m_overloaded = telemetry.REGISTRY.counter(
+            "astpu_rpc_client_overloaded_total",
+            "responses refused admission by the peer (RpcOverloaded)",
+            always=True,
+        )
+        self._m_overload_wait = telemetry.REGISTRY.counter(
+            "astpu_rpc_overload_backoff_seconds_total",
+            "seconds slept honoring peer retry-after hints",
+            always=True,
         )
 
     # -- connection --------------------------------------------------------
@@ -613,11 +765,20 @@ class RpcClient:
             cap=self.backoff_cap,
             seed=f"{self._seed}|{rid}",
         )
+        # overload backoffs have their own budget (retriable even for
+        # non-idempotent calls — the handler never executed) and their
+        # own deterministic jitter stream; the peer's retry-after hint is
+        # the floor of every wait
+        ov_delays = backoff_delays(
+            self.retries,
+            base=self.backoff_base,
+            cap=self.backoff_cap,
+            seed=f"{self._seed}|{rid}|overload",
+        )
         last: Exception | None = None
-        for attempt in range(attempts):
-            if attempt:
-                self._m_retries.inc()
-                self.sleep(delays[attempt - 1])
+        transport_tries = 0
+        overload_tries = 0
+        while True:
             try:
                 with self._lock:
                     sock = self._ensure_sock()
@@ -630,14 +791,37 @@ class RpcClient:
                     raise ConnectionError("server closed the connection")
                 h, a = resp
                 if h.get("error") is not None:
+                    if h.get("etype") == "RpcOverloaded":
+                        raise RpcOverloaded(
+                            h["error"], h.get("retry_after", 0.0)
+                        )
                     raise RpcRemoteError(h.get("etype", "Error"), h["error"])
                 return h, a
+            except RpcOverloaded as e:
+                # counted reject from the peer: back off at least its
+                # retry-after hint and retry under the SAME request id —
+                # never a node failure, so never RpcUnavailable
+                overload_tries += 1
+                self._m_overloaded.inc()
+                if overload_tries > self.retries:
+                    raise
+                wait = min(
+                    max(e.retry_after, ov_delays[overload_tries - 1]),
+                    self.overload_wait_cap,
+                )
+                self._m_overload_wait.inc(wait)
+                self.sleep(wait)
             except RpcRemoteError:
                 raise
             except (ConnectionError, OSError, socket.timeout, RpcError) as e:
                 last = e
                 with self._lock:
                     self._drop_sock()
+                transport_tries += 1
+                if transport_tries >= attempts:
+                    break
+                self._m_retries.inc()
+                self.sleep(delays[transport_tries - 1])
         raise RpcUnavailable(
             f"{method} to {self.address} failed after {attempts} attempts: {last}"
         )

@@ -163,10 +163,17 @@ def device_counters() -> dict[str, float]:
 
 
 def _clear_for_tests() -> None:
-    """Drop the handle cache and baselines — required after a test calls
-    ``telemetry.REGISTRY.reset()``, or cached handles would keep feeding
-    histograms the registry no longer exports."""
+    """Drop the handle cache and baselines after a registry reset, or
+    cached handles would keep feeding histograms (and device counters)
+    the registry no longer exports.  Runs AUTOMATICALLY on every
+    ``telemetry.REGISTRY.reset()`` via the reset hook below — the manual
+    call-it-yourself contract was a real test-ordering trap (an early
+    test's reset silently zeroed every later test's device-ledger
+    deltas)."""
     with _lock:
         _hists.clear()
         _baseline.clear()
         _dev_counters.clear()
+
+
+telemetry.REGISTRY.add_reset_hook(_clear_for_tests)

@@ -333,6 +333,11 @@ class Registry:
         self._metrics: dict[tuple, object] = {}
         self._callbacks: dict[tuple, _CallbackGauge] = {}
         self._enabled = enabled  # None → resolve from ASTPU_TELEMETRY lazily
+        self._reset_hooks: list = []  # see reset(): handle-cache droppers
+        #: bumped by reset(): handle-caching instrumenters (the admission
+        #: plane) compare it lazily and re-instrument on first use after
+        #: a reset — dormant objects never pollute a fresh registry
+        self.generation = 0
 
     # -- gating ------------------------------------------------------------
 
@@ -497,10 +502,30 @@ class Registry:
 
     def reset(self) -> None:
         """Drop every metric and callback (tests only — production metrics
-        are cumulative for the life of the process)."""
+        are cumulative for the life of the process).  Modules that cache
+        metric HANDLES (``obs/stages.py``'s always-on device counters)
+        register a reset hook so their caches drop with the registry —
+        otherwise a reset orphans the cached objects and later
+        increments land outside :meth:`find`'s view (a real test-ordering
+        bug this hook retired)."""
         with self._lock:
             self._metrics.clear()
             self._callbacks.clear()
+            self.generation += 1
+        keep = []
+        for fn in list(self._reset_hooks):
+            try:
+                # a hook returning False unregisters itself (how
+                # per-instance hooks — a dead AdmissionController's
+                # re-instrumenter — avoid accumulating forever)
+                if fn() is not False:
+                    keep.append(fn)
+            except Exception:
+                keep.append(fn)
+        self._reset_hooks = keep
+
+    def add_reset_hook(self, fn) -> None:
+        self._reset_hooks.append(fn)
 
 
 #: the process-wide registry every layer instruments against

@@ -113,6 +113,14 @@ class NearDupEngine:
         #: every resolution path passes its candidate matrix through it
         #: before union-find (None = pass-through)
         self.rerank_hook = None
+        #: optional :class:`~advanced_scrapper_tpu.runtime.admission.
+        #: DegradationLadder` — when installed, the engine honours the
+        #: declared brownout steps at its decision points: a halved
+        #: dispatch window ("shrink_window"), a bypassed rerank tier
+        #: ("skip_rerank"), and half the LSH bands on the stream-index
+        #: path ("fewer_bands"); each application is counted via
+        #: ``ladder.count_effect`` and reverses the moment the step exits
+        self.ladder = None
         #: optional per-tile observer ``(dict) -> None`` on the dispatch
         #: executor loop (tile index, rows, width, h2d_bytes, put/dispatch
         #: ms) — ``tools/profile_hostpath.py --device`` renders it as a
@@ -499,9 +507,38 @@ class NearDupEngine:
 
             def dispatch(running, item):
                 dev, rows, w, _nb, _pms = item
-                return step(
+                out = step(
                     running, dev, rows=rows, width=w, num_articles=n_bucket
                 )
+                # counted on success, INSIDE the fn: the OOM-backoff
+                # ladder then ledgers exactly its leaf dispatches
+                stages.count_dispatch("dedup")
+                return out
+
+            def split_packed(item):
+                """Device-OOM halving: D2H the packed buffer, re-pack as
+                two half-row tiles, re-put.  Each sub-item carries its
+                TRUE row count — on an odd-row tile (non-power-of-two
+                ``block_len`` configs) the halves differ by one row, and
+                a mislabeled count would shift the trailer decode.  For
+                the default power-of-two shapes the halves stay inside
+                the prewarmed set (no recompile storm); odd shapes may
+                compile a backoff variant once.  The extra puts/bytes
+                land on the always-on device ledger like any transfer."""
+                dev, rows, w, _nb, _pms = item
+                buf = np.asarray(dev)
+                tok = buf[: rows * w].reshape(rows, w)
+                trailer = buf[rows * w :].view("<i4").reshape(2, rows)
+                half = rows // 2
+                out = []
+                for lo, hi in ((0, half), (half, rows)):
+                    sl = slice(lo, hi)
+                    pb = pack_tile(tok[sl], trailer[0, sl], trailer[1, sl])
+                    with stages.timed("h2d"):
+                        d = jax.device_put(pb)
+                    stages.count_device_put(pb.nbytes, "dedup")
+                    out.append((d, hi - lo, w, pb.nbytes, 0.0))
+                return out
         else:
             # legacy tile transport (parity certification / escape hatch):
             # three serialized puts + two dispatches per tile, same bytes
@@ -535,21 +572,48 @@ class NearDupEngine:
 
         running = jnp.full((n_bucket, params.num_perm), U32_MAX, jnp.uint32)
         dispatched = 0
+        window = cfg.dispatch_window
+        if self.ladder is not None and self.ladder.active("shrink_window"):
+            # brownout step 1: halve the in-flight dispatch window —
+            # less resident device memory, more backpressure upstream
+            from advanced_scrapper_tpu.pipeline.dispatch import (
+                resolve_dispatch_window,
+            )
+
+            window = max(
+                1, resolve_dispatch_window(cfg.dispatch_window, put_workers) // 2
+            )
+            self.ladder.count_effect("shrink_window")
         pipe = PipelinedDispatcher(
             host_batches(),
             pack=pack,
             put=put,
             put_workers=put_workers,
-            window=cfg.dispatch_window,
+            window=window,
         )
+        from advanced_scrapper_tpu.pipeline.dispatch import (
+            dispatch_with_oom_backoff,
+        )
+
         try:
             for item in pipe:
                 rows = int(item[0].shape[0]) if not packed_mode else item[1]
                 t0 = time.perf_counter()
                 with stages.timed("kernel"), self.step_timer.step(rows):
                     # async dispatch; device waits land here
-                    running = dispatch(running, item)
-                stages.count_dispatch("dedup")
+                    if packed_mode:
+                        # RESOURCE_EXHAUSTED halves the tile (re-pack,
+                        # re-put, retry — byte-identical fold) down to
+                        # the 64-row floor, then fails cleanly
+                        running = dispatch_with_oom_backoff(
+                            dispatch, running, item,
+                            split=split_packed,
+                            rows_of=lambda it: it[1],
+                        )
+                    else:
+                        running = dispatch(running, item)
+                if not packed_mode:
+                    stages.count_dispatch("dedup")
                 if probe is not None:
                     probe(
                         {
@@ -636,10 +700,16 @@ class NearDupEngine:
             )
             stages.count_dispatch("dedup")
         if self.rerank_hook is not None:
-            # the declared RERANK_HOOK_EDGE: candidates flow through the
-            # rerank tier before EITHER resolution path sees them
-            with trace.span("dedup.rerank", trace=tid, docs=n):
-                rep_bands = self.rerank_hook(raw, sigs, rep_bands, valid)
+            if self.ladder is not None and self.ladder.active("skip_rerank"):
+                # brownout step 2: the precision tier is bypassed under
+                # sustained pressure — candidates pass through unreranked
+                # (counted; reverses the moment the step exits)
+                self.ladder.count_effect("skip_rerank")
+            else:
+                # the declared RERANK_HOOK_EDGE: candidates flow through
+                # the rerank tier before EITHER resolution path sees them
+                with trace.span("dedup.rerank", trace=tid, docs=n):
+                    rep_bands = self.rerank_hook(raw, sigs, rep_bands, valid)
         return raw, sigs, keys, valid, rep_bands, n_bucket, tid
 
     def dedup_reps_async(self, texts: Sequence[str | bytes], *, _regime: str = "async"):
@@ -1011,6 +1081,19 @@ class NearDupEngine:
             raw, wide=True, sync_sigs=False
         )
         keys64 = pack_keys64(keys_wide)
+        if (
+            self.ladder is not None
+            and self.ladder.active("fewer_bands")
+            and keys64.ndim == 2
+            and keys64.shape[1] > 1
+        ):
+            # brownout step 3: probe/post only the first half of the LSH
+            # bands — a declared recall brownout (fewer probe rows, fewer
+            # postings) that reverses when the step exits; rows posted
+            # while degraded keep their reduced band set, which is the
+            # counted cost of staying up
+            keys64 = keys64[:, : max(1, keys64.shape[1] // 2)]
+            self.ladder.count_effect("fewer_bands", n)
         eligible = np.fromiter(
             (len(r) >= self.params.shingle_k for r in raw), bool, n
         )

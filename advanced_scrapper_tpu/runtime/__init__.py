@@ -18,6 +18,13 @@ order does it all stop).  The runtime answers them once:
   ``obs/trace`` flight recorder before a chaos death (``fsio._die``).
 - :class:`PauseGate` — the deadline-based global pause (the scraper's
   rate-limit circuit breaker), now a runtime primitive any stage can honour.
+- :class:`AdmissionController` / :class:`DegradationLadder`
+  (``runtime/admission.py``) — the overload plane: token-bucket +
+  concurrency + queue-depth admission with priority classes and counted
+  retry-after rejects (PauseGate generalized; its surface and telemetry
+  names flow through), plus declared brownout steps with enter/exit
+  hysteresis that consumers (RPC server, shard server, lease server,
+  dedup engine) honour at their decision points.
 - :class:`FanoutPool` — a tiny Edge-fed executor for bounded parallel
   fan-out (the index fleet's per-shard RPCs ride it), so remote hops use
   the same queue abstraction as local stages.
@@ -35,6 +42,16 @@ Layering: the runtime sits above ``obs`` only — it must never import
 ``tools/lint_imports.py``); those layers import *it*.
 """
 
+from advanced_scrapper_tpu.runtime.admission import (
+    PRIORITY_CRITICAL,
+    PRIORITY_HIGH,
+    PRIORITY_LOW,
+    PRIORITY_NORMAL,
+    AdmissionController,
+    AdmissionDecision,
+    DegradationLadder,
+    LadderStep,
+)
 from advanced_scrapper_tpu.runtime.graph import (
     DONE,
     RETRY,
@@ -49,10 +66,18 @@ from advanced_scrapper_tpu.runtime.pause import PauseGate
 
 __all__ = [
     "DONE",
+    "PRIORITY_CRITICAL",
+    "PRIORITY_HIGH",
+    "PRIORITY_LOW",
+    "PRIORITY_NORMAL",
     "RETRY",
+    "AdmissionController",
+    "AdmissionDecision",
+    "DegradationLadder",
     "Edge",
     "EdgeClosed",
     "FanoutPool",
+    "LadderStep",
     "PauseGate",
     "StageGraph",
     "live_graphs",

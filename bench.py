@@ -769,7 +769,45 @@ def _bench_slo_engine():
             "threshold": 0.01,
         }
     )
+    objectives.append(
+        {
+            # the declared reject-ratio objective of the overload plane:
+            # a bench run is UNLOADED relative to its own capacity, so
+            # any admission activity it does produce must stay almost
+            # entirely admitted — sheds belong to storms, not benches
+            "name": "admission_reject_ratio",
+            "kind": "ratio_max",
+            "metric": "astpu_admission_rejected_total",
+            "denominator": "astpu_admission_requests_total",
+            "threshold": 0.05,
+        }
+    )
     return SloEngine(objectives)
+
+
+def _admission_counters() -> dict:
+    """Always-on overload-plane totals (admitted/rejected/degraded-step)
+    — snapshotted per regime like the device counters, so every result
+    JSON states what the admission plane did during that regime."""
+    from advanced_scrapper_tpu.obs import telemetry
+    from advanced_scrapper_tpu.obs.slo import SloEngine
+
+    def total(name, **labels):
+        return sum(
+            m.value
+            for m in telemetry.REGISTRY.find(name)
+            if all(m.labels.get(k) == v for k, v in labels.items())
+        )
+
+    step = 0.0
+    for name, _labels, v in SloEngine.registry_samples():
+        if name == "astpu_degraded_step":
+            step = max(step, v)
+    return {
+        "admitted": total("astpu_admission_requests_total", outcome="admitted"),
+        "rejected": total("astpu_admission_requests_total", outcome="rejected"),
+        "degraded_step": step,
+    }
 
 
 def _telemetry_ledger(slo_engine) -> dict:
@@ -877,11 +915,28 @@ def main(argv=None) -> None:
         note(f"platform={platform} devices={len(jax.devices())} batch={batch}")
         with xla_trace(os.environ.get("ASTPU_TRACE_DIR") or None):
             uniform = None
+            # overload-plane ledger per regime: admitted/rejected/
+            # degraded-step deltas ride every regime's result keys (the
+            # declared reject-ratio SLO is evaluated in the end-of-run
+            # verdict under out["telemetry"]["slo"])
+            _adm_last = _admission_counters()
+
+            def _adm_delta(prefix: str) -> dict:
+                nonlocal _adm_last
+                now = _admission_counters()
+                out = {
+                    f"{prefix}_admitted": now["admitted"] - _adm_last["admitted"],
+                    f"{prefix}_rejected": now["rejected"] - _adm_last["rejected"],
+                    f"{prefix}_degraded_step": now["degraded_step"],
+                }
+                _adm_last = now
+                return out
             if "uniform" in want:
                 uniform = _bench_uniform(jax, mesh, params, backend, batch, block)
                 note(f"uniform done: {uniform:.0f}/s")
                 out["value"] = round(uniform, 1)
                 out["vs_baseline"] = round(uniform / 50000.0, 4)
+                out.update(_adm_delta("uniform"))
             # stage_ms: per-stage wall attribution over the two host-path
             # regimes (ragged + stream; obs/stages.py on what the numbers
             # mean), so the next PR can see where the remaining time goes
@@ -905,6 +960,7 @@ def main(argv=None) -> None:
                     ),
                 }
 
+
             if "ragged" in want:
                 ragged_warm, ragged, ragged_dc = _bench_ragged(
                     1024 if quick else 8192
@@ -921,6 +977,7 @@ def main(argv=None) -> None:
                 out.update(
                     {f"ragged_{k}": v for k, v in ragged_dc.items()}
                 )
+                out.update(_adm_delta("ragged"))
             if "stream" in want:
                 dc = stages.device_counters()
                 stream = _bench_stream(
@@ -935,6 +992,7 @@ def main(argv=None) -> None:
                 out["stream_warmup_s"] = round(warm_s, 3)
                 out["stream_vs_baseline"] = round(stream / 50000.0, 4)
                 out.update(_dev_delta(dc, "stream"))
+                out.update(_adm_delta("stream"))
             stage_ms = {k: 0.0 for k in ("encode", "h2d", "kernel", "resolve")}
             stage_ms.update(stages.snapshot_ms())
             if "recall" in want:
@@ -951,6 +1009,7 @@ def main(argv=None) -> None:
                 out["precision_vs_oracle"] = round(precision, 4)
                 out["precision_oracle"] = round(precision_oracle, 4)
                 out["unchained_merges"] = unchained
+                out.update(_adm_delta("recall"))
             if "exact" in want:
                 (
                     exact, exact_vs_pandas, exact_ms, pandas_ms,
@@ -973,6 +1032,7 @@ def main(argv=None) -> None:
                 out["exact_backend"] = exact_backend
                 if exact_reason:
                     out["exact_backend_reason"] = exact_reason
+                out.update(_adm_delta("exact"))
             if "matcher" in want:
                 stages.reset()
                 matcher_warm, matcher, matcher_dc = _bench_matcher(
@@ -991,6 +1051,7 @@ def main(argv=None) -> None:
                 out["matcher_warmup_articles_per_sec"] = round(matcher_warm, 1)
                 # steady-state window only, matching the rate split
                 out.update({f"matcher_{k}": v for k, v in matcher_dc.items()})
+                out.update(_adm_delta("matcher"))
             if "index" in want:
                 idx = _bench_index(8192 if quick else 65536)
                 note(
@@ -999,6 +1060,7 @@ def main(argv=None) -> None:
                     f"reopen {idx['index_reopen_ms']:.1f}ms"
                 )
                 out.update(idx)
+                out.update(_adm_delta("index"))
             if "fleet" in want:
                 flt = _bench_fleet(8192 if quick else 32768)
                 note(
@@ -1007,6 +1069,7 @@ def main(argv=None) -> None:
                     f"(2 shards × 2 replicas over loopback RPC)"
                 )
                 out.update(flt)
+                out.update(_adm_delta("fleet"))
     except Exception as e:
         # A tunnel that came up can still die between dispatches (it has).
         # Better one labeled cpu-fallback line than no round record at all.

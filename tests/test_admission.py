@@ -1,0 +1,503 @@
+"""Admission controller + degradation ladder: the overload plane's core.
+
+Covers the tentpole primitive (`runtime/admission.py`): token-bucket /
+concurrency / queue-depth admission with priority classes and counted
+retry-after rejects; the PauseGate→AdmissionController compatibility
+contract (trigger/remaining/wait semantics and telemetry names
+byte-stable through the new primitive); ladder enter/exit hysteresis
+(no flapping under oscillating load); and the engine-level brownout
+hooks (shrink_window / skip_rerank / fewer_bands honored by
+NearDupEngine, reversibly).
+"""
+
+import numpy as np
+import pytest
+
+from advanced_scrapper_tpu.obs import telemetry, trace
+from advanced_scrapper_tpu.runtime import PauseGate
+from advanced_scrapper_tpu.runtime.admission import (
+    DEFAULT_LADDER_STEPS,
+    PRIORITY_CRITICAL,
+    PRIORITY_LOW,
+    PRIORITY_NORMAL,
+    AdmissionController,
+    DegradationLadder,
+    LadderStep,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture
+def live_registry():
+    telemetry.REGISTRY.reset()
+    telemetry.set_enabled(True)
+    trace.set_enabled(True)
+    yield telemetry.REGISTRY
+    telemetry.REGISTRY.reset()
+    telemetry.set_enabled(None)
+    trace.set_enabled(None)
+
+
+def _counter_sum(name: str, **labels) -> float:
+    total = 0.0
+    for m in telemetry.REGISTRY.find(name):
+        if all(m.labels.get(k) == str(v) for k, v in labels.items()):
+            total += m.value
+    return total
+
+
+# -- AdmissionController -----------------------------------------------------
+
+
+def test_concurrency_limit_and_release():
+    clock = FakeClock()
+    ctrl = AdmissionController(max_inflight=2, clock=clock)
+    d1 = ctrl.admit()
+    d2 = ctrl.admit()
+    assert d1 and d2
+    d3 = ctrl.admit()
+    assert not d3
+    assert d3.reason == "concurrency"
+    assert d3.retry_after > 0
+    ctrl.release(d1)
+    assert ctrl.admit().admitted
+    # releasing a rejected decision must not free a slot it never held
+    ctrl.release(d3)
+    assert not ctrl.admit().admitted
+
+
+def test_token_bucket_rate_and_retry_after_hint():
+    clock = FakeClock()
+    ctrl = AdmissionController(rate=10.0, burst=2, clock=clock)
+    a = ctrl.admit()
+    b = ctrl.admit()
+    assert a and b  # the burst
+    ctrl.release(a)
+    ctrl.release(b)
+    c = ctrl.admit()
+    assert not c and c.reason == "rate"
+    # the hint is exactly the refill time for the missing token
+    assert c.retry_after == pytest.approx(0.1, rel=0.05)
+    clock.advance(c.retry_after + 0.001)
+    d = ctrl.admit()
+    assert d.admitted
+
+
+def test_queue_depth_limit():
+    ctrl = AdmissionController(max_queue=4, clock=FakeClock())
+    assert ctrl.admit(queue_depth=3).admitted
+    r = ctrl.admit(queue_depth=4)
+    assert not r and r.reason == "queue"
+
+
+def test_critical_always_admitted_and_slotless():
+    clock = FakeClock()
+    ctrl = AdmissionController(rate=1.0, burst=1, max_inflight=1, clock=clock)
+    assert ctrl.admit().admitted  # consumes the slot AND the token
+    for _ in range(10):
+        d = ctrl.admit(PRIORITY_CRITICAL)
+        assert d.admitted and not d.slot
+    # the critical flood neither consumed tokens nor slots
+    assert ctrl.inflight() == 1
+    assert not ctrl.admit().admitted
+
+
+def test_rejects_counted_with_retry_after(live_registry):
+    clock = FakeClock()
+    ctrl = AdmissionController(max_inflight=1, clock=clock)
+    ctrl.admit()
+    for _ in range(3):
+        ctrl.admit()
+    assert ctrl.rejected == 3
+    assert _counter_sum(
+        "astpu_admission_requests_total", gate=ctrl.name, outcome="rejected"
+    ) == 3
+    assert _counter_sum(
+        "astpu_admission_rejected_total", gate=ctrl.name, reason="concurrency"
+    ) == 3
+    hist = telemetry.REGISTRY.find("astpu_admission_retry_after_seconds")
+    assert any(
+        m.labels.get("gate") == ctrl.name and m.count == 3 for m in hist
+    )
+
+
+def test_rejects_counted_even_with_telemetry_disabled():
+    """The admission ledger is always-on, like the device counters — a
+    reject during an incident must be visible with ASTPU_TELEMETRY off."""
+    telemetry.REGISTRY.reset()
+    assert not telemetry.enabled()
+    ctrl = AdmissionController(max_inflight=1, clock=FakeClock())
+    ctrl.admit()
+    ctrl.admit()
+    try:
+        assert (
+            _counter_sum(
+                "astpu_admission_requests_total",
+                gate=ctrl.name, outcome="rejected",
+            )
+            == 1
+        )
+    finally:
+        telemetry.REGISTRY.reset()
+
+
+def test_shed_step_refuses_low_priority_only():
+    clock = FakeClock()
+    ladder = DegradationLadder(
+        [LadderStep("shed_low", 0.9, 0.5)], dwell_s=0.0, clock=clock
+    )
+    ladder.observe(1.0)
+    ladder.observe(1.0)  # dwell 0: second observation arms the step
+    assert ladder.active("shed_low")
+    ctrl = AdmissionController(ladder=ladder, shed_at=PRIORITY_LOW, clock=clock)
+    low = ctrl.admit(PRIORITY_LOW)
+    assert not low and low.reason == "shed"
+    assert ctrl.admit(PRIORITY_NORMAL).admitted
+    assert ctrl.admit(PRIORITY_CRITICAL).admitted
+
+
+# -- PauseGate compatibility -------------------------------------------------
+
+
+def test_pausegate_semantics_byte_stable(live_registry):
+    """trigger/remaining/wait and the telemetry names flow through the
+    AdmissionController exactly as through a bare PauseGate."""
+    clock = FakeClock()
+    gate = PauseGate(clock=clock)
+    ctrl = AdmissionController(clock=clock)
+    gate.trigger(200.0)
+    ctrl.trigger(200.0)
+    assert ctrl.remaining() == pytest.approx(gate.remaining())
+    # deadline EXTENDS, never shortens — the PauseGate core invariant
+    ctrl.trigger(50.0)
+    assert ctrl.remaining() == pytest.approx(200.0)
+    assert ctrl.trips == 2
+    # SAME counter name, and both primitives feed the same series
+    assert _counter_sum("astpu_rate_limit_trips_total") == 3
+    events = [
+        e for e in trace.RECORDER.snapshot()
+        if e.get("name") == "scraper.rate_limit_trip"
+    ]
+    assert len(events) == 3
+    # wait() honours the deadline through the controller
+    clock.advance(199.0)
+    slept = []
+    ctrl.wait(sleep=lambda s: (slept.append(s), clock.advance(s)), tick=1.0)
+    assert ctrl.remaining() == 0
+    assert slept  # it actually waited out the remainder
+
+
+def test_pause_rejects_noncritical_with_remaining_as_hint():
+    clock = FakeClock()
+    ctrl = AdmissionController(clock=clock)
+    ctrl.trigger(30.0)
+    d = ctrl.admit()
+    assert not d and d.reason == "paused"
+    assert d.retry_after == pytest.approx(30.0)
+    assert ctrl.admit(PRIORITY_CRITICAL).admitted
+    clock.advance(31.0)
+    assert ctrl.admit().admitted
+
+
+# -- DegradationLadder -------------------------------------------------------
+
+
+def test_ladder_validates_declarations():
+    with pytest.raises(ValueError):
+        DegradationLadder([LadderStep("x", 0.5, 0.6)])  # exit above enter
+    with pytest.raises(ValueError):
+        DegradationLadder(
+            [LadderStep("a", 0.8, 0.5), LadderStep("b", 0.6, 0.3)]
+        )  # de-escalating
+    with pytest.raises(ValueError):
+        DegradationLadder([])
+
+
+def test_ladder_enter_exit_with_dwell(live_registry):
+    clock = FakeClock()
+    ladder = DegradationLadder(
+        [LadderStep("s1", 0.7, 0.4), LadderStep("s2", 0.9, 0.6)],
+        dwell_s=1.0, clock=clock,
+    )
+    # pressure above enter_at but not yet for dwell seconds: no step
+    assert ladder.observe(0.8) == 0
+    clock.advance(0.5)
+    assert ladder.observe(0.8) == 0
+    clock.advance(0.6)
+    assert ladder.observe(0.8) == 1  # dwell satisfied → s1 arms
+    assert ladder.active("s1") and not ladder.active("s2")
+    # climbing to s2 needs its own sustained window
+    clock.advance(0.1)
+    assert ladder.observe(0.95) == 1
+    clock.advance(1.1)
+    assert ladder.observe(0.95) == 2
+    assert ladder.active("s2")
+    # calm exits one step at a time, each after its own dwell
+    clock.advance(0.1)
+    assert ladder.observe(0.3) == 2
+    clock.advance(1.1)
+    assert ladder.observe(0.3) == 1
+    clock.advance(0.1)
+    assert ladder.observe(0.3) == 1  # re-arms the calm timer post-exit
+    clock.advance(1.1)
+    assert ladder.observe(0.3) == 0
+    assert (
+        _counter_sum(
+            "astpu_degraded_transitions_total", ladder=ladder.name, dir="enter"
+        )
+        == 2
+    )
+    assert (
+        _counter_sum(
+            "astpu_degraded_transitions_total", ladder=ladder.name, dir="exit"
+        )
+        == 2
+    )
+
+
+def test_ladder_no_flapping_under_oscillating_load():
+    """A load signal oscillating faster than the dwell never moves the
+    ladder: each crossing into the opposite region resets both timers."""
+    clock = FakeClock()
+    ladder = DegradationLadder(
+        [LadderStep("s1", 0.7, 0.4)], dwell_s=1.0, clock=clock
+    )
+    for _ in range(50):
+        ladder.observe(0.9)   # above enter
+        clock.advance(0.3)    # < dwell
+        ladder.observe(0.2)   # below exit: resets the arm timer
+        clock.advance(0.3)
+    assert ladder.level() == 0
+    # and once armed, the same oscillation cannot flap it OFF either
+    ladder.observe(0.9)
+    clock.advance(1.1)
+    ladder.observe(0.9)
+    assert ladder.level() == 1
+    for _ in range(50):
+        ladder.observe(0.2)
+        clock.advance(0.3)
+        ladder.observe(0.9)
+        clock.advance(0.3)
+    assert ladder.level() == 1
+
+
+def test_ladder_middle_band_resets_timers():
+    clock = FakeClock()
+    ladder = DegradationLadder(
+        [LadderStep("s1", 0.7, 0.4)], dwell_s=1.0, clock=clock
+    )
+    ladder.observe(0.9)
+    clock.advance(0.9)
+    ladder.observe(0.5)  # middle band: neither enter nor exit → reset
+    clock.advance(0.2)
+    assert ladder.observe(0.9) == 0  # the 0.9 s of credit was wiped
+    clock.advance(1.1)
+    assert ladder.observe(0.9) == 1
+
+
+def test_ladder_step_gauge_always_on():
+    telemetry.REGISTRY.reset()
+    clock = FakeClock()
+    ladder = DegradationLadder(
+        [LadderStep("s1", 0.7, 0.4)], dwell_s=0.0, clock=clock
+    )
+    try:
+        ladder.observe(1.0)
+        ladder.observe(1.0)
+        text = telemetry.REGISTRY.prometheus_text()
+        assert "astpu_degraded_step" in text
+        assert f'ladder="{ladder.name}"' in text
+    finally:
+        telemetry.REGISTRY.reset()
+
+
+def test_default_ladder_declares_the_documented_steps():
+    names = [s.name for s in DEFAULT_LADDER_STEPS]
+    assert names == ["shrink_window", "skip_rerank", "fewer_bands", "shed_low"]
+    ladder = DegradationLadder(clock=FakeClock())
+    assert ladder.level() == 0
+
+
+def test_controller_feeds_ladder_pressure():
+    clock = FakeClock()
+    ladder = DegradationLadder(
+        [LadderStep("shed_low", 0.9, 0.5)], dwell_s=0.0, clock=clock
+    )
+    ctrl = AdmissionController(max_inflight=2, ladder=ladder, clock=clock)
+    ctrl.admit()
+    ctrl.admit()          # inflight 2/2 → pressure 1.0, first sample arms
+    ctrl.admit()          # reject → second sample at 1.0 → step enters
+    assert ladder.active("shed_low")
+    assert ctrl.pressure() >= 1.0
+
+
+# -- engine brownout hooks ---------------------------------------------------
+
+
+def _distinct_docs(n: int, seed: int = 7) -> list:
+    """Genuinely dissimilar documents (random word soup — near-identical
+    template strings would all cluster into one dup family)."""
+    rng = np.random.default_rng(seed)
+    words = [f"w{int(x):05d}" for x in rng.integers(0, 99999, size=(n, 40)).ravel()]
+    return [
+        " ".join(words[i * 40 : (i + 1) * 40]) for i in range(n)
+    ]
+
+
+def _forced_ladder(*active_steps):
+    """A ladder whose named steps are pre-armed (dwell 0, two pumps)."""
+    clock = FakeClock()
+    steps = [
+        LadderStep(n, 0.1 * (i + 1), 0.05 * (i + 1))
+        for i, n in enumerate(
+            ("shrink_window", "skip_rerank", "fewer_bands", "shed_low")
+        )
+    ]
+    ladder = DegradationLadder(steps, dwell_s=0.0, clock=clock)
+    want = max(
+        (i + 1 for i, s in enumerate(steps) if s.name in active_steps),
+        default=0,
+    )
+    while ladder.level() < want:
+        before = ladder.level()
+        ladder.observe(1.0)
+        if ladder.level() == before:
+            ladder.observe(1.0)
+    return ladder
+
+
+def test_engine_skip_rerank_under_ladder():
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    docs = _distinct_docs(8)
+    docs[5] = docs[2]
+    eng = NearDupEngine(DedupConfig(batch_size=8, block_len=256))
+    calls = []
+
+    def veto_hook(raw, sigs, rep_bands, valid):
+        calls.append(len(raw))
+        return np.full_like(np.asarray(rep_bands), -1)  # veto every edge
+
+    eng.rerank_hook = veto_hook
+    base = eng.dedup_reps(docs)
+    assert calls  # the hook ran and vetoed: no dups found
+    assert base[5] == 5
+    eng.ladder = _forced_ladder("skip_rerank")
+    degraded = eng.dedup_reps(docs)
+    assert len(calls) == 1  # hook NOT called under the active step
+    assert degraded[5] == 2  # dedup found without the veto
+    eng.ladder = None
+    eng.dedup_reps(docs)
+    assert len(calls) == 2  # reversible: hook runs again
+
+
+def test_engine_fewer_bands_under_ladder(tmp_path):
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.index import PersistentIndex
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    docs = _distinct_docs(6, seed=11)
+    eng = NearDupEngine(DedupConfig(batch_size=8, block_len=256))
+    eng.ladder = _forced_ladder("fewer_bands")
+    idx = PersistentIndex(str(tmp_path / "idx"))
+    try:
+        out = eng.dedup_against_index(docs, idx)
+        assert (out == -1).all()  # all fresh
+        # half the bands → half the postings per doc
+        keys, _docs = idx.dump_postings()
+        full_bands = eng.params.num_bands
+        assert len(keys) == len(docs) * (full_bands // 2)
+    finally:
+        idx.close()
+
+
+def test_engine_shrink_window_counts_effect():
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+    telemetry.REGISTRY.reset()
+    try:
+        docs = _distinct_docs(6, seed=13)
+        eng = NearDupEngine(DedupConfig(batch_size=8, block_len=256))
+        ladder = _forced_ladder("shrink_window")
+        eng.ladder = ladder
+        baseline = eng.dedup_reps(docs)
+        assert (
+            _counter_sum(
+                "astpu_degraded_effects_total",
+                ladder=ladder.name, step="shrink_window",
+            )
+            >= 1
+        )
+        # byte-identical result: the window is a latency knob, not a
+        # semantics knob
+        eng.ladder = None
+        assert np.array_equal(np.asarray(baseline), np.asarray(eng.dedup_reps(docs)))
+    finally:
+        telemetry.REGISTRY.reset()
+
+
+def test_critical_flood_does_not_reset_ladder_dwell():
+    """Health pings (critical class) carry no load signal: a ping flood
+    faster than the dwell must neither stop a saturated ladder from
+    arming nor walk an armed step back mid-storm."""
+    clock = FakeClock()
+    ladder = DegradationLadder(
+        [LadderStep("s1", 0.7, 0.4)], dwell_s=1.0, clock=clock
+    )
+    ctrl = AdmissionController(
+        max_inflight=1, ladder=ladder, clock=clock
+    )
+    hold = ctrl.admit()
+    assert hold.admitted
+    for _ in range(12):
+        ctrl.admit()                    # reject → pressure 1.0
+        ctrl.admit(PRIORITY_CRITICAL)   # ping — must NOT read as calm
+        clock.advance(0.2)
+    assert ladder.level() == 1, "critical traffic reset the arm dwell"
+    for _ in range(12):
+        ctrl.admit(PRIORITY_CRITICAL)
+        clock.advance(0.2)
+    assert ladder.level() == 1, "critical traffic walked the step back"
+
+
+def test_shed_rejects_do_not_feed_pressure_livelock():
+    """A shed reject is the ladder's own output: if it fed pressure 1.0
+    back in, retrying clients would hold the shed step armed forever.
+    With the feedback cut, the bucket refills, pressure falls, the step
+    exits, and service resumes."""
+    clock = FakeClock()
+    ladder = DegradationLadder(
+        [LadderStep("shed_low", 0.8, 0.5)], dwell_s=0.5, clock=clock
+    )
+    ctrl = AdmissionController(
+        rate=2.0, burst=2, ladder=ladder, shed_at=PRIORITY_NORMAL,
+        clock=clock,
+    )
+    assert ctrl.admit().admitted and ctrl.admit().admitted  # drain burst
+    for _ in range(4):  # capacity rejects arm the step
+        ctrl.admit()
+        clock.advance(0.2)
+    assert ladder.active("shed_low")
+    # now ONLY shed-rejected retries arrive; the bucket refills under
+    # them and the step must disarm (the livelock regression)
+    recovered = False
+    for _ in range(20):
+        d = ctrl.admit()
+        if d.admitted:
+            recovered = True
+            break
+        assert d.reason == "shed"
+        clock.advance(0.3)
+    assert recovered, "shed step never exited under retrying clients"

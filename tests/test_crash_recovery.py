@@ -181,3 +181,27 @@ def test_crashsweep_fleet_twenty_instants(tmp_path):
     assert report["kills"] >= 20 - 2, (
         f"only {report['kills']} of 20 kill instants landed"
     )
+
+
+def test_crashsweep_overload_converges(tmp_path):
+    """The overload-storm acceptance, tier-1 slice: one seeded case of a
+    ≥10× mixed-priority storm against an admission-tight live 2×2 fleet
+    with a mid-storm REPLICA SIGKILL (+respawn).  Zero collapse, zero
+    promotions (overload is never death; a dead replica is never a
+    write-target loss), counted rejects with retry-after honored by the
+    client, no degraded probes, the declared reject-ratio SLO green over
+    the FleetCollector's merged view, and admitted-work annotations
+    BYTE-equal to the unloaded single-node oracle.  (More instants run
+    in the default `tools/crashsweep.py` battery.)"""
+    report = crashsweep.sweep_overload(str(tmp_path), kills=1, seed=7)
+    assert not report["problems"], report["problems"]
+    assert report["kills"] == 1, report
+
+
+@pytest.mark.slow
+def test_crashsweep_overload_five_instants(tmp_path):
+    """The wider overload bar: five seeded storm cases, each with its
+    own kill geometry, all byte-convergent and promotion-free."""
+    report = crashsweep.sweep_overload(str(tmp_path), kills=5, seed=11)
+    assert not report["problems"], report["problems"]
+    assert report["kills"] >= 4, report

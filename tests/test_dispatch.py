@@ -380,3 +380,232 @@ def test_nativebuild_falls_back_to_tmp_when_target_unwritable(tmp_path):
     bad.write_text("this is not C++\n")
     lib2, why2 = build_or_find(str(bad), str(tmp_path / "libbad.so"))
     assert lib2 is None and why2
+
+
+# -- device-plane degradation (overload-safe ingest) --------------------------
+
+
+def test_watchdog_trips_on_hung_put_with_dump_and_teardown(tmp_path, monkeypatch):
+    """A wedged tile (hung inside the put stage) trips the watchdog
+    inside its budget: counted, flight recorder dumped, whole graph torn
+    down, and the consumer raises DispatchTimeout instead of blocking
+    forever."""
+    import threading
+
+    from advanced_scrapper_tpu.obs import telemetry, trace
+    from advanced_scrapper_tpu.pipeline.dispatch import DispatchTimeout
+
+    dump = tmp_path / "flight.jsonl"
+    trace.RECORDER.set_active(True)  # the env gate caches on first touch
+    trace.RECORDER.set_dump_path(str(dump))
+    trace.RECORDER.clear()  # re-arm the once-per-death dump latch
+    hang = threading.Event()
+
+    def hung_put(x):
+        hang.wait(30.0)  # far beyond the budget
+        return x
+
+    before = telemetry.REGISTRY.counter(
+        "astpu_dispatch_watchdog_trips_total", always=True
+    ).value
+    pipe = PipelinedDispatcher(
+        iter(range(4)),
+        pack=lambda x: x,
+        put=hung_put,
+        watchdog_s=0.3,
+    )
+    try:
+        with pytest.raises(DispatchTimeout):
+            list(pipe)
+        after = telemetry.REGISTRY.counter(
+            "astpu_dispatch_watchdog_trips_total", always=True
+        ).value
+        assert after == before + 1
+        assert dump.exists(), "watchdog never dumped the flight recorder"
+        text = dump.read_text()
+        assert "dispatch watchdog" in text
+        assert '"dispatch.watchdog"' in text
+    finally:
+        hang.set()
+        pipe.close()
+        trace.RECORDER.set_dump_path(None)
+        trace.RECORDER.set_active(None)
+        trace.RECORDER.clear()
+
+
+def test_watchdog_trips_on_hung_caller_dispatch():
+    """A hang in the CALLER's dispatch (the device step) also goes
+    stale — the beat only advances when iteration re-enters — so the
+    watchdog still counts and tears down (the consumer itself is stuck,
+    but the wedge becomes visible and every worker exits)."""
+    import threading
+    import time as _time
+
+    from advanced_scrapper_tpu.obs import telemetry
+    from advanced_scrapper_tpu.pipeline.dispatch import DispatchTimeout
+
+    release = threading.Event()
+    pipe = PipelinedDispatcher(
+        iter(range(4)),
+        pack=lambda x: x,
+        put=lambda x: x,
+        watchdog_s=0.25,
+    )
+    got = []
+    err = []
+
+    def consume():
+        try:
+            for item in pipe:
+                got.append(item)
+                release.wait(20.0)  # "hung device call" on the first tile
+        except DispatchTimeout as e:
+            err.append(e)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = _time.monotonic() + 5
+    while pipe.error is None and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    assert isinstance(pipe.error, DispatchTimeout)
+    release.set()
+    t.join(timeout=5)
+    pipe.close()
+
+
+def test_watchdog_quiet_on_clean_run():
+    from advanced_scrapper_tpu.obs import telemetry
+
+    c = telemetry.REGISTRY.counter(
+        "astpu_dispatch_watchdog_trips_total", always=True
+    )
+    before = c.value
+    pipe = PipelinedDispatcher(
+        iter(range(32)),
+        pack=lambda x: x,
+        put=lambda x: x,
+        watchdog_s=5.0,
+    )
+    assert sorted(list(pipe)) == list(range(32))
+    pipe.close()
+    assert c.value == before
+
+
+def test_oom_backoff_halving_converges_byte_identical(monkeypatch):
+    """Injected RESOURCE_EXHAUSTED (chaos env) halves tiles, re-packs,
+    retries — and the fold converges byte-identical to the unthrottled
+    path, with the extra halved puts visible on the always-on ledger."""
+    from advanced_scrapper_tpu.obs import stages, telemetry
+    from advanced_scrapper_tpu.pipeline import dispatch as dp
+
+    # uniform ~one-block docs: ONE width bucket whose first tile is a
+    # 512-row power-of-two chunk — the injected OOMs land on tiles with
+    # real halving headroom (a 64-row floor tile would fail clean, which
+    # is the OTHER test)
+    rng = np.random.RandomState(11)
+    docs = [
+        rng.randint(32, 127, size=int(rng.randint(900, 1100)), dtype=np.uint8)
+        .tobytes()
+        for _ in range(512)
+    ]
+    eng = NearDupEngine(DedupConfig(packed_h2d=True))
+    clean = np.asarray(eng.dedup_reps(docs))
+
+    monkeypatch.setenv("ASTPU_CHAOS_DISPATCH_OOM", "2")
+    dp.reset_chaos_oom()
+    backoffs = telemetry.REGISTRY.counter(
+        "astpu_dispatch_oom_backoff_total", always=True, plane="dedup"
+    )
+    b0 = backoffs.value
+    before = stages.device_counters()
+    throttled = np.asarray(eng.dedup_reps(docs))
+    after = stages.device_counters()
+    monkeypatch.delenv("ASTPU_CHAOS_DISPATCH_OOM")
+    dp.reset_chaos_oom()
+
+    assert (throttled == clean).all(), "OOM backoff changed the output"
+    assert backoffs.value > b0, "the injection never engaged the ladder"
+    # each halving pays 2 extra puts (the re-packed halves)
+    extra_puts = int(after["device_puts"] - before["device_puts"])
+    assert extra_puts >= eng.last_tiles + 1 + 2, (
+        f"halved tiles never re-crossed H2D (puts delta {extra_puts})"
+    )
+
+
+def test_oom_ladder_to_floor_fails_clean(monkeypatch):
+    """An injection budget deep enough to out-halve the floor produces a
+    clean RESOURCE_EXHAUSTED failure — bounded, attributable, no wedge —
+    and the engine is reusable afterwards."""
+    from advanced_scrapper_tpu.pipeline import dispatch as dp
+
+    rng = np.random.RandomState(12)
+    docs = _corpus(rng, 64)
+    eng = NearDupEngine(DedupConfig(packed_h2d=True))
+    monkeypatch.setenv("ASTPU_CHAOS_DISPATCH_OOM", "100000")
+    dp.reset_chaos_oom()
+    with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+        eng.dedup_reps(docs)
+    monkeypatch.delenv("ASTPU_CHAOS_DISPATCH_OOM")
+    dp.reset_chaos_oom()
+    clean = np.asarray(eng.dedup_reps(docs))
+    assert clean.shape[0] >= len(docs)
+
+
+def test_oom_backoff_floor_and_markers():
+    from advanced_scrapper_tpu.pipeline.dispatch import (
+        OOM_FLOOR_ROWS,
+        dispatch_with_oom_backoff,
+        is_resource_exhausted,
+    )
+
+    assert is_resource_exhausted(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+    assert is_resource_exhausted(MemoryError("Resource exhausted: HBM"))
+    assert is_resource_exhausted(RuntimeError("ran out of memory on device"))
+    assert not is_resource_exhausted(ValueError("shape mismatch"))
+
+    # a non-OOM error propagates untouched, never split
+    calls = []
+    with pytest.raises(ValueError):
+        dispatch_with_oom_backoff(
+            lambda c, i: (_ for _ in ()).throw(ValueError("boom")),
+            0, (None, 128),
+            split=lambda i: calls.append(i) or [],
+            rows_of=lambda i: i[1],
+        )
+    assert not calls
+
+    # at the floor the OOM propagates cleanly instead of splitting
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        dispatch_with_oom_backoff(
+            lambda c, i: (_ for _ in ()).throw(
+                RuntimeError("RESOURCE_EXHAUSTED: x")
+            ),
+            0, (None, OOM_FLOOR_ROWS),
+            split=lambda i: calls.append(i) or [],
+            rows_of=lambda i: i[1],
+        )
+    assert not calls
+
+
+def test_oom_backoff_generic_fold_halves_to_success():
+    """Pure-python model of the ladder: a fold that OOMs above 128 rows
+    converges through recursive halving with the leaf sum intact."""
+    from advanced_scrapper_tpu.pipeline.dispatch import (
+        dispatch_with_oom_backoff,
+    )
+
+    def fn(carry, item):
+        lo, hi = item
+        if hi - lo > 128:
+            raise RuntimeError("RESOURCE_EXHAUSTED: too big")
+        return carry + sum(range(lo, hi))
+
+    def split(item):
+        lo, hi = item
+        mid = lo + (hi - lo) // 2
+        return [(lo, mid), (mid, hi)]
+
+    total = dispatch_with_oom_backoff(
+        fn, 0, (0, 1024), split=split, rows_of=lambda it: it[1] - it[0],
+    )
+    assert total == sum(range(1024))

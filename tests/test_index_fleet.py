@@ -562,3 +562,165 @@ def test_torn_spill_journal_tail_truncated_on_reload(tmp_path):
         client.close()
         for s in servers:
             s.stop()
+
+
+# -- overload vs dead (the overload-safe ingest plane) ------------------------
+
+def _tight_fleet(
+    tmp_path, *, max_inflight=1, insert_rate=0.0, shards=2, replicas=2, **ckw
+):
+    """A fleet whose shard servers run a deliberately tiny write-admission
+    bound, so a handful of concurrent inserts overloads them.  (A single
+    fleet client serialises calls per node, so the RATE limit is what a
+    one-client storm actually trips; the in-flight bound needs multiple
+    client processes — the loadgen/crashsweep story.)"""
+    servers = []
+    parts = []
+    for s in range(shards):
+        nodes = []
+        for r in range(replicas):
+            srv = IndexShardServer(
+                str(tmp_path / f"s{s}n{r}"),
+                spaces=("bands",),
+                cut_postings=96,
+                compact_segments=4,
+                compact_inline=True,
+                name=f"s{s}n{r}",
+                max_inflight_inserts=max_inflight,
+                insert_rate=insert_rate,
+            ).start()
+            servers.append(srv)
+            nodes.append(f"127.0.0.1:{srv.port}")
+        parts.append("|".join(nodes))
+    kw = dict(
+        space="bands",
+        spill_dir=str(tmp_path / "spill"),
+        timeout=2.0,
+        retries=1,
+        health_timeout=0.2,
+        overload_budget=20.0,
+    )
+    kw.update(ckw)
+    return servers, ShardedIndexClient(";".join(parts), **kw)
+
+
+def test_storm_against_tight_shards_zero_promotions(tmp_path):
+    """The satellite regression: a concurrent write storm against
+    admission-tight shards backs off in place — zero failovers, zero
+    promotions, zero spills, and every posting lands (byte-equal to the
+    oracle) once the storm drains."""
+    # rate 3/s ⇒ burst 3: the 8-batch storm per node outruns the bucket
+    # and MUST hit counted rejects (burst defaults to the rate)
+    servers, client = _tight_fleet(tmp_path, max_inflight=1, insert_rate=3.0)
+    rng = np.random.default_rng(3)
+    batches = [
+        (
+            rng.integers(0, 1 << 62, 24).astype(np.uint64),
+            np.arange(b * 24, (b + 1) * 24, dtype=np.uint64),
+        )
+        for b in range(8)
+    ]
+    errors: list = []
+
+    def blast(batch):
+        try:
+            client.insert_batch(*batch)
+        except Exception as e:  # noqa: BLE001 - the assert below reports it
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=blast, args=(b,), daemon=True)
+        for b in batches
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, f"storm surfaced errors: {errors[:3]}"
+        assert client._m_failovers.value == 0, "overload was treated as death"
+        assert client._m_promotions.value == 0
+        assert client._m_spilled.value == 0
+        # the storm really did hit the admission bound: the shard servers
+        # counted rejects (the RpcClient's own retry-after honoring
+        # absorbs most of them before the fleet layer ever sees one)
+        assert sum(s.server.overload_rejects for s in servers) > 0, (
+            "the tight admission bound never actually rejected — the storm "
+            "did not exercise the overload path"
+        )
+        # every node of every shard holds every posting of its ring slice
+        # (replication never skipped an overloaded node)
+        all_k = np.concatenate([k for k, _ in batches])
+        all_d = np.concatenate([d for _, d in batches])
+        probe = client.probe_batch(all_k[:, None])
+        want = _min_map(all_k, all_d)
+        got = {int(k): int(p) for k, p in zip(all_k.tolist(), probe.tolist())}
+        assert got == {k: v for k, v in want.items()}
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+def test_slow_but_pingable_node_is_not_demoted(tmp_path):
+    """Deadline expiry while the server still answers pings = overload,
+    not death: the probe is answered by a replica, and the slow primary
+    keeps its write-target seat (zero failovers, zero promotions)."""
+    servers, client = _fleet(
+        tmp_path, shards=1, replicas=2, timeout=0.3,
+        retries=0, overload_budget=1.2,
+    )
+    try:
+        keys = np.arange(100, 120, dtype=np.uint64)
+        client.insert_batch(keys, keys)
+        # wedge the PRIMARY's probe handler (pings stay native+instant)
+        primary = servers[0]
+        real_probe = primary._h_probe
+
+        def slow_probe(header, arrays):
+            time.sleep(1.0)  # >> the 0.3 s client deadline
+            return real_probe(header, arrays)
+
+        primary.server.handlers["probe"] = slow_probe
+        out = client.probe_batch(keys[:4][:, None])
+        assert (np.asarray(out) >= 0).all(), "probe lost data"
+        assert client._m_failovers.value == 0, (
+            "a slow-but-alive node was marked dead"
+        )
+        assert client._m_promotions.value == 0
+        assert client._m_slow.value > 0, (
+            "the slow-node path never engaged — the test wedge is broken"
+        )
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+def test_insert_overload_blocks_not_drops(tmp_path):
+    """insert_batch under a refusing shard is backpressure, not loss:
+    the call takes as long as admission takes, and the postings land
+    exactly once."""
+    servers, client = _tight_fleet(tmp_path, max_inflight=1, shards=1)
+    try:
+        # hold the single insert slot open server-side
+        srv = servers[0]
+        hold = srv.admission.admit()
+        assert hold.admitted
+
+        def free_later():
+            time.sleep(0.5)
+            srv.admission.release(hold)
+
+        threading.Thread(target=free_later, daemon=True).start()
+        keys = np.arange(7000, 7016, dtype=np.uint64)
+        t0 = time.monotonic()
+        client.insert_batch(keys, keys)
+        assert time.monotonic() - t0 >= 0.3, "insert should have waited"
+        assert client._m_failovers.value == 0
+        out = client.probe_batch(keys[:, None])
+        assert (np.asarray(out) >= 0).all()
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()

@@ -384,3 +384,62 @@ def test_client_connect_exhaustion_raises_connection_error():
     with pytest.raises(ConnectionError):
         client.run(max_seconds=1)
     assert len(slept) == 2, "every retry must back off before redialing"
+
+
+# -- overload shedding (the overload-safe ingest plane) -----------------------
+
+
+def test_lease_grants_shed_under_admission_and_complete():
+    """A rate-refusing admission controller sheds lease GRANTS (empty
+    batch + retry-after, counted); the client honors the hint, never
+    mistakes a shed for a drained queue, and the run still completes
+    once capacity refills."""
+    from advanced_scrapper_tpu.runtime.admission import AdmissionController
+
+    urls = [f"https://x/{i}.html" for i in range(8)]
+    cfg = _cfg(batch_size=2, min_queue_length=1)
+    # ~6 grant-sized refills needed; rate 5/s with burst 1 forces several
+    # shed rounds before the queue drains
+    ctrl = AdmissionController(rate=5.0, burst=1)
+    server = LeaseServer(cfg, urls, admission=ctrl).start()
+    try:
+        client = LeaseClient(
+            cfg,
+            lambda: MockTransport({u: ARTICLE_HTML for u in urls}),
+            port=server.port,
+        )
+        sent = client.run(max_seconds=30)
+        assert sent == len(urls)
+        assert server.wait_done(10)
+        assert server._m_shed.value > 0, (
+            "the storm never shed a grant — admission was not exercised"
+        )
+        assert ctrl.rejected > 0
+    finally:
+        server.stop()
+
+
+def test_shed_batch_is_not_drained_signal():
+    """An explicit shed frame must leave the client's drained latch
+    unset — only a genuine empty batch ends the run."""
+    from advanced_scrapper_tpu.runtime.admission import AdmissionController
+
+    urls = [f"https://x/{i}.html" for i in range(4)]
+    cfg = _cfg(batch_size=4, min_queue_length=1)
+    ctrl = AdmissionController()
+    ctrl.trigger(0.6)  # paused: every grant shed for the first 600 ms
+    server = LeaseServer(cfg, urls, admission=ctrl).start()
+    try:
+        client = LeaseClient(
+            cfg,
+            lambda: MockTransport({u: ARTICLE_HTML for u in urls}),
+            port=server.port,
+        )
+        sent = client.run(max_seconds=20)
+        assert sent == len(urls), (
+            "a shed grant ended the run early (mistaken for drained)"
+        )
+        assert server.wait_done(5)
+        assert server._m_shed.value > 0
+    finally:
+        server.stop()

@@ -34,6 +34,7 @@ import random
 import signal
 import subprocess
 import sys
+import threading
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -61,6 +62,17 @@ FLEET_REPLICAS = 2
 #: with the replica too (forcing spill + promotion-window recovery), or
 #: chaos-exit the primary INSIDE a WAL append syscall
 FLEET_KILL_MODES = ("insert", "probe", "promotion", "wal")
+
+#: overload workload: a mixed-priority storm at ≥10× the shards' declared
+#: write-admission capacity, with a mid-storm REPLICA SIGKILL — the
+#: acceptance is zero collapse, ZERO promotions (a dead replica is not a
+#: write-target loss; an overloaded node is not dead at all), counted
+#: rejects with retry-after honored, and admitted-work annotations
+#: byte-equal to an unloaded single-node oracle.
+OVERLOAD_DOCS = 84
+OVERLOAD_BATCH = 12
+OVERLOAD_INSERT_RATE = 3.0   # per-node admitted writes/s (burst = rate)
+OVERLOAD_STORM_WORKERS = 4   # read/ping storm threads beside the ingest
 
 
 # -- deterministic synthetic data -------------------------------------------
@@ -426,7 +438,8 @@ def _fleet_pick_ports(n: int) -> list[int]:
 
 
 def _fleet_spawn_server(
-    case_dir: str, sid: int, rep: int, chaos: str | None, port: int
+    case_dir: str, sid: int, rep: int, chaos: str | None, port: int,
+    *, extra_args=(), telemetry: bool = False, metrics_port_file=None,
 ):
     """Fork one IndexShardServer over its (possibly crash-scarred) dir;
     PDEATHSIG ties it to the orchestrating child so a killed orchestrator
@@ -437,7 +450,10 @@ def _fleet_spawn_server(
     pf = os.path.join(case_dir, f"s{sid}n{rep}.port")
     if os.path.exists(pf):
         os.unlink(pf)
-    env = dict(os.environ, JAX_PLATFORMS="cpu", ASTPU_TELEMETRY="0")
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        ASTPU_TELEMETRY="1" if telemetry else "0",
+    )
     env.pop("ASTPU_CHAOS_FS", None)
     if chaos:
         env["ASTPU_CHAOS_FS"] = chaos
@@ -453,15 +469,19 @@ def _fleet_spawn_server(
         ctypes.CDLL(None).prctl(1, signal.SIGKILL)  # PR_SET_PDEATHSIG
 
     log = open(os.path.join(case_dir, f"s{sid}n{rep}.log"), "ab")
+    argv = [
+        sys.executable, "-m", "advanced_scrapper_tpu.index.remote",
+        "--dir", sdir, "--port", str(port), "--port-file", pf,
+        "--spaces", "bands",
+        "--cut-postings", str(6 * PINDEX_BANDS),
+        "--compact-segments", "4",
+        "--name", f"s{sid}n{rep}",
+    ]
+    if metrics_port_file:
+        argv += ["--metrics-port-file", metrics_port_file]
+    argv += list(extra_args)
     proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "advanced_scrapper_tpu.index.remote",
-            "--dir", sdir, "--port", str(port), "--port-file", pf,
-            "--spaces", "bands",
-            "--cut-postings", str(6 * PINDEX_BANDS),
-            "--compact-segments", "4",
-            "--name", f"s{sid}n{rep}",
-        ],
+        argv,
         env=env, cwd=REPO, stdout=log, stderr=log, preexec_fn=_pdeathsig,
     )
     log.close()
@@ -650,12 +670,284 @@ def child_fleet(case_dir: str, seed: int) -> int:
                 p.kill()
 
 
+def _overload_doc_keys(i: int):
+    """Band keys for overload doc ``i`` — the planted-dup scheme under
+    its own salt (never aliases fleet/pindex cases)."""
+    import numpy as np
+
+    src = i - 3 if (i % 7 == 3 and i >= 3) else i
+    x = (np.arange(PINDEX_BANDS, dtype=np.uint64)
+         + np.uint64(src * 1000 + 31)) * np.uint64(0x9E3779B97F4A7C15)
+    x ^= x >> np.uint64(29)
+    return x
+
+
+_OVERLOAD_ORACLE_CACHE: list = []
+
+
+def overload_oracle_annotations():
+    """The UNLOADED single-node truth the stormed fleet must byte-match
+    for every admitted item (and every item IS eventually admitted — the
+    client's retry-after honoring turns overload into backpressure, not
+    loss).  Memoized like the fleet oracle."""
+    if _OVERLOAD_ORACLE_CACHE:
+        return _OVERLOAD_ORACLE_CACHE[0]
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from advanced_scrapper_tpu.index import PersistentIndex
+
+    base = tempfile.mkdtemp(prefix="overload-oracle-")
+    idx = PersistentIndex(
+        os.path.join(base, "oracle"),
+        cut_postings=6 * PINDEX_BANDS,
+        compact_segments=4,
+        compact_inline=True,
+    )
+    ann: list[int] = []
+    try:
+        for start in range(0, OVERLOAD_DOCS, OVERLOAD_BATCH):
+            rows = range(start, min(start + OVERLOAD_BATCH, OVERLOAD_DOCS))
+            keys = np.stack([_overload_doc_keys(i) for i in rows])
+            ids = idx.allocate_doc_ids(len(keys))
+            ann += np.asarray(idx.check_and_add_batch(keys, ids)).tolist()
+        keys_all, docs_all = idx.dump_postings()
+        minmap: dict[int, int] = {}
+        for k, d in zip(keys_all.tolist(), docs_all.tolist()):
+            if k not in minmap or d < minmap[k]:
+                minmap[k] = d
+    finally:
+        idx.close()
+        shutil.rmtree(base, ignore_errors=True)
+    _OVERLOAD_ORACLE_CACHE.append((ann, minmap))
+    return ann, minmap
+
+
+def child_overload(case_dir: str, seed: int) -> int:
+    """10× mixed-priority storm against an admission-tight 2×2 fleet,
+    with a seeded mid-storm REPLICA SIGKILL (+respawn).
+
+    The shard servers declare ~3 admitted writes/s each; the ingest
+    stream plus a read/ping storm offer far more.  The contract under
+    test: the fleet backs off in place on counted rejects (retry-after
+    honored), NEVER promotes (the write targets stay seated — overload
+    is not death, and a dead replica is not a write-target loss), no
+    probe degrades, and the admitted annotations land byte-equal to the
+    unloaded oracle.  The `astpu_admission_*`/`astpu_degraded_step`
+    series are scraped off the live shards by the PR 11 FleetCollector
+    and fed to the declared SLO engine; the verdict rides the report."""
+    os.environ["ASTPU_TELEMETRY"] = "1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    from advanced_scrapper_tpu.index.fleet import FleetSpec, ShardedIndexClient
+    from advanced_scrapper_tpu.net.rpc import RpcClient, RpcError
+    from advanced_scrapper_tpu.obs import telemetry
+    from advanced_scrapper_tpu.obs.collector import FleetCollector
+    from advanced_scrapper_tpu.obs.slo import SloEngine
+
+    rng = random.Random(f"overload-child|{seed}")
+    n_batches = (OVERLOAD_DOCS + OVERLOAD_BATCH - 1) // OVERLOAD_BATCH
+    kill_batch = rng.randrange(2, n_batches - 2)
+    revive_batch = min(n_batches - 1, kill_batch + 2)
+    kill_shard = rng.randrange(FLEET_SHARDS)
+
+    port_list = _fleet_pick_ports(FLEET_SHARDS * FLEET_REPLICAS)
+    ports = {
+        (sid, rep): port_list[sid * FLEET_REPLICAS + rep]
+        for sid in range(FLEET_SHARDS)
+        for rep in range(FLEET_REPLICAS)
+    }
+    tight = (
+        "--insert-rate", str(OVERLOAD_INSERT_RATE),
+        "--max-inflight-inserts", "2",
+    )
+    procs: dict[tuple[int, int], subprocess.Popen] = {}
+    stop_storm = threading.Event()
+    storm_threads: list[threading.Thread] = []
+    try:
+        for sid in range(FLEET_SHARDS):
+            for rep in range(FLEET_REPLICAS):
+                procs[(sid, rep)] = _fleet_spawn_server(
+                    case_dir, sid, rep, None, ports[(sid, rep)],
+                    extra_args=tight, telemetry=True,
+                    metrics_port_file=os.path.join(
+                        case_dir, f"s{sid}n{rep}.mport"
+                    ),
+                )
+        spec = FleetSpec(
+            shards=tuple(
+                tuple(
+                    ("127.0.0.1", ports[(sid, rep)])
+                    for rep in range(FLEET_REPLICAS)
+                )
+                for sid in range(FLEET_SHARDS)
+            )
+        )
+        client = ShardedIndexClient(
+            spec,
+            space="bands",
+            spill_dir=os.path.join(case_dir, "spill"),
+            timeout=1.5,
+            retries=1,
+            health_checks=2,
+            health_timeout=0.3,
+            overload_budget=60.0,
+        )
+        _touch_marker(case_dir)
+
+        # -- the storm: mixed-priority read/ping noise at ~10× the write
+        # capacity, read-only so the admitted-work byte-equality stands
+        def storm(wid: int):
+            c = RpcClient(
+                ("127.0.0.1", port_list[wid % len(port_list)]),
+                timeout=1.0, retries=1, seed=1000 + wid,
+            )
+            k = 0
+            probe_keys = np.stack(
+                [_overload_doc_keys(i) for i in range(4)]
+            ).ravel().astype(np.uint64)
+            try:
+                while not stop_storm.is_set():
+                    k += 1
+                    try:
+                        if k % 3 == 0:
+                            c.ping(timeout=0.5)  # the critical class
+                        else:
+                            c.call(
+                                "probe", {"space": "bands"}, [probe_keys],
+                                timeout=1.0,
+                            )
+                    except RpcError:
+                        pass  # storm noise never fails the case by itself
+                    time.sleep(0.02)
+            finally:
+                c.close()
+
+        for w in range(OVERLOAD_STORM_WORKERS):
+            t = threading.Thread(target=storm, args=(w,), daemon=True)
+            t.start()
+            storm_threads.append(t)
+
+        ann: list[int] = []
+        for b in range(n_batches):
+            if b == kill_batch:
+                # mid-storm SIGKILL of a REPLICA (rep 1 — never the
+                # write target): the fleet observes a real death under
+                # full overload and must STILL not promote
+                os.kill(procs[(kill_shard, 1)].pid, signal.SIGKILL)
+                procs[(kill_shard, 1)].wait()
+            if b == revive_batch:
+                procs[(kill_shard, 1)] = _fleet_spawn_server(
+                    case_dir, kill_shard, 1, None, ports[(kill_shard, 1)],
+                    extra_args=tight, telemetry=True,
+                )
+            rows = range(
+                b * OVERLOAD_BATCH,
+                min((b + 1) * OVERLOAD_BATCH, OVERLOAD_DOCS),
+            )
+            keys = np.stack([_overload_doc_keys(i) for i in rows])
+            ids = client.allocate_doc_ids(len(keys))
+            ann += np.asarray(client.check_and_add_batch(keys, ids)).tolist()
+        stop_storm.set()
+        for t in storm_threads:
+            t.join(timeout=5)
+        client.checkpoint()  # recovery probe: drains gap backfill
+
+        # -- PR 11 integration: scrape the LIVE shards' admission series
+        # and evaluate the declared overload SLO over the merged view
+        endpoints = []
+        for (sid, rep) in ports:
+            mp = os.path.join(case_dir, f"s{sid}n{rep}.mport")
+            if os.path.exists(mp):
+                with open(mp) as f:
+                    endpoints.append(
+                        (f"s{sid}n{rep}", f"http://127.0.0.1:{f.read().strip()}")
+                    )
+        coll = FleetCollector(endpoints, timeout=2.0)
+        coll.scrape_once()
+        merged, _types = coll.merged_samples()
+        slo = SloEngine(
+            [
+                {
+                    "name": "reject_ratio_ceiling",
+                    "kind": "ratio_max",
+                    "metric": "astpu_admission_rejected_total",
+                    "denominator": "astpu_admission_requests_total",
+                    # shed hard, but never refuse everything: admitted
+                    # work must keep flowing through the storm
+                    "threshold": 0.97,
+                },
+            ]
+        )
+        verdict = slo.evaluate(merged)
+        rejected = sum(
+            v for name, _l, v in merged
+            if name == "astpu_admission_rejected_total"
+        )
+        degraded_step = max(
+            [v for name, _l, v in merged if name == "astpu_degraded_step"]
+            or [0.0],
+        )
+        honored_s = sum(
+            m.value
+            for m in telemetry.REGISTRY.find(
+                "astpu_rpc_overload_backoff_seconds_total"
+            )
+        )
+        report = {
+            "kill_shard": kill_shard,
+            "kill_batch": kill_batch,
+            "annotations": ann,
+            "failovers": float(client._m_failovers.value),
+            "promotions": float(client._m_promotions.value),
+            "spilled": float(client._m_spilled.value),
+            "degraded": float(client._m_degraded.value),
+            "overload_backoff": float(client._m_overload.value),
+            "slow_backoff": float(client._m_slow.value),
+            "retry_after_honored_s": honored_s,
+            "server_rejects": rejected,
+            "degraded_step": degraded_step,
+            "spill_pending": sum(
+                int(k.size)
+                for sh in client._shards
+                for (_r, k, _d) in sh.pending
+            ),
+            "slo_ok": bool(verdict["ok"]),
+            "slo_reject_ratio": verdict["objectives"][0]["value"],
+            "write_targets": [
+                sh.write_target for sh in client._shards
+            ],
+        }
+        client.close()
+        from advanced_scrapper_tpu.storage.fsio import atomic_replace
+
+        atomic_replace(
+            os.path.join(case_dir, "overload_report.json"),
+            json.dumps(report).encode(),
+        )
+        return 0
+    finally:
+        stop_storm.set()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 CHILDREN = {
     "harvest": child_harvest,
     "scrape": child_scrape,
     "stream": child_stream,
     "pindex": child_pindex,
     "fleet": child_fleet,
+    "overload": child_overload,
     "graph": child_graph,
 }
 
@@ -946,6 +1238,62 @@ def verify_fleet(case_dir: str) -> list[str]:
     return problems
 
 
+def verify_overload(case_dir: str) -> list[str]:
+    """Overload-storm acceptance: zero collapse, zero promotions,
+    counted rejects with retry-after honored, no degraded probes, and
+    admitted-work annotations byte-equal to the unloaded oracle."""
+    problems: list[str] = []
+    report_path = os.path.join(case_dir, "overload_report.json")
+    if not os.path.exists(report_path):
+        return ["overload child never wrote its report (storm collapsed)"]
+    with open(report_path) as f:
+        report = json.load(f)
+
+    oracle_ann, _minmap = overload_oracle_annotations()
+    if report["annotations"] != oracle_ann:
+        diff = [
+            i for i, (a, b) in enumerate(zip(report["annotations"], oracle_ann))
+            if a != b
+        ]
+        problems.append(
+            f"admitted-work annotations diverge from the UNLOADED oracle at "
+            f"docs {diff[:5]} (of {len(diff)}) — overload changed semantics"
+        )
+    if report.get("promotions"):
+        problems.append(
+            f"{report['promotions']} promotions under overload — a healthy "
+            "write target lost its seat (overload treated as death)"
+        )
+    if not report.get("failovers"):
+        problems.append(
+            "the mid-storm replica SIGKILL was never observed (the case "
+            "did not exercise death-under-overload)"
+        )
+    if not report.get("server_rejects"):
+        problems.append("the storm never tripped a counted admission reject")
+    if report.get("server_rejects") and not report.get("retry_after_honored_s"):
+        problems.append("rejects happened but no retry-after was ever honored")
+    if report.get("degraded"):
+        problems.append(
+            f"{report['degraded']} probe rows answered degraded — overload "
+            "leaked into the data plane"
+        )
+    if report.get("spill_pending"):
+        problems.append(
+            f"{report['spill_pending']} spilled postings never replayed"
+        )
+    if not report.get("slo_ok", True):
+        problems.append(
+            f"declared reject-ratio SLO violated "
+            f"(ratio={report.get('slo_reject_ratio')})"
+        )
+    if any(wt != 0 for wt in report.get("write_targets", [])):
+        problems.append(
+            f"write targets moved under the storm: {report['write_targets']}"
+        )
+    return problems
+
+
 def check_graph_safety(case_dir: str) -> list[str]:
     """Kill-point invariants for the stage-graph workload: the annotations
     CSV parses (torn tails are the reader's repair problem, never a loss),
@@ -1018,6 +1366,7 @@ VERIFIERS = {
     "stream": verify_stream,
     "pindex": verify_pindex,
     "fleet": verify_fleet,
+    "overload": verify_overload,
     "graph": verify_graph,
 }
 
@@ -1187,6 +1536,55 @@ def sweep_workload(
     }
 
 
+def sweep_overload(base_dir: str, *, kills: int, seed: int = 0) -> dict:
+    """Seeded overload sweep: each case storms a fresh admission-tight
+    fleet at ≥10× capacity with a mid-storm replica SIGKILL, then
+    verifies the zero-collapse/zero-promotion/byte-equality contract.
+    A 'kill landed' = the client watched the replica die (failovers
+    moved) WITHOUT any promotion."""
+    cases = []
+    for i in range(kills):
+        case_seed = seed * 1000 + i
+        case_dir = os.path.join(base_dir, f"overload-k{i}")
+        os.makedirs(case_dir, exist_ok=True)
+        rec: dict = {"workload": "overload", "seed": case_seed}
+        proc = _spawn("overload", case_dir, case_seed, None)
+        try:
+            proc.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            rec["problems"] = ["overload child hung past 240 s"]
+            cases.append(rec)
+            continue
+        problems = []
+        if proc.returncode != 0:
+            problems.append(f"overload child exited {proc.returncode}")
+        problems += verify_overload(case_dir)
+        report_path = os.path.join(case_dir, "overload_report.json")
+        killed = False
+        if os.path.exists(report_path):
+            with open(report_path) as f:
+                r = json.load(f)
+            killed = bool(r.get("failovers")) and not r.get("promotions")
+            rec["counters"] = {
+                k: r.get(k)
+                for k in (
+                    "failovers", "promotions", "server_rejects",
+                    "retry_after_honored_s", "degraded_step",
+                )
+            }
+        rec["killed"] = killed
+        rec["problems"] = problems
+        cases.append(rec)
+    return {
+        "workload": "overload",
+        "cases": cases,
+        "kills": sum(1 for c in cases if c.get("killed")),
+        "problems": [p for c in cases for p in c.get("problems", [])],
+    }
+
+
 def sweep_fleet(base_dir: str, *, kills: int, seed: int = 0) -> dict:
     """Seeded fleet sweep: each case runs the fleet child ONCE (the
     client survives its shard-primary kills and carries the stream to
@@ -1255,7 +1653,7 @@ def main(argv=None) -> int:
     import tempfile
 
     base = args.dir or tempfile.mkdtemp(prefix="crashsweep-")
-    per = max(1, args.kills // 6)
+    per = max(1, args.kills // 7)
     report = {
         "seed": args.seed,
         "workloads": [
@@ -1274,6 +1672,7 @@ def main(argv=None) -> int:
                 chaos_only=PINDEX_CHAOS_TARGETS,
             ),
             sweep_fleet(base, kills=per, seed=args.seed),
+            sweep_overload(base, kills=per, seed=args.seed),
             sweep_workload(
                 "graph",
                 base,
@@ -1284,10 +1683,10 @@ def main(argv=None) -> int:
             sweep_workload(
                 "stream",
                 base,
-                # the remainder: five workloads above each land exactly
+                # the remainder: six workloads above each land exactly
                 # `per` instants, stream takes what's left of --kills
                 # (its one chaos case included)
-                sigkills=max(1, args.kills - 5 * per - 1),
+                sigkills=max(1, args.kills - 6 * per - 1),
                 chaos_kills=1,
                 seed=args.seed,
                 kill_window=(0.05, 1.2),

@@ -1,0 +1,352 @@
+#!/usr/bin/env python
+"""Overload storm driver — prove the admission plane sheds instead of
+collapsing.
+
+Drives a mixed-priority request storm at a declared multiple of a
+server's admitted capacity and reports, machine-readably, the four
+things the overload contract promises:
+
+- **zero collapse**: every offered request ends in an answer — admitted
+  work completes, refused work gets a counted reject with a retry-after
+  hint, nothing times out into the failover path;
+- **counted rejects**: the `astpu_admission_*` / `astpu_rpc_overload_*`
+  ledgers move exactly as much as the storm exceeded capacity;
+- **retry-after honored**: the client-side backoff-seconds counter
+  proves the hints were slept, not ignored;
+- **bounded p99**: admitted-request latency stays under the declared
+  SLO (evaluated through ``obs/slo.py`` — the same engine the fleet
+  collector and bench verdicts ride).
+
+Modes::
+
+    python tools/loadgen.py --smoke             # self-contained: spawns an
+        # in-process admission-bounded RpcServer and storms it (CI smoke)
+    python tools/loadgen.py --address H:P       # storm a live RPC endpoint
+        # (e.g. an IndexShardServer) with mixed-priority __ping__/insert
+
+The crashsweep ``overload`` workload reuses :func:`storm_rpc` against a
+live 2×2 fleet with a mid-storm SIGKILL; this CLI is the operator's
+hand tool and the CI smoke.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+#: priority mix of the storm: (method suffix, priority class, weight)
+PRIORITY_MIX = (("high", 1, 1), ("normal", 2, 2), ("low", 3, 1))
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    ix = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
+    return sorted_vals[ix]
+
+
+def storm_rpc(
+    address,
+    *,
+    methods,
+    rate: float,
+    duration: float,
+    workers: int = 8,
+    timeout: float = 5.0,
+    retries: int = 4,
+    payload=None,
+) -> dict:
+    """Drive ``methods`` (a list of ``(method, weight)``) at ``rate``
+    offered requests/s total for ``duration`` seconds from ``workers``
+    threads; returns the storm ledger (offered / ok / rejected_final /
+    transport_failures, per-method latency percentiles of SUCCESSFUL
+    calls, and the client overload counters' deltas)."""
+    from advanced_scrapper_tpu.net.rpc import (
+        RpcClient,
+        RpcOverloaded,
+        RpcUnavailable,
+    )
+    from advanced_scrapper_tpu.obs import telemetry
+
+    weighted = [m for m, w in methods for _ in range(w)]
+    interval = workers / max(rate, 1e-9)  # per-worker pacing
+    stop_at = time.monotonic() + duration
+    lock = threading.Lock()
+    ledger = {
+        "offered": 0,
+        "ok": 0,
+        "rejected_final": 0,   # still refused after every client retry
+        "transport_failures": 0,
+        "latencies": {m: [] for m, _ in methods},
+    }
+
+    def one_client(wid: int):
+        client = RpcClient(
+            tuple(address), timeout=timeout, retries=retries, seed=wid
+        )
+        k = wid  # stagger the method mix across workers
+        try:
+            while time.monotonic() < stop_at:
+                method = weighted[k % len(weighted)]
+                k += 1
+                t0 = time.perf_counter()
+                try:
+                    client.call(method, dict(payload or {}))
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        ledger["offered"] += 1
+                        ledger["ok"] += 1
+                        ledger["latencies"][method].append(dt)
+                except RpcOverloaded:
+                    with lock:
+                        ledger["offered"] += 1
+                        ledger["rejected_final"] += 1
+                except RpcUnavailable:
+                    with lock:
+                        ledger["offered"] += 1
+                        ledger["transport_failures"] += 1
+                sleep_left = interval - (time.perf_counter() - t0)
+                if sleep_left > 0:
+                    time.sleep(sleep_left)
+        finally:
+            client.close()
+
+    over0 = sum(
+        m.value for m in telemetry.REGISTRY.find("astpu_rpc_client_overloaded_total")
+    )
+    wait0 = sum(
+        m.value
+        for m in telemetry.REGISTRY.find("astpu_rpc_overload_backoff_seconds_total")
+    )
+    threads = [
+        threading.Thread(target=one_client, args=(i,), daemon=True)
+        for i in range(workers)
+    ]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + 60)
+    elapsed = time.monotonic() - t_start
+    out = {
+        "offered": ledger["offered"],
+        "ok": ledger["ok"],
+        "rejected_final": ledger["rejected_final"],
+        "transport_failures": ledger["transport_failures"],
+        "elapsed_s": round(elapsed, 3),
+        "offered_rate": round(ledger["offered"] / max(elapsed, 1e-9), 1),
+        "client_overload_answers": sum(
+            m.value
+            for m in telemetry.REGISTRY.find("astpu_rpc_client_overloaded_total")
+        )
+        - over0,
+        "retry_after_honored_s": round(
+            sum(
+                m.value
+                for m in telemetry.REGISTRY.find(
+                    "astpu_rpc_overload_backoff_seconds_total"
+                )
+            )
+            - wait0,
+            4,
+        ),
+        "latency_ms": {},
+    }
+    for m, vals in ledger["latencies"].items():
+        vals.sort()
+        out["latency_ms"][m] = {
+            "n": len(vals),
+            "p50": round(_percentile(vals, 0.50) * 1e3, 3),
+            "p99": round(_percentile(vals, 0.99) * 1e3, 3),
+        }
+    return out
+
+
+def admission_snapshot() -> dict:
+    """The `astpu_admission_*` / degradation ledger as plain numbers —
+    what the bench regimes and the crashsweep verifier read."""
+    from advanced_scrapper_tpu.obs import telemetry
+
+    def total(name, **labels):
+        return sum(
+            m.value
+            for m in telemetry.REGISTRY.find(name)
+            if all(m.labels.get(k) == v for k, v in labels.items())
+        )
+
+    # degraded step: max across ladders (callback gauges — read via the
+    # flat-sample path the SLO engine uses, not find())
+    from advanced_scrapper_tpu.obs.slo import SloEngine
+
+    step = 0.0
+    for name, _labels, v in SloEngine.registry_samples():
+        if name == "astpu_degraded_step":
+            step = max(step, v)
+    return {
+        "admitted": total("astpu_admission_requests_total", outcome="admitted"),
+        "rejected": total("astpu_admission_requests_total", outcome="rejected"),
+        "rejects_by_reason": {
+            m.labels.get("reason", "?"): m.value
+            for m in telemetry.REGISTRY.find("astpu_admission_rejected_total")
+        },
+        "server_overload_rejects": total("astpu_rpc_overload_rejects_total"),
+        "degraded_step": step,
+    }
+
+
+def run_smoke(
+    *, rate_multiple: float = 10.0, duration: float = 1.5, workers: int = 6
+) -> dict:
+    """Self-contained storm: an in-process RpcServer whose admission
+    rate is deliberately tiny, stormed at ``rate_multiple``× that
+    capacity with the declared priority mix, verdict via the SLO
+    engine."""
+    from advanced_scrapper_tpu.net.rpc import RpcServer
+    from advanced_scrapper_tpu.obs import telemetry
+    from advanced_scrapper_tpu.obs.slo import SloEngine
+    from advanced_scrapper_tpu.runtime.admission import (
+        AdmissionController,
+        DegradationLadder,
+    )
+
+    # the p99 objective reads the server latency histogram, which is
+    # telemetry-gated — the smoke declares an SLO, so it turns the
+    # plane on for its own window
+    telemetry_was = telemetry.enabled()
+    if not telemetry_was:
+        telemetry.set_enabled(True)
+
+    capacity = 40.0  # admitted requests/s the server declares
+    ladder = DegradationLadder(dwell_s=0.2, name="loadgen")
+    ctrl = AdmissionController(
+        rate=capacity, burst=capacity / 4, max_inflight=workers * 2,
+        ladder=ladder, name="loadgen",
+    )
+
+    def work(header, arrays):
+        time.sleep(0.002)
+        return {"ok": True}
+
+    handlers = {f"work_{sfx}": work for sfx, _p, _w in PRIORITY_MIX}
+    srv = RpcServer(
+        handlers,
+        admission=ctrl,
+        method_priority={
+            f"work_{sfx}": prio for sfx, prio, _w in PRIORITY_MIX
+        },
+        name="loadgen",
+    ).start()
+    slo = SloEngine(
+        [
+            {
+                "name": "admitted_p99",
+                "kind": "p99_latency_max",
+                "metric": "astpu_rpc_server_seconds",
+                "labels": {"server": "loadgen"},
+                "threshold": 0.25,
+            },
+            {
+                "name": "reject_ratio_ceiling",
+                "kind": "ratio_max",
+                "metric": "astpu_admission_rejected_total",
+                "denominator": "astpu_admission_requests_total",
+                # a 10× storm MUST reject ~90%; the ceiling says "shed,
+                # don't collapse", not "don't shed"
+                "threshold": 0.97,
+            },
+        ]
+    )
+    slo.evaluate()
+    try:
+        report = storm_rpc(
+            ("127.0.0.1", srv.port),
+            methods=[(f"work_{sfx}", w) for sfx, _p, w in PRIORITY_MIX],
+            rate=capacity * rate_multiple,
+            duration=duration,
+            workers=workers,
+            retries=2,
+        )
+    finally:
+        srv.stop()
+    report["admission"] = admission_snapshot()
+    report["slo"] = slo.evaluate()
+    if not telemetry_was:
+        telemetry.set_enabled(None)
+    report["capacity_rps"] = capacity
+    report["rate_multiple"] = rate_multiple
+    problems = []
+    if report["transport_failures"]:
+        problems.append(
+            f"{report['transport_failures']} calls died on transport — "
+            "overload leaked into the failover path"
+        )
+    if not report["ok"]:
+        problems.append("no admitted work completed")
+    if not report["admission"]["rejected"]:
+        problems.append("a 10x storm never tripped a reject")
+    if report["retry_after_honored_s"] <= 0 and report["client_overload_answers"]:
+        problems.append("client never honored a retry-after hint")
+    if not report["slo"]["ok"]:
+        problems.append(f"declared SLO violated: {report['slo']}")
+    report["problems"] = problems
+    report["ok_verdict"] = not problems
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="self-contained storm against an in-process server",
+    )
+    ap.add_argument("--address", default=None, help="host:port to storm")
+    ap.add_argument(
+        "--methods", default="__ping__",
+        help="comma-separated method list for --address mode",
+    )
+    ap.add_argument("--rate", type=float, default=400.0, help="offered req/s")
+    ap.add_argument(
+        "--rate-multiple", type=float, default=10.0,
+        help="smoke mode: offered rate as a multiple of declared capacity",
+    )
+    ap.add_argument("--duration", type=float, default=1.5, help="seconds")
+    ap.add_argument("--workers", type=int, default=6)
+    ap.add_argument("--out", default=None, help="write the JSON report here")
+    args = ap.parse_args(argv)
+
+    if args.smoke or not args.address:
+        report = run_smoke(
+            rate_multiple=args.rate_multiple,
+            duration=args.duration,
+            workers=args.workers,
+        )
+    else:
+        host, _, port = args.address.rpartition(":")
+        report = storm_rpc(
+            (host, int(port)),
+            methods=[(m, 1) for m in args.methods.split(",") if m],
+            rate=args.rate,
+            duration=args.duration,
+            workers=args.workers,
+        )
+        report["admission"] = admission_snapshot()
+        report["problems"] = []
+        report["ok_verdict"] = report["transport_failures"] == 0
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    print(text)
+    return 0 if report.get("ok_verdict") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
