@@ -52,6 +52,23 @@ def build_mesh(
     return Mesh(grid, (data_axis, seq_axis))
 
 
+def parse_mesh_shape(spec: str) -> tuple[int, int]:
+    """``"2x4"`` → ``(2, 4)`` — the (data, seq) shape of a mesh-sweep
+    axis (``tools/sweep_onchip.py --mesh``, ``ASTPU_BENCH_MESH``).  One
+    parser so the sweep driver, the bench and operators' notes all mean
+    the same thing by ``DxS``."""
+    parts = spec.lower().strip().split("x")
+    if len(parts) != 2:
+        raise ValueError(f"mesh shape {spec!r} is not of the form DxS")
+    try:
+        dp, sp = int(parts[0]), int(parts[1])
+    except ValueError as e:
+        raise ValueError(f"mesh shape {spec!r} is not of the form DxS") from e
+    if dp < 1 or sp < 1:
+        raise ValueError(f"mesh shape {spec!r} must be positive")
+    return dp, sp
+
+
 def shard_map_compat(f, *, mesh, in_specs, out_specs):
     """``jax.shard_map`` across the API move: newer jax exposes it at the
     top level (``check_vma``), older releases under

@@ -115,12 +115,17 @@ _DEV_NAMES = (
     "astpu_device_dispatches_total",
     "astpu_h2d_bytes_total",
 )
-_dev_counters: dict[tuple[str, str], telemetry.Counter] = {}
+_dev_counters: dict[tuple[str, str, str | None], telemetry.Counter] = {}
 
 
-def _dev(name: str, regime: str) -> telemetry.Counter:
-    c = _dev_counters.get((name, regime))
+def _dev(name: str, regime: str, shard: str | None = None) -> telemetry.Counter:
+    c = _dev_counters.get((name, regime, shard))
     if c is None:
+        labels = {"regime": regime}
+        if shard is not None:
+            # the mesh-sharded planes label traffic per device shard, so
+            # the per-shard 1-put/1-dispatch contract is a ledger fact
+            labels["shard"] = shard
         c = telemetry.event_counter(
             name,
             {
@@ -128,22 +133,33 @@ def _dev(name: str, regime: str) -> telemetry.Counter:
                 "astpu_device_dispatches_total": "jitted device dispatches",
                 "astpu_h2d_bytes_total": "host→device bytes shipped by puts",
             }[name],
-            regime=regime,
+            **labels,
         )
         with _lock:
-            _dev_counters[(name, regime)] = c
+            _dev_counters[(name, regime, shard)] = c
     return c
 
 
-def count_device_put(nbytes: int, regime: str = "dedup") -> None:
-    """Record one explicit ``jax.device_put`` of ``nbytes``."""
-    _dev("astpu_device_puts_total", regime).inc()
-    _dev("astpu_h2d_bytes_total", regime).inc(nbytes)
+def count_device_put(
+    nbytes: int, regime: str = "dedup", *, shard: int | str | None = None
+) -> None:
+    """Record one explicit ``jax.device_put`` of ``nbytes`` (``shard``:
+    the mesh row-shard the buffer landed on, for the sharded planes)."""
+    shard = None if shard is None else str(shard)
+    _dev("astpu_device_puts_total", regime, shard).inc()
+    _dev("astpu_h2d_bytes_total", regime, shard).inc(nbytes)
 
 
-def count_dispatch(regime: str = "dedup", n: int = 1) -> None:
-    """Record ``n`` jitted device dispatches."""
-    _dev("astpu_device_dispatches_total", regime).inc(n)
+def count_dispatch(
+    regime: str = "dedup", n: int = 1, *, shard: int | str | None = None
+) -> None:
+    """Record ``n`` jitted device dispatches (``shard``: the mesh
+    row-shard that executed them — one partitioned launch executes once
+    per device, so the sharded planes count it once per shard)."""
+    _dev(
+        "astpu_device_dispatches_total", regime,
+        None if shard is None else str(shard),
+    ).inc(n)
 
 
 def device_counters() -> dict[str, float]:
@@ -160,6 +176,63 @@ def device_counters() -> dict[str, float]:
         for c in telemetry.REGISTRY.find(name):
             out[key] += c.value
     return out
+
+
+def sharded_device_counters(regime: str = "sharded") -> dict[str, dict[str, float]]:
+    """Per-shard cumulative device-traffic totals for one regime:
+    ``{shard: {"device_puts", "device_dispatches", "h2d_bytes"}}`` —
+    only shard-labelled series count (the single-device planes never
+    carry the label).  Subtract two snapshots to window a corpus; the
+    sharded launch-count gates (tier-1 and the MULTICHIP dryrun) assert
+    every shard's delta is exactly tiles + 1 / tiles + 1."""
+    short = {
+        "astpu_device_puts_total": "device_puts",
+        "astpu_device_dispatches_total": "device_dispatches",
+        "astpu_h2d_bytes_total": "h2d_bytes",
+    }
+    out: dict[str, dict[str, float]] = {}
+    for name, key in short.items():
+        for c in telemetry.REGISTRY.find(name):
+            shard = c.labels.get("shard")
+            if shard is None or c.labels.get("regime") != regime:
+                continue
+            per = out.setdefault(
+                shard,
+                {"device_puts": 0.0, "device_dispatches": 0.0, "h2d_bytes": 0.0},
+            )
+            per[key] += c.value
+    return out
+
+
+def record_sharded_put_skew(
+    baseline: dict | None = None, regime: str = "sharded"
+) -> float:
+    """Max−min per-shard put count across the shard-labelled ledger,
+    recorded on the always-on ``astpu_sharded_put_skew`` gauge — the
+    bench's SLO hook: a balanced sharded plane (every shard exactly
+    tiles + 1 puts) reads 0, and the declared ``gauge_max`` objective
+    turns any imbalance into a machine-readable verdict.
+
+    ``baseline`` — a prior :func:`sharded_device_counters` snapshot —
+    windows the computation to the work since that snapshot, and only
+    shards ACTIVE in the window count: cumulative totals would read a
+    permanent false skew in any process that ran corpora on meshes with
+    different shard counts (an 8-shard corpus then a 4-shard one leaves
+    shards 4-7 forever behind, with every corpus perfectly balanced)."""
+    per = sharded_device_counters(regime)
+    base = baseline or {}
+    puts = [
+        v["device_puts"] - base.get(s, {}).get("device_puts", 0.0)
+        for s, v in per.items()
+    ]
+    puts = [p for p in puts if p > 0]  # shards active in the window
+    skew = (max(puts) - min(puts)) if puts else 0.0
+    telemetry.REGISTRY.gauge(
+        "astpu_sharded_put_skew",
+        "max-min per-shard device_put count (0 = balanced sharded ledger)",
+        always=True,
+    ).set(skew)
+    return skew
 
 
 def _clear_for_tests() -> None:

@@ -5,6 +5,11 @@ from advanced_scrapper_tpu.parallel.sharded import (
     make_sharded_dedup,
     shard_batch,
 )
+from advanced_scrapper_tpu.parallel.sharded_packed import (
+    make_sharded_fused_tile_step,
+    make_sharded_keys_epilogue,
+    make_sharded_resolve_epilogue,
+)
 from advanced_scrapper_tpu.parallel.dist import initialize_multihost
 
 __all__ = [
@@ -12,6 +17,9 @@ __all__ = [
     "seq_sharded_signatures",
     "make_seq_sharded_signatures",
     "make_sharded_dedup",
+    "make_sharded_fused_tile_step",
+    "make_sharded_keys_epilogue",
+    "make_sharded_resolve_epilogue",
     "shard_batch",
     "initialize_multihost",
 ]

@@ -75,6 +75,23 @@ def _tile_rows_options(bs: int) -> list[int]:
     return tile_rows_options(bs, 64)
 
 
+def _prewarm_widths(cfg: DedupConfig) -> list[int]:
+    """The chunker's width-bucket set: powers of two below ``block_len``
+    (mirroring ``bucket_widths(..., max_bucket=block_len)``) plus
+    ``block_len`` itself — the body/long-tail bucket, which need not be a
+    power of two and must not be skipped or prewarm misses the dominant
+    width.  THE single source for every prewarm (single-device and
+    mesh-sharded): a width added to the chunker without landing here
+    would silently disjoint the prewarmed set (the PR 9 lesson)."""
+    widths = []
+    w = 64
+    while w < cfg.block_len:
+        widths.append(w)
+        w *= 2
+    widths.append(cfg.block_len)
+    return widths
+
+
 def resolve_put_workers(cfg: DedupConfig) -> int:
     """Effective H2D put-thread count: ``cfg.put_workers``, with 0 meaning
     the transport default (``core.mesh.auto_h2d_workers`` — 4 on the
@@ -286,17 +303,7 @@ class NearDupEngine:
         )
         step = self._get_fused_step()
         compiled = 0
-        # the width set mirrors bucket_widths(..., max_bucket=block_len):
-        # powers of two BELOW block_len, plus block_len itself (the body/
-        # long-tail bucket — which need not be a power of two, and must
-        # not be skipped or prewarm misses the dominant width)
-        widths = []
-        w = 64
-        while w < cfg.block_len:
-            widths.append(w)
-            w *= 2
-        widths.append(cfg.block_len)
-        for w in widths:
+        for w in _prewarm_widths(cfg):
             # same derivation as the encode chunker (_tile_bs /
             # _tile_rows_options) — shared helpers, never re-derived here
             for rows in _tile_rows_options(_tile_bs(cfg, w)):
@@ -310,64 +317,29 @@ class NearDupEngine:
                 compiled += 1
         return compiled
 
-    def _accumulate_device(self, texts: Sequence[str | bytes], trace_id=None):
-        """``(running, n_bucket, use_oph)``: the device-resident combined
-        signature accumulator (RAW for the OPH backend — densify happens
-        once downstream) after streaming every tile through the pipelined
-        dispatch executor.
-
-        The ragged corpus is grouped by power-of-two *width buckets* (a doc
-        of 700 B rides a 1024-wide row, not a block_len-wide one) and docs
-        longer than ``cfg.block_len`` split blockwise; every group folds
-        into one running per-article minimum on device.  Three properties
-        are load-bearing for throughput on an H2D-constrained link (the
-        ragged regime is transfer-bound, not compute-bound — DESIGN.md §5):
-
-        - bucketing cuts padded bytes on realistic length mixes vs
-          one-width encoding, and padding that remains is zeros (cheap for
-          a compressing transport);
-        - each tile crosses the boundary as ONE packed ``device_put``
-          (``ops/pack.py``) and ONE fused jitted dispatch with the
-          accumulator donated (``ops.minhash.make_fused_tile_step``) —
-          down from three serialized puts + two dispatches per tile
-          (``cfg.packed_h2d=False`` restores that legacy transport, kept
-          byte-identical for parity certification);
-        - puts queue ahead of compute (async dispatch, no host sync until
-          the caller materialises a result), and the
-          encode→pack→put→dispatch stages run pipelined with a bounded
-          in-flight window (``pipeline/dispatch.py``).
-
-        Rows past ``len(texts)`` are untouched ⇒ all-``U32_MAX``.
-        """
+    def _host_tiles(self, raw: list, trace_id=None):
+        """Width-bucketed power-of-two tile generator ``(tok, lens,
+        owners)`` — THE shared encode chunker of every packed dedup
+        plane: the single-device executor (:meth:`_accumulate_device`)
+        and the mesh-sharded one (:meth:`_accumulate_device_sharded`)
+        both draw tiles from here, so their shape sets — and the
+        prewarmed sets (``_tile_bs``/``_tile_rows_options``, shared) —
+        can never silently diverge.  The eager prologue (vectorised
+        range bucketing) runs on the caller's thread; per-group encode
+        and greedy chunking run lazily where the consumer pulls."""
         cfg, params = self.cfg, self.params
-        use_oph = cfg.backend == "oph"
-        resolve_signature_fn(cfg.backend)  # validates the name up front
-
-        import jax
-        import jax.numpy as jnp
-
-        from advanced_scrapper_tpu.core.mesh import maybe_enable_compile_cache
-
-        maybe_enable_compile_cache()
 
         from advanced_scrapper_tpu.cpu.hostbatch import (
             block_counts,
             encode_blocks_ranges,
         )
         from advanced_scrapper_tpu.obs import stages, trace
-        from advanced_scrapper_tpu.ops.minhash import accumulate_block_signatures
-        from advanced_scrapper_tpu.ops.shingle import U32_MAX
 
-        tid = trace_id or trace.new_trace_id()
-        raw = [to_bytes(t) for t in texts]
         n = len(raw)
-        # Bucket the article count so combine compiles O(log N) variants, not
-        # one per corpus size (same trick as the block-length axis).
-        n_bucket = bucket_len(n, min_bucket=64)
         overlap = params.shingle_k - 1
         stride = cfg.block_len - overlap
         with stages.timed("encode"), trace.span(
-            "dedup.encode", trace=tid, docs=n
+            "dedup.encode", trace=trace_id, docs=n
         ):
             # Vectorised RANGE bucketing, one numpy pass, no per-article
             # Python loop.  Every document becomes one TAIL range (the
@@ -473,6 +445,61 @@ class NearDupEngine:
                         o = np.concatenate([o, np.zeros((pad,), np.int32)])
                     yield (t, l, o)
                     start += rows
+
+        return host_batches()
+
+    def _accumulate_device(self, texts: Sequence[str | bytes], trace_id=None):
+        """``(running, n_bucket, use_oph)``: the device-resident combined
+        signature accumulator (RAW for the OPH backend — densify happens
+        once downstream) after streaming every tile through the pipelined
+        dispatch executor.
+
+        The ragged corpus is grouped by power-of-two *width buckets* (a doc
+        of 700 B rides a 1024-wide row, not a block_len-wide one) and docs
+        longer than ``cfg.block_len`` split blockwise (:meth:`_host_tiles`,
+        the shared chunker); every group folds into one running per-article
+        minimum on device.  Three properties are load-bearing for
+        throughput on an H2D-constrained link (the ragged regime is
+        transfer-bound, not compute-bound — DESIGN.md §5):
+
+        - bucketing cuts padded bytes on realistic length mixes vs
+          one-width encoding, and padding that remains is zeros (cheap for
+          a compressing transport);
+        - each tile crosses the boundary as ONE packed ``device_put``
+          (``ops/pack.py``) and ONE fused jitted dispatch with the
+          accumulator donated (``ops.minhash.make_fused_tile_step``) —
+          down from three serialized puts + two dispatches per tile
+          (``cfg.packed_h2d=False`` restores that legacy transport, kept
+          byte-identical for parity certification);
+        - puts queue ahead of compute (async dispatch, no host sync until
+          the caller materialises a result), and the
+          encode→pack→put→dispatch stages run pipelined with a bounded
+          in-flight window (``pipeline/dispatch.py``).
+
+        Rows past ``len(texts)`` are untouched ⇒ all-``U32_MAX``.
+        """
+        cfg, params = self.cfg, self.params
+        use_oph = cfg.backend == "oph"
+        resolve_signature_fn(cfg.backend)  # validates the name up front
+
+        import jax
+        import jax.numpy as jnp
+
+        from advanced_scrapper_tpu.core.mesh import maybe_enable_compile_cache
+
+        maybe_enable_compile_cache()
+
+        from advanced_scrapper_tpu.obs import stages, trace
+        from advanced_scrapper_tpu.ops.minhash import accumulate_block_signatures
+        from advanced_scrapper_tpu.ops.shingle import U32_MAX
+
+        tid = trace_id or trace.new_trace_id()
+        raw = [to_bytes(t) for t in texts]
+        n = len(raw)
+        # Bucket the article count so combine compiles O(log N) variants, not
+        # one per corpus size (same trick as the block-length axis).
+        n_bucket = bucket_len(n, min_bucket=64)
+        host_batches = self._host_tiles(raw, trace_id=tid)
 
         # The tile plane rides the pipelined dispatch executor
         # (pipeline/dispatch.py): a pack stage draws width-group tiles off
@@ -585,7 +612,7 @@ class NearDupEngine:
             )
             self.ladder.count_effect("shrink_window")
         pipe = PipelinedDispatcher(
-            host_batches(),
+            host_batches,
             pack=pack,
             put=put,
             put_workers=put_workers,
@@ -790,16 +817,379 @@ class NearDupEngine:
             stages.count_dispatch("dedup")
             return rep
 
+    # -- mesh-sharded packed plane (pod-scale dedup) ---------------------------
+
+    def _get_sharded_fused_step(self, mesh):
+        """The mesh's shard_map'd single-dispatch tile step (params
+        constant-folded, accumulator donated per shard) — cached per
+        mesh; jit then caches per static (rows, width, num_articles),
+        the same shape set :meth:`prewarm_sharded` compiles."""
+        key = (mesh, "fused")
+        step = self._sharded_steps.get(key)
+        if step is None:
+            from advanced_scrapper_tpu.parallel.sharded_packed import (
+                make_sharded_fused_tile_step,
+            )
+
+            step = make_sharded_fused_tile_step(
+                mesh, self.params, self.cfg.backend
+            )
+            self._sharded_steps[key] = step
+        return step
+
+    def _get_sharded_init(self, mesh):
+        key = (mesh, "init")
+        init = self._sharded_steps.get(key)
+        if init is None:
+            from advanced_scrapper_tpu.parallel.sharded_packed import (
+                make_sharded_accumulator_init,
+            )
+
+            init = make_sharded_accumulator_init(mesh, self.params.num_perm)
+            self._sharded_steps[key] = init
+        return init
+
+    def _get_sharded_epilogue(self, mesh):
+        """The end-of-corpus combine+resolve dispatch (``pmin`` across
+        shards, then the async path's estimator-only resolution)."""
+        key = (mesh, "resolve")
+        epi = self._sharded_steps.get(key)
+        if epi is None:
+            from advanced_scrapper_tpu.parallel.sharded_packed import (
+                make_sharded_resolve_epilogue,
+            )
+
+            epi = make_sharded_resolve_epilogue(
+                mesh,
+                self.params,
+                threshold=self.cfg.sim_threshold,
+                fine_margin=self.cfg.fine_margin,
+                fine_salt=self._fine_salt(),
+                backend=self.cfg.backend,
+            )
+            self._sharded_steps[key] = epi
+        return epi
+
+    def _get_sharded_keys_epilogue(self, mesh):
+        key = (mesh, "keys")
+        epi = self._sharded_steps.get(key)
+        if epi is None:
+            from advanced_scrapper_tpu.parallel.sharded_packed import (
+                make_sharded_keys_epilogue,
+            )
+
+            epi = make_sharded_keys_epilogue(mesh, self.params, self.cfg.backend)
+            self._sharded_steps[key] = epi
+        return epi
+
+    def _sharded_tile_groups(self, tiles, nsh: int):
+        """Group the shared chunker's same-shape tiles into per-shard
+        groups of ``nsh`` — one group = one partitioned dispatch, each
+        shard owning one tile.  The min-combine is order- and
+        placement-independent, so which shard folds which tile never
+        shows in the output.  A shape's leftover group pads with zero
+        tiles (lens 0 ⇒ all-``U32_MAX`` signatures, the min identity —
+        exactly how in-tile padding rows already behave), so every
+        shard's ledger stays uniform: tiles + 1 puts, tiles + 1
+        dispatches per corpus, per shard."""
+        pending: dict = {}
+        for t, l, o in tiles:
+            shape = (t.shape[0], t.shape[1])
+            bucket = pending.setdefault(shape, [])
+            bucket.append((t, l, o))
+            if len(bucket) == nsh:
+                yield shape, pending.pop(shape)
+        for (rows, w), bucket in list(pending.items()):
+            while len(bucket) < nsh:
+                bucket.append(
+                    (
+                        np.zeros((rows, w), np.uint8),
+                        np.zeros((rows,), np.int32),
+                        np.zeros((rows,), np.int32),
+                    )
+                )
+            yield (rows, w), bucket
+
+    def _accumulate_device_sharded(self, raw: list, mesh, trace_id=None):
+        """``(running, n_bucket, use_oph)`` — the sharded twin of
+        :meth:`_accumulate_device`: the same shared chunker feeds the
+        same pipelined executor (``pipeline/dispatch.py``, a sharded
+        source on the one graph), but each tile group crosses H2D as one
+        packed ``device_put`` PER SHARD (this host puts its local shards
+        only) assembled into a global dim-0-sharded buffer — zero-copy —
+        and dispatches as ONE partitioned fused step that folds every
+        shard's tile into its own DONATED accumulator row.  Per-shard
+        ledger (``shard=`` label on the always-on device counters):
+        exactly tiles + 1 puts and tiles + 1 dispatches per corpus, the
+        single-device plane's contract applied at pod scale.  ``raw``
+        is the already-``to_bytes``-converted corpus (both callers
+        convert once at their boundary)."""
+        cfg, params = self.cfg, self.params
+        use_oph = cfg.backend == "oph"
+        resolve_signature_fn(cfg.backend)  # validates the name up front
+
+        import jax
+
+        from advanced_scrapper_tpu.core.mesh import maybe_enable_compile_cache
+
+        maybe_enable_compile_cache()
+
+        from advanced_scrapper_tpu.obs import stages, trace
+        from advanced_scrapper_tpu.ops.pack import pack_tile
+        from advanced_scrapper_tpu.parallel.sharded_packed import (
+            assemble_packed_tiles,
+            local_shard_rows,
+            mesh_num_shards,
+            shard_row_devices,
+        )
+        from advanced_scrapper_tpu.pipeline.dispatch import PipelinedDispatcher
+
+        tid = trace_id or trace.new_trace_id()
+        n = len(raw)
+        n_bucket = bucket_len(n, min_bucket=64)
+        nsh = mesh_num_shards(mesh)
+        devices = shard_row_devices(mesh)
+        local_rows = local_shard_rows(mesh)
+        step = self._get_sharded_fused_step(mesh)
+        tiles = self._sharded_tile_groups(self._host_tiles(raw, trace_id=tid), nsh)
+
+        from advanced_scrapper_tpu.ops.pack import packed_nbytes
+
+        def pack(group):
+            (rows, w), batch = group
+            with stages.timed("encode"):  # host memcpy: encode plane
+                # LOCAL shards only: a remote shard's tile is packed (and
+                # put) by the host that owns it — packing all n_shards
+                # here would burn encode-plane memcpy on buffers this
+                # host immediately discards
+                bufs = {s: pack_tile(*batch[s]) for s in local_rows}
+            return bufs, rows, w
+
+        def put(item):
+            bufs, rows, w = item
+            t0 = time.perf_counter()
+            nb = packed_nbytes(rows, w)  # uniform across shards
+            with stages.timed("h2d"):
+                shards = []
+                for s in local_rows:
+                    # one put per shard per tile, onto the device that
+                    # owns that accumulator row (shard_row_devices —
+                    # derived from the sharding's index map)
+                    shards.append(jax.device_put(bufs[s][None], devices[s]))
+                    stages.count_device_put(
+                        bufs[s].nbytes, "sharded", shard=s
+                    )
+                packed = assemble_packed_tiles(mesh, shards, nb)
+            nbytes = sum(bufs[s].nbytes for s in local_rows)
+            return packed, rows, w, nbytes, time.perf_counter() - t0
+
+        def dispatch(running, item):
+            packed, rows, w, _nb, _pms = item
+            out = step(
+                running, packed, rows=rows, width=w, num_articles=n_bucket
+            )
+            # one partitioned launch = one execution per shard
+            for s in local_rows:
+                stages.count_dispatch("sharded", shard=s)
+            return out
+
+        running = self._get_sharded_init(mesh)(num_articles=n_bucket)
+        probe = self.dispatch_probe
+        pipe = PipelinedDispatcher(
+            tiles,
+            pack=pack,
+            put=put,
+            put_workers=resolve_put_workers(cfg),
+            window=cfg.dispatch_window,
+            name="dedup.sharded.h2d",
+        )
+        dispatched = 0
+        try:
+            for item in pipe:
+                t0 = time.perf_counter()
+                rows = int(item[1])
+                with stages.timed("kernel"), self.step_timer.step(rows * nsh):
+                    running = dispatch(running, item)
+                if probe is not None:
+                    probe(
+                        {
+                            "tile": dispatched,
+                            "rows": rows,
+                            "width": int(item[2]),
+                            "shards": nsh,
+                            "h2d_bytes": int(item[3]),
+                            "put_ms": round(item[4] * 1e3, 3),
+                            "dispatch_ms": round(
+                                (time.perf_counter() - t0) * 1e3, 3
+                            ),
+                        }
+                    )
+                dispatched += 1
+        finally:
+            pipe.close()
+        self._m_batches.inc(dispatched)
+        self.last_tiles = dispatched
+        if trace.RECORDER.active:
+            trace.record(
+                "span", "dedup.dispatch", trace=tid,
+                batches=dispatched, docs=n, shards=nsh,
+            )
+        return running, n_bucket, use_oph
+
+    def _valid_device_sharded(self, raw: list, n_bucket: int, mesh):
+        """Replicated device ``bool[n_bucket]`` eligibility mask — the
+        sharded twin of :meth:`_valid_device` (one replica lands on every
+        shard, so the ledger counts one put per shard)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from advanced_scrapper_tpu.obs import stages
+        from advanced_scrapper_tpu.parallel.sharded_packed import (
+            local_shard_rows,
+        )
+
+        n = len(raw)
+        lens = np.fromiter((len(r) for r in raw), np.int64, count=n)
+        valid = np.zeros((n_bucket,), bool)
+        valid[:n] = lens >= self.params.shingle_k
+        dev = jax.device_put(valid, NamedSharding(mesh, P(None)))
+        for s in local_shard_rows(mesh):
+            stages.count_device_put(valid.nbytes, "sharded", shard=s)
+        return dev
+
+    def prewarm_sharded(self, mesh, n_articles: int | None = None) -> int:
+        """Compile the sharded packed plane's (mesh, bucket, rows) shape
+        set ahead of the first corpus — the sharded twin of
+        :meth:`prewarm`, drawing from the SAME derivation
+        (``_prewarm_widths`` × ``_tile_bs``/``_tile_rows_options``) the
+        shared chunker emits, so the two shape sets cannot silently
+        disjoint (the PR 9 lesson, jit-cache-asserted in tier-1).  Also
+        compiles the end-of-corpus resolve epilogue for the bucket.
+        With ``ASTPU_COMPILE_CACHE`` set the compiles persist across
+        processes.  Returns the number of shape variants compiled."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from advanced_scrapper_tpu.core.mesh import maybe_enable_compile_cache
+        from advanced_scrapper_tpu.ops.pack import packed_nbytes
+        from advanced_scrapper_tpu.parallel.sharded_packed import (
+            assemble_packed_tiles,
+            local_shard_rows,
+            shard_row_devices,
+        )
+
+        maybe_enable_compile_cache()
+        cfg = self.cfg
+        n_bucket = bucket_len(
+            n_articles if n_articles else cfg.batch_size, min_bucket=64
+        )
+        step = self._get_sharded_fused_step(mesh)
+        init = self._get_sharded_init(mesh)
+        devices = shard_row_devices(mesh)
+        local_rows = local_shard_rows(mesh)
+        compiled = 0
+        for w in _prewarm_widths(cfg):
+            for rows in _tile_rows_options(_tile_bs(cfg, w)):
+                running = init(num_articles=n_bucket)
+                nb = packed_nbytes(rows, w)
+                zeros = np.zeros((1, nb), np.uint8)
+                shards = [
+                    jax.device_put(zeros, devices[s]) for s in local_rows
+                ]
+                packed = assemble_packed_tiles(mesh, shards, nb)
+                step(
+                    running, packed, rows=rows, width=w, num_articles=n_bucket
+                ).block_until_ready()
+                compiled += 1
+        # the per-bucket epilogue (combine + resolve) compiles here too,
+        # so the first corpus pays zero compiles end to end
+        running = init(num_articles=n_bucket)
+        valid = jax.device_put(
+            np.zeros((n_bucket,), bool), NamedSharding(mesh, P(None))
+        )
+        self._get_sharded_epilogue(mesh)(
+            running, valid, jump_rounds=_jump_rounds(n_bucket)
+        ).block_until_ready()
+        compiled += 1
+        return compiled
+
     def dedup_reps_sharded(self, texts: Sequence[str | bytes], mesh) -> np.ndarray:
-        """int32[N] representatives via the mesh-sharded FUSED step: blockwise
-        encode → ``parallel.sharded.make_sharded_block_dedup`` (per-article
-        segment-min combined with ``lax.pmin`` inside the device step, then
-        LSH resolution) — the multi-device path with NO host-side combine
-        pass between the encoder and resolution.  Same estimator-only
-        resolution semantics as :meth:`dedup_reps_async` (parity-tested);
-        use the one-shot :meth:`dedup_reps` when the exact-verify precision
-        path is required.
-        """
+        """int32[N] representatives over a device mesh — the pod-scale
+        twin of :meth:`dedup_reps_async`'s estimator-only resolution
+        (byte-identical, parity-tested; use the one-shot
+        :meth:`dedup_reps` when the exact-verify precision path is
+        required).
+
+        Default (``cfg.packed_h2d``): the PACKED plane — the shared
+        width-bucketed chunker feeds per-shard packed single-put tiles
+        through the pipelined executor into one partitioned fused
+        donated dispatch per tile group (1 put + 1 dispatch per tile per
+        shard, shard-labelled on the always-on ledger), with the
+        cross-shard ``pmin`` combine + LSH resolution as one end-of-corpus
+        epilogue dispatch.  ``ASTPU_DEDUP_PACKED_H2D=0`` restores the
+        legacy unpacked transport (blockwise ``encode_blocks`` →
+        ``make_sharded_block_dedup``), kept byte-identical as the parity
+        oracle."""
+        if self.cfg.packed_h2d:
+            return self._dedup_reps_sharded_packed(texts, mesh)
+        return self._dedup_reps_sharded_legacy(texts, mesh)
+
+    def _dedup_reps_sharded_packed(self, texts, mesh) -> np.ndarray:
+        from advanced_scrapper_tpu.obs import stages, trace
+        from advanced_scrapper_tpu.parallel.sharded_packed import (
+            local_shard_rows,
+        )
+
+        n = len(texts)
+        if n == 0:
+            return np.zeros((0,), np.int32)
+        tid = trace.new_trace_id()
+        self._m_docs["sharded"].inc(n)
+        raw = [to_bytes(t) for t in texts]
+        running, n_bucket, _use_oph = self._accumulate_device_sharded(
+            raw, mesh, trace_id=tid
+        )
+        valid = self._valid_device_sharded(raw, n_bucket, mesh)
+        epi = self._get_sharded_epilogue(mesh)
+        with stages.timed("resolve"), trace.span(
+            "dedup.resolve", trace=tid, regime="sharded", docs=n
+        ):
+            rep = epi(running, valid, jump_rounds=_jump_rounds(n_bucket))
+            for s in local_shard_rows(mesh):
+                stages.count_dispatch("sharded", shard=s)
+            out = np.asarray(rep)[:n]
+        self._count_result("sharded", n, out)
+        return out
+
+    def _keys_wide_sharded(self, raw: list, mesh) -> np.ndarray:
+        """Host ``uint32[N, nb, 2]`` wide band keys off the mesh-sharded
+        packed accumulator — the sharded twin of
+        ``signatures_and_keys(wide=True, sync_sigs=False)``: one keys
+        epilogue dispatch (``pmin`` combine + ``band_keys_wide``),
+        replicated, signatures never synced."""
+        from advanced_scrapper_tpu.obs import stages, trace
+        from advanced_scrapper_tpu.parallel.sharded_packed import (
+            local_shard_rows,
+        )
+
+        tid = trace.new_trace_id()
+        running, _n_bucket, _use_oph = self._accumulate_device_sharded(
+            raw, mesh, trace_id=tid
+        )
+        keys_dev = self._get_sharded_keys_epilogue(mesh)(running)
+        for s in local_shard_rows(mesh):
+            stages.count_dispatch("sharded", shard=s)
+        with stages.timed("kernel"), trace.span(
+            "dedup.readback", trace=tid, docs=len(raw)
+        ):  # readback sync: the device drains here
+            return np.asarray(keys_dev)[: len(raw)]
+
+    def _dedup_reps_sharded_legacy(self, texts, mesh) -> np.ndarray:
+        """The PR 2 unpacked sharded transport — blockwise encode →
+        ``make_sharded_block_dedup`` (three arrays H2D, one monolithic
+        dispatch).  Kept byte-identical behind ``ASTPU_DEDUP_PACKED_H2D=0``
+        as the packed plane's parity oracle (MIGRATION: new callers use
+        the packed entry)."""
         from advanced_scrapper_tpu.obs import stages, trace
         from advanced_scrapper_tpu.parallel.sharded import (
             make_sharded_block_dedup,
@@ -1050,7 +1440,7 @@ class NearDupEngine:
         )
 
     def dedup_against_index(
-        self, texts: Sequence[str | bytes], index, doc_ids=None
+        self, texts: Sequence[str | bytes], index, doc_ids=None, *, mesh=None
     ) -> np.ndarray:
         """``int64[N]`` attribution of a corpus against a persistent index
         (``index.store.PersistentIndex`` — or its fleet drop-in,
@@ -1066,6 +1456,17 @@ class NearDupEngine:
         built for: the batch backend (`extractors/tpu_batch.py`) wraps it
         with record bookkeeping, but a raw corpus stream can consume it
         directly.
+
+        ``mesh=``: compute the band keys on the mesh-sharded packed plane
+        (per-shard fused donated tiles, ``pmin``-combined keys epilogue)
+        instead of the single-device accumulator — byte-identical keys,
+        so attributions never depend on the device topology.  The
+        cross-shard band-key merge then rides the index plane on the
+        host: a ``ShardedIndexClient`` fans each key to its ring shard
+        (probe row-min + replicated insert), which is deliberately
+        decoupled from the device-mesh shard count.  (With the legacy
+        transport forced — ``ASTPU_DEDUP_PACKED_H2D=0`` — ``mesh`` is
+        ignored: the oracle transport has no sharded keys plane.)
         """
         from advanced_scrapper_tpu.utils.bloom import pack_keys64
 
@@ -1077,9 +1478,12 @@ class NearDupEngine:
         # fused epilogue: the wide keys come off the device-resident
         # accumulator in one dispatch — signatures never bounce D2H→H2D,
         # and are never synced at all (the index stores keys only)
-        _sigs, keys_wide = self.signatures_and_keys(
-            raw, wide=True, sync_sigs=False
-        )
+        if mesh is not None and self.cfg.packed_h2d:
+            keys_wide = self._keys_wide_sharded(raw, mesh)
+        else:
+            _sigs, keys_wide = self.signatures_and_keys(
+                raw, wide=True, sync_sigs=False
+            )
         keys64 = pack_keys64(keys_wide)
         if (
             self.ladder is not None

@@ -9,12 +9,15 @@ transfer-bound link comes from keeping all four saturated at once.
 ``put_workers == 1``, a locked-generator stage graph above it); this
 module is the ONE executor, expressed on the PR 7 runtime, and it is
 deliberately workload-blind: the dedup signature plane
-(``pipeline/dedup.py``, donated running accumulator) and the matcher
-screen plane (``pipeline/matcher.py``, independent per-tile masks)
-ride the same three stages, as does the legacy multi-array tile
-transport kept alive for parity certification — ``pack``/``put`` are
-caller-supplied callables, the executor knows nothing of either
-workload:
+(``pipeline/dedup.py``, donated running accumulator), the matcher
+screen plane (``pipeline/matcher.py``, independent per-tile masks) and
+the MESH-SHARDED dedup plane (a sharded source on the same graph: each
+"tile" is a per-shard group whose ``put`` issues one ``device_put`` per
+shard and whose dispatch is one partitioned fused step —
+``parallel/sharded_packed.py``) ride the same three stages, as does the
+legacy multi-array tile transport kept alive for parity certification —
+``pack``/``put`` are caller-supplied callables, the executor knows
+nothing of any workload:
 
 - the ``pack`` stage draws tiles off the encode generator
   (``StageGraph``'s ``source_iter`` wraps it in a locked puller) and

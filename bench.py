@@ -34,6 +34,10 @@ Sweep knobs (env):
                               single-dispatch screen tiles)
   ASTPU_MATCH_DISPATCH_WINDOW=N  matcher screen-tile window depth
   ASTPU_MATCH_SCREEN_TILE_BYTES=N  byte budget per packed screen tile
+  ASTPU_BENCH_MESH=DxS        (data, seq) mesh factorisation for the
+                              sharded regime (default: all devices on the
+                              data axis); the result JSON carries the
+                              shape + per-shard put/dispatch/byte ledger
   ASTPU_COMPILE_CACHE=dir     persistent XLA compilation cache — warmup
                               vs steady-state are reported separately
                               (ragged_warmup_articles_per_sec /
@@ -50,10 +54,10 @@ were unavailable — the silent-fallback shape behind BENCH_r05's 0.22×
 exact reading).
 
 Observability (the telemetry plane rides the bench):
-  --regime NAME               run one regime (uniform|ragged|stream|recall|
-                              exact|matcher|index) instead of the full
-                              battery; the JSON line carries only that
-                              regime's keys
+  --regime NAME               run one regime (uniform|ragged|stream|sharded|
+                              recall|exact|matcher|index|fleet) instead of
+                              the full battery; the JSON line carries only
+                              that regime's keys
   ASTPU_TELEMETRY=1           serve live GET /metrics + /status for the
                               whole run (port: ASTPU_METRICS_PORT, default
                               ephemeral — address printed to stderr); the
@@ -188,6 +192,54 @@ def _bench_ragged(
         assert r.shape == (n_articles,)
     deltas = {k: int(dc1[k] - dc0[k]) for k in dc0}
     return warm_rate, n_articles * n_corpora / dt, deltas
+
+
+def _bench_sharded(
+    jax, n_articles: int, n_corpora: int = 3
+) -> tuple[float, float, dict, dict, dict]:
+    """``(warmup_rate, steady_rate, totals, per_shard, mesh_shape)`` —
+    the pod-shape regime: the ragged workload through
+    ``NearDupEngine.dedup_reps_sharded``'s PACKED plane (per-shard fused
+    donated tiles, pmin combine epilogue) on a mesh over every visible
+    device.  ``ASTPU_BENCH_MESH=DxS`` pins the (data, seq) factorisation
+    (default: all devices on the data axis — shard count is the device
+    count either way).  The always-on shard-labelled counters window the
+    steady corpora only, so the per-shard 1-put/1-dispatch contract is a
+    reported number per shard, and the max−min put skew lands on the
+    ``astpu_sharded_put_skew`` gauge the declared SLO set gates at 0."""
+    from advanced_scrapper_tpu.core.mesh import build_mesh, parse_mesh_shape
+    from advanced_scrapper_tpu.obs import stages
+
+    ndev = len(jax.devices())
+    spec = os.environ.get("ASTPU_BENCH_MESH")
+    dp, sp = parse_mesh_shape(spec) if spec else (ndev, 1)
+    mesh = build_mesh(dp, sp)
+    engine = _ragged_engine()
+    rng = np.random.RandomState(7)
+    t0 = time.perf_counter()
+    warm = engine.dedup_reps_sharded(_ragged_corpus(rng, n_articles), mesh)
+    assert warm.shape[0] == n_articles
+    warm_rate = n_articles / (time.perf_counter() - t0)
+    corpora = [_ragged_corpus(rng, n_articles) for _ in range(n_corpora)]
+    dc0 = stages.device_counters()
+    ps0 = stages.sharded_device_counters()
+    t0 = time.perf_counter()
+    for c in corpora:
+        rep = engine.dedup_reps_sharded(c, mesh)
+        assert rep.shape == (n_articles,)
+    dt = time.perf_counter() - t0
+    dc1 = stages.device_counters()
+    ps1 = stages.sharded_device_counters()
+    totals = {k: int(dc1[k] - dc0[k]) for k in dc0}
+    per_shard = {
+        s: {
+            k: int(ps1[s][k] - ps0.get(s, {}).get(k, 0.0)) for k in ps1[s]
+        }
+        for s in sorted(ps1, key=int)
+    }
+    stages.record_sharded_put_skew(ps0)  # steady window → the gauge_max SLO
+    mesh_shape = {"data": dp, "seq": sp, "shards": dp * sp}
+    return warm_rate, n_articles * n_corpora / dt, totals, per_shard, mesh_shape
 
 
 def _feed_workers() -> int | None:
@@ -771,6 +823,20 @@ def _bench_slo_engine():
     )
     objectives.append(
         {
+            # the sharded plane's declared balance objective: the packed
+            # mesh regime labels every put per shard, and a healthy plane
+            # is EXACTLY balanced (tiles + 1 per shard) — any skew is a
+            # violated SLO, not a prose claim.  The gauge only exists
+            # once a sharded regime ran (record_sharded_put_skew), so
+            # non-sharded runs skip it instead of vacuously passing.
+            "name": "sharded_put_skew",
+            "kind": "gauge_max",
+            "metric": "astpu_sharded_put_skew",
+            "threshold": 0.0,
+        }
+    )
+    objectives.append(
+        {
             # the declared reject-ratio objective of the overload plane:
             # a bench run is UNLOADED relative to its own capacity, so
             # any admission activity it does produce must stay almost
@@ -824,8 +890,8 @@ def _telemetry_ledger(slo_engine) -> dict:
 
 
 REGIMES = (
-    "uniform", "ragged", "stream", "recall", "exact", "matcher", "index",
-    "fleet",
+    "uniform", "ragged", "stream", "sharded", "recall", "exact", "matcher",
+    "index", "fleet",
 )
 
 
@@ -993,6 +1059,26 @@ def main(argv=None) -> None:
                 out["stream_vs_baseline"] = round(stream / 50000.0, 4)
                 out.update(_dev_delta(dc, "stream"))
                 out.update(_adm_delta("stream"))
+            if "sharded" in want:
+                (
+                    sharded_warm, sharded, sharded_dc, sharded_ps,
+                    sharded_mesh,
+                ) = _bench_sharded(jax, 1024 if quick else 8192)
+                note(
+                    f"sharded done: {sharded:.0f}/s steady over "
+                    f"{sharded_mesh['shards']} shards "
+                    f"({sharded_mesh['data']}x{sharded_mesh['seq']} mesh; "
+                    f"warmup corpus {sharded_warm:.0f}/s)"
+                )
+                out["sharded_articles_per_sec"] = round(sharded, 1)
+                out["sharded_warmup_articles_per_sec"] = round(sharded_warm, 1)
+                out["sharded_vs_baseline"] = round(sharded / 50000.0, 4)
+                out["sharded_mesh"] = sharded_mesh
+                # steady-window totals + the per-shard ledger (the
+                # 1-put/1-dispatch-per-tile-per-shard contract as data)
+                out.update({f"sharded_{k}": v for k, v in sharded_dc.items()})
+                out["sharded_per_shard"] = sharded_ps
+                out.update(_adm_delta("sharded"))
             stage_ms = {k: 0.0 for k in ("encode", "h2d", "kernel", "resolve")}
             stage_ms.update(stages.snapshot_ms())
             if "recall" in want:

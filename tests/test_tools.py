@@ -46,9 +46,30 @@ def test_sweep_onchip_snippets_and_dead_tunnel_abort(tmp_path, monkeypatch, caps
     # snippets format cleanly and reference real symbols
     s = t.STREAM_SNIPPET.format(here=t.HERE, batch=64, block=64, n_batches=1, workers=1)
     r = t.RAGGED_SNIPPET.format(here=t.HERE, put_workers=1, n_articles=8)
+    sh = t.SHARDED_SNIPPET.format(
+        here=t.HERE, n_articles=8, dp=2, sp=1, put_workers=1
+    )
     compile(s, "<stream>", "exec")
     compile(r, "<ragged>", "exec")
+    compile(sh, "<sharded>", "exec")
     assert "make_sharded_dedup" in s and "dedup_reps_async" in r
+    assert "dedup_reps_sharded" in sh and "prewarm_sharded" in sh
+
+    # the local DxS parser is a grammar twin of core.mesh.parse_mesh_shape
+    # (the parent process must never import jax, hence the duplicate)
+    from advanced_scrapper_tpu.core.mesh import parse_mesh_shape
+
+    for spec in ("2x4", "8X1", " 1x8 "):
+        assert t.parse_mesh_shape(spec) == parse_mesh_shape(spec)
+    for bad in ("axb", "8", "0x4", "2x4x1"):
+        for parser in (t.parse_mesh_shape, parse_mesh_shape):
+            try:
+                parser(bad)
+                raise AssertionError(f"{parser} accepted {bad!r}")
+            except ValueError as e:
+                assert "mesh shape" in str(e)
+    assert t._mesh_shapes("auto", 8) == [(8, 1), (4, 2)]
+    assert t._mesh_shapes("1x8,2x4,4x4", 8) == [(1, 8), (2, 4)]
 
     # dead tunnel: probe subprocess fails fast -> sweep aborts, row recorded
     out = tmp_path / "sweep.jsonl"
@@ -305,8 +326,8 @@ def test_bench_regime_selection_args():
     assert bench._parse_args([]).regime == "all"
     assert bench._parse_args(["--regime", "ragged"]).regime == "ragged"
     assert set(bench.REGIMES) == {
-        "uniform", "ragged", "stream", "recall", "exact", "matcher", "index",
-        "fleet",
+        "uniform", "ragged", "stream", "sharded", "recall", "exact",
+        "matcher", "index", "fleet",
     }
     try:
         bench._parse_args(["--regime", "nope"])
@@ -338,6 +359,31 @@ def test_bench_fleet_regime_reports_throughput():
     assert out["fleet_insert_rows_per_sec"] > 0
     assert out["fleet_probe_rows_per_sec"] > 0
     assert out["fleet_shards"] == 2 and out["fleet_replicas"] == 2
+
+
+def test_bench_sharded_regime_reports_per_shard_ledger():
+    """``bench.py --regime sharded``: the pod-shape regime must report
+    mesh shape, steady throughput, and a per-shard put/dispatch ledger
+    that is exactly balanced (the gauge the declared SLO gates at 0)."""
+    import jax
+
+    import bench
+    from advanced_scrapper_tpu.obs import telemetry
+
+    warm, steady, totals, per_shard, mesh_shape = bench._bench_sharded(
+        jax, 192, n_corpora=1
+    )
+    assert warm > 0 and steady > 0
+    assert mesh_shape["shards"] == len(jax.devices())
+    assert len(per_shard) == mesh_shape["shards"]
+    puts = {d["device_puts"] for d in per_shard.values()}
+    disp = {d["device_dispatches"] for d in per_shard.values()}
+    assert len(puts) == 1 and len(disp) == 1, per_shard
+    assert totals["device_puts"] >= mesh_shape["shards"]
+    skew = [
+        g for g in telemetry.REGISTRY.find("astpu_sharded_put_skew")
+    ]
+    assert skew and skew[0].value == 0.0
 
 
 def test_lint_imports_clean_tree():
@@ -399,6 +445,22 @@ def test_lint_imports_catches_violations(tmp_path):
         "def h():\n"
         "    from advanced_scrapper_tpu.pipeline.scraper import SUCCESS_FIELDS\n"
     )
+    # the mesh planes are device math: the sharded packed step must never
+    # reach for the executor (pipeline) or scheduler (runtime) that drive
+    # it — pipeline→parallel is strictly one-way
+    (pkg / "parallel").mkdir()
+    (pkg / "parallel" / "bad.py").write_text(
+        "def f():\n"
+        "    from advanced_scrapper_tpu.pipeline.dispatch import (\n"
+        "        PipelinedDispatcher,\n"
+        "    )\n"
+        "    import advanced_scrapper_tpu.runtime.graph\n"
+        "    from advanced_scrapper_tpu.index.fleet import ShardedIndexClient\n"
+    )
+    (pkg / "parallel" / "ok.py").write_text(
+        "from advanced_scrapper_tpu.core.mesh import shard_map_compat\n"
+        "from advanced_scrapper_tpu.ops.pack import unpack_tile\n"
+    )
     # the runtime is workload-blind: no pipeline/extractors/net/index —
     # but obs (telemetry taps, the flight recorder) is its one dependency
     (pkg / "runtime").mkdir()
@@ -415,7 +477,10 @@ def test_lint_imports_catches_violations(tmp_path):
         "from advanced_scrapper_tpu.obs import telemetry, trace\n"
     )
     problems = lint_imports.lint(str(tmp_path))
-    assert len(problems) == 12, problems
+    assert len(problems) == 15, problems
+    assert any("parallel/ must not import pipeline/" in p for p in problems)
+    assert any("parallel/ must not import runtime/" in p for p in problems)
+    assert any("parallel/ must not import index/" in p for p in problems)
     assert any("core/ must not import obs/" in p for p in problems)
     assert any("core/ must not import pipeline/" in p for p in problems)
     assert any("ops/ must not import runtime/" in p for p in problems)

@@ -17,6 +17,12 @@ Rules (banned prefixes per source layer)::
                          transport — protocol modules like net.lease stay
                          out of the index layer)
     net/                 must not import  pipeline/
+    parallel/            must not import  pipeline/, net/, index/,
+                         runtime/  (the mesh planes are device math —
+                         jax + core/ops only; the pipeline→parallel
+                         dependency is strictly one-way, so the sharded
+                         packed executor in pipeline/dedup.py drives
+                         parallel/sharded_packed.py, never the reverse)
     runtime/             must not import  pipeline/, extractors/, net/,
                          index/  (the scheduler sits on obs only; the
                          pipeline→runtime dependency is strictly one-way,
@@ -51,6 +57,11 @@ RULES: dict[str, tuple[str, ...]] = {
     "utils": ("pipeline", "net", "obs", "runtime"),
     "index": ("pipeline", "net"),
     "net": ("pipeline",),
+    # the mesh planes (sharded/sharded_packed/ring/dist) are device math:
+    # the host pipeline around them (executor, ledger, chunker) lives in
+    # pipeline/ and drives them one-way — a parallel→pipeline import
+    # would drag the whole runtime into every kernel test
+    "parallel": ("pipeline", "net", "index", "runtime"),
     # the stage-graph runtime is workload-blind: pipeline/net/index ride
     # its edges, never the other way around
     "runtime": ("pipeline", "extractors", "net", "index"),

@@ -99,6 +99,43 @@ dt = time.perf_counter() - t0
 print(json.dumps({{"articles_per_sec": round(n / dt, 1)}}))
 """
 
+SHARDED_SNIPPET = """
+import json, os, sys, time
+import numpy as np
+sys.path.insert(0, {here!r})
+import jax
+import bench
+from advanced_scrapper_tpu.config import DedupConfig
+from advanced_scrapper_tpu.core.mesh import build_mesh
+from advanced_scrapper_tpu.obs import stages
+from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+n, dp, sp = {n_articles}, {dp}, {sp}
+rng = np.random.RandomState(7)
+engine = NearDupEngine(DedupConfig(put_workers={put_workers}))
+# sub-count shapes (dp*sp < devices) sweep a carved sub-mesh: build_mesh
+# requires len(devices) == dp*sp, so hand it exactly that many
+mesh = build_mesh(dp, sp, devices=jax.devices()[: dp * sp])
+engine.prewarm_sharded(mesh, n)                       # warm the shape set
+engine.dedup_reps_sharded(bench._ragged_corpus(rng, n), mesh)
+corpus = bench._ragged_corpus(rng, n)
+ps0 = stages.sharded_device_counters()
+t0 = time.perf_counter()
+rep = engine.dedup_reps_sharded(corpus, mesh)
+dt = time.perf_counter() - t0
+ps1 = stages.sharded_device_counters()
+puts = sorted(
+    ps1[s]["device_puts"] - ps0.get(s, {{}}).get("device_puts", 0.0)
+    for s in ps1
+)
+print(json.dumps({{
+    "articles_per_sec": round(n / dt, 1),
+    "mesh": [dp, sp],
+    "tiles": engine.last_tiles,
+    "per_shard_puts": [puts[0], puts[-1]],
+}}))
+"""
+
 
 def run_config(tag: str, snippet: str, env: dict, timeout: float) -> dict:
     t0 = time.time()
@@ -129,11 +166,63 @@ def run_config(tag: str, snippet: str, env: dict, timeout: float) -> dict:
     return rec
 
 
+def parse_mesh_shape(spec: str) -> tuple[int, int]:
+    """``"2x4"`` → ``(2, 4)`` — local twin of
+    ``core.mesh.parse_mesh_shape`` (same DxS grammar, asserted in
+    tests).  Deliberately NOT imported from the package: this parent
+    process must never import jax (a dead tunnel can hang backend-
+    touching imports forever; every jax-touching config runs in its own
+    watchdogged subprocess)."""
+    parts = spec.lower().strip().split("x")
+    if len(parts) != 2:
+        raise ValueError(f"mesh shape {spec!r} is not of the form DxS")
+    try:
+        dp, sp = int(parts[0]), int(parts[1])
+    except ValueError as e:
+        raise ValueError(f"mesh shape {spec!r} is not of the form DxS") from e
+    if dp < 1 or sp < 1:
+        raise ValueError(f"mesh shape {spec!r} must be positive")
+    return dp, sp
+
+
+def _mesh_shapes(spec: str, n_devices: int) -> list[tuple[int, int]]:
+    """The sharded-regime mesh axis: explicit ``1x8,2x4`` shapes (kept
+    only when they fit the probed device count), or ``auto`` — the flat
+    data mesh plus the 2-way seq split when the count allows."""
+    if spec == "auto":
+        shapes = [(n_devices, 1)]
+        if n_devices % 2 == 0 and n_devices > 1:
+            shapes.append((n_devices // 2, 2))
+        return shapes
+    shapes = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        dp, sp = parse_mesh_shape(part)
+        if dp * sp <= n_devices:
+            shapes.append((dp, sp))
+        else:
+            print(
+                f"sweep: skipping mesh {dp}x{sp} ({dp * sp} > {n_devices} "
+                "visible devices)",
+                file=sys.stderr,
+            )
+    return shapes
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--out", default=os.path.join(HERE, "sweep_onchip.jsonl"))
     ap.add_argument("--timeout", type=float, default=900.0)
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument(
+        "--mesh",
+        default="auto",
+        help="comma-separated DxS mesh shapes for the sharded axis "
+        "(e.g. 1x8,2x4); 'auto' derives from the probed device count; "
+        "'' skips the sharded axis",
+    )
     args = ap.parse_args()
 
     env = dict(os.environ)  # default env: the axon chip when healthy
@@ -188,6 +277,24 @@ def main() -> None:
                 args.timeout,
             )
         )
+    # mesh-shape axis: the sharded packed plane (per-shard fused donated
+    # tiles) swept over (data, seq) factorisations × put workers, so the
+    # live-tunnel window can sweep the pod-shape step without a code change
+    if args.mesh:
+        shapes = _mesh_shapes(args.mesh, int(probe.get("n", 1)))
+        for dp, sp in shapes:
+            for pw in (1, 4):
+                emit(
+                    run_config(
+                        f"sharded:n={ragged_n},mesh={dp}x{sp},put_workers={pw}",
+                        SHARDED_SNIPPET.format(
+                            here=HERE, n_articles=ragged_n,
+                            dp=dp, sp=sp, put_workers=pw,
+                        ),
+                        env,
+                        args.timeout,
+                    )
+                )
     print(f"sweep complete -> {args.out}", file=sys.stderr)
 
 
