@@ -20,13 +20,20 @@ Layering: this package may use ``storage.fsio`` (durability seam),
   compaction, crash-safe via manifest swap.
 """
 
-from advanced_scrapper_tpu.index.segment import Segment, write_segment
+from advanced_scrapper_tpu.index.segment import (
+    Segment,
+    SegmentCorruption,
+    file_digest,
+    write_segment,
+)
 from advanced_scrapper_tpu.index.store import PersistentIndex
 from advanced_scrapper_tpu.index.wal import WriteAheadLog, replay_wal
 
 __all__ = [
     "PersistentIndex",
     "Segment",
+    "SegmentCorruption",
+    "file_digest",
     "write_segment",
     "WriteAheadLog",
     "replay_wal",

@@ -215,8 +215,12 @@ class _Shard:
     #   writes ACKED by the shard while this node was unreachable — the
     #   backfill a returning node must absorb BEFORE it may rejoin (else a
     #   later promotion could elect a replica missing acked postings)
-    gap_overflow: set = field(default_factory=set)  # node ix: gap dropped,
-    #   node is out for this client's lifetime (needs operator resync)
+    gap_overflow: set = field(default_factory=set)  # node ix: gap ledger
+    #   dropped past the cap — the node may only return through a FULL
+    #   digest-verified resync (_resync_node), never the plain drain path
+    resyncing: set = field(default_factory=set)  # node ix: a resync is in
+    #   flight — a second caller must not re-arm (and thereby wipe) the
+    #   first's gap ledger
     journal: WriteAheadLog | None = None
     lock: threading.RLock = field(default_factory=threading.RLock)
 
@@ -247,6 +251,10 @@ class ShardedIndexClient:
         overload_backoff_cap: float = 2.0,
         overload_budget: float = 45.0,
         sleep=time.sleep,
+        gap_limit_postings: int | None = None,
+        repair_interval: float | None = None,
+        resync_rounds: int = 4,
+        digest_bits: int | None = None,
     ):
         """``spill_dir`` holds one journal per shard (``shardN-<space>
         .spill``); ``None`` disables the durable journal (spills are then
@@ -267,6 +275,26 @@ class ShardedIndexClient:
         self.overload_backoff_cap = float(overload_backoff_cap)
         self.overload_budget = float(overload_budget)
         self._sleep = sleep
+        #: per-node gap-ledger cap (instance-scoped so tests can shrink
+        #: it; defaults to the class constant)
+        self.gap_limit_postings = int(
+            self.GAP_LIMIT_POSTINGS
+            if gap_limit_postings is None else gap_limit_postings
+        )
+        #: anti-entropy knobs: digest resolution, resync convergence
+        #: rounds, and the background repair cadence (seconds; 0 = off,
+        #: env ASTPU_FLEET_REPAIR_INTERVAL is the deployment default)
+        from advanced_scrapper_tpu.index.repair import DEFAULT_BITS
+
+        self.digest_bits = int(DEFAULT_BITS if digest_bits is None else digest_bits)
+        self.resync_rounds = int(resync_rounds)
+        if repair_interval is None:
+            repair_interval = float(
+                os.environ.get("ASTPU_FLEET_REPAIR_INTERVAL", "0") or 0
+            )
+        self.repair_interval = float(repair_interval)
+        self._repair_stop = threading.Event()
+        self._repair_thread: threading.Thread | None = None
         from advanced_scrapper_tpu.storage.fsio import default_fs
 
         self._fs = fs or default_fs()
@@ -319,6 +347,8 @@ class ShardedIndexClient:
             for sh in self._shards:
                 if sh.pending:  # best-effort recovery replay at open
                     self._ensure_write_target(sh)
+        if self.repair_interval > 0:
+            self.start_repair(self.repair_interval)
 
     # -- telemetry ---------------------------------------------------------
 
@@ -367,6 +397,34 @@ class ShardedIndexClient:
             "astpu_fleet_backfilled_postings_total",
             "acked-elsewhere postings delivered to returning nodes before "
             "their rejoin",
+        )
+        # always-on like the overload pair: resync/repair are exactly what
+        # an operator audits after an incident, telemetry gate or not
+        self._m_resyncs = telemetry.REGISTRY.counter(
+            "astpu_fleet_resync_total",
+            "gap-overflowed nodes restored by digest-verified full resync "
+            "(the auto path behind astpu_fleet_gap_overflow_total)",
+            always=True, fleet=fid,
+        )
+        self._m_resync_postings = telemetry.REGISTRY.counter(
+            "astpu_fleet_resync_postings_total",
+            "semantic postings streamed into returning nodes during resync",
+            always=True, fleet=fid,
+        )
+        self._m_repair_rounds = telemetry.REGISTRY.counter(
+            "astpu_repair_rounds_total",
+            "anti-entropy repair passes over the fleet",
+            always=True, fleet=fid,
+        )
+        self._m_repair_ranges = telemetry.REGISTRY.counter(
+            "astpu_repair_ranges_total",
+            "divergent digest buckets streamed during repair",
+            always=True, fleet=fid,
+        )
+        self._m_repair_postings = telemetry.REGISTRY.counter(
+            "astpu_repair_postings_total",
+            "postings pushed between replicas to heal divergence",
+            always=True, fleet=fid,
         )
         # always-on (not gated by ASTPU_TELEMETRY): the overload-vs-dead
         # distinction is exactly what an operator audits in an incident
@@ -428,6 +486,7 @@ class ShardedIndexClient:
                         ],
                         "promoting": sh.promoting,
                         "spill_pending": sum(int(k.size) for _r, k, _d in sh.pending),
+                        "awaiting_resync": sorted(sh.gap_overflow),
                     }
                 )
         return {"space": self.space, "shards": shards}
@@ -530,24 +589,32 @@ class ShardedIndexClient:
             node=f"{node.address[0]}:{node.address[1]}",
         )
 
-    def _try_revive(self, sh: _Shard) -> None:
+    def _try_revive(self, sh: _Shard, *, allow_resync: bool = False) -> None:
         """Ping dead nodes (cheap timeout, rate-limited so a dark shard
         costs one ping round per interval, not per operation); a
         responder must first absorb its gap ledger — every write the
         shard ACKED while it was away — and only then rejoins, as a
         replica, NOT as write target.  That invariant is what makes any
         live node a safe promotion candidate: live ⇒ not missing any
-        acked posting."""
+        acked posting.
+
+        ``allow_resync`` gates the expensive leg: gap-OVERFLOWED nodes
+        (dropped ledger) can only return through a full digest-verified
+        resync, which streams state and must never run inline from the
+        probe/insert hot path — only ``checkpoint()``, ``repair_once()``
+        and the background repair loop pass True."""
         now = time.monotonic()
         with sh.lock:
-            if now - sh.last_revive < self.health_timeout:
+            if now - sh.last_revive < self.health_timeout and not allow_resync:
                 return
             sh.last_revive = now
         for ix, node in enumerate(sh.nodes):
-            if node.alive or ix in sh.gap_overflow:
+            if node.alive or (ix in sh.gap_overflow and not allow_resync):
                 continue
             if not node.client.ping(timeout=self.health_timeout):
                 continue
+            if ix in sh.gap_overflow and not self._resync_node(sh, ix, node):
+                continue  # still diverged; the next repair round retries
             with sh.lock:
                 gap = list(sh.gaps.get(ix, ()))
             backfilled = 0
@@ -677,6 +744,271 @@ class ShardedIndexClient:
             from advanced_scrapper_tpu.obs import trace
 
             trace.record("event", "fleet.replay", shard=sh.sid, postings=done)
+
+    # -- anti-entropy: digests, repair, resync ----------------------------
+
+    def _node_digest(self, sh: _Shard, node: _Node):
+        _h, (dig, cnt) = self._node_call(
+            sh, node, "digest",
+            {"space": self.space, "bits": self.digest_bits},
+            budget=self.timeout,
+        )
+        return np.asarray(dig, np.uint64), np.asarray(cnt, np.uint64)
+
+    def _fetch_semantic_range(self, sh: _Shard, node: _Node, lo: int, hi: int):
+        """Paged ``fetch_range`` under the frame cap — the shared
+        pagination loop, over this client's failure-accounted call."""
+        from advanced_scrapper_tpu.index.remote import paged_fetch_range
+
+        return paged_fetch_range(
+            lambda header: self._node_call(
+                sh, node, "fetch_range",
+                {"space": self.space, **header},
+                budget=self.timeout,
+            ),
+            lo, hi, page=self.REPLAY_CHUNK_POSTINGS,
+        )
+
+    def _push_pairs(self, sh: _Shard, dst: _Node, keys, docs) -> None:
+        rid = (
+            f"repair-{self._token}-{self._fid}-s{sh.sid}"
+            f"-{self._next_wid()}"
+        )
+        self._node_call(
+            sh, dst, "insert", {"space": self.space}, [keys, docs],
+            request_id=f"{rid}@{dst.address[0]}:{dst.address[1]}",
+            budget=self.timeout,
+        )
+
+    def _heal_pair(
+        self, sh: _Shard, a: _Node, b: _Node
+    ) -> tuple[int, bool, bool]:
+        """One SYMMETRIC anti-entropy pass between two replicas: diff
+        bucket digests, stream only the divergent key ranges, and push
+        each side the pairs the other is missing (or holds with a LATER
+        doc — min-doc semantics).  Postings are inserts, never deletes,
+        so a pair present on EITHER side is legitimate acked data and
+        propagates both ways — without this, a replica holding a pair no
+        peer has (an applied insert whose ack was lost) could never
+        digest-match and a resync would spin forever.
+
+        Returns ``(postings_pushed, a_matched, b_matched)``.  Each match
+        compares that side's FINAL digest against the expected UNION of
+        the two START states (computed locally per divergent bucket, so
+        it is immune to writes the pass races): a True for side X proves
+        X now covers everything EITHER side held when the pass looked —
+        the resync-gate property for a returning node, whose concurrent
+        writes sit in the armed gap ledger, not in this check.  A side
+        taking live writes mid-pass legitimately reports False and the
+        next pass picks up the remainder."""
+        dig_a, cnt_a = self._node_digest(sh, a)
+        dig_b, cnt_b = self._node_digest(sh, b)
+        diff = np.flatnonzero((dig_a != dig_b) | (cnt_a != cnt_b))
+        if diff.size == 0:
+            return 0, True, True
+        from advanced_scrapper_tpu.index.repair import (
+            bucket_digests,
+            bucket_range,
+        )
+
+        # expected end state per bucket: non-divergent buckets already
+        # agree (dig_a rows are the shared truth); divergent ones get the
+        # locally-computed union digest below
+        expect_dig, expect_cnt = dig_a.copy(), cnt_a.copy()
+        pushed = 0
+        for bucket in diff.tolist():
+            lo, hi = bucket_range(bucket, self.digest_bits)
+            ka, da = self._fetch_semantic_range(sh, a, lo, hi)
+            kb, db = self._fetch_semantic_range(sh, b, lo, hi)
+            have_a = dict(zip(ka.tolist(), da.tolist()))
+            have_b = dict(zip(kb.tolist(), db.tolist()))
+            self._m_repair_ranges.inc()
+            merged = dict(have_b)
+            for k, d in have_a.items():
+                if merged.get(k, _I64_MAX) > d:
+                    merged[k] = d
+            for dst, src_k, src_d, have in (
+                (b, ka, da, have_b),
+                (a, kb, db, have_a),
+            ):
+                need = [
+                    j
+                    for j, (k, d) in enumerate(
+                        zip(src_k.tolist(), src_d.tolist())
+                    )
+                    if have.get(k, _I64_MAX) > d
+                ]
+                if need:
+                    self._push_pairs(sh, dst, src_k[need], src_d[need])
+                    pushed += len(need)
+            uk = np.fromiter(merged.keys(), np.uint64, len(merged))
+            ud = np.fromiter(merged.values(), np.uint64, len(merged))
+            u_dig, u_cnt = bucket_digests(uk, ud, self.digest_bits)
+            expect_dig[bucket] = u_dig[bucket]
+            expect_cnt[bucket] = u_cnt[bucket]
+        self._m_repair_postings.inc(pushed)
+        dig_a2, cnt_a2 = self._node_digest(sh, a)
+        dig_b2, cnt_b2 = self._node_digest(sh, b)
+        a_matched = bool(
+            (dig_a2 == expect_dig).all() and (cnt_a2 == expect_cnt).all()
+        )
+        b_matched = bool(
+            (dig_b2 == expect_dig).all() and (cnt_b2 == expect_cnt).all()
+        )
+        return pushed, a_matched, b_matched
+
+    RESYNC_ROUNDS = 4  # class default; instance knob is resync_rounds
+
+    def _resync_node(self, sh: _Shard, ix: int, node: _Node) -> bool:
+        """Full resync of a gap-OVERFLOWED node — the headline healing
+        path: its dropped ledger means an unknown set of acked writes is
+        missing, so the plain drain can never certify it.  Instead:
+
+        1. arm a FRESH gap ledger (writes acked from this instant on are
+           preserved again) — the overflow mark STAYS SET the whole time,
+           so a racing plain ``_try_revive`` keeps refusing the node (a
+           cleared mark mid-stream would let it rejoin uncertified);
+        2. stream the full divergence against a healthy live peer — which
+           by the live-node invariant holds every acked posting — via the
+           bucket-digest diff, repeating up to ``resync_rounds`` times;
+        3. only when the node's digest MATCHES the peer's (and the armed
+           ledger survived — an overflowed ledger means unpreserved
+           writes) does the mark clear and the node proceed to the
+           normal ledger-drain + rejoin gate in ``_try_revive``.
+
+        Digest-matched means the node covers everything acked up to the
+        match instant; the armed ledger covers everything after.  On ANY
+        failure — no live peer, RPC fault, churn outran the rounds, or an
+        unexpected exception (the ``finally`` voids the attempt before it
+        propagates) — the mark is still set and the next repair round
+        starts over: the node stays out, but never forever."""
+        source = None
+        with sh.lock:
+            for cand in sh.nodes:
+                if cand.alive and cand is not node:
+                    source = cand
+                    break
+        if source is None:
+            # no healthy peer holds the acked history right now; resync
+            # would certify against nothing.  Keep the node out — a peer
+            # that rejoins (it holds every acked posting) unblocks this.
+            return False
+        with sh.lock:
+            if ix in sh.resyncing:
+                # another thread (checkpoint vs the background repair
+                # loop) is mid-resync: re-arming here would WIPE its
+                # armed ledger and certify a node missing those writes
+                return False
+            sh.resyncing.add(ix)
+            sh.gaps[ix] = []  # armed: concurrent acked writes land here
+        from advanced_scrapper_tpu.obs import trace
+
+        pushed_total = 0
+        ok = False
+        try:
+            for _ in range(max(1, self.resync_rounds)):
+                # the gate is the NODE's side only: the live source keeps
+                # taking writes mid-pass and legitimately trails the
+                # union; the returning node receives nothing but our
+                # pushes, so its match is churn-immune
+                pushed, _src_ok, matched = self._heal_pair(sh, source, node)
+                pushed_total += pushed
+                self._m_resync_postings.inc(pushed)
+                if matched:
+                    with sh.lock:
+                        # the armed ledger must have SURVIVED: if it
+                        # overflowed mid-resync, writes went unpreserved
+                        # and the match certifies a stale state
+                        if sh.gaps.get(ix) is not None:
+                            sh.gap_overflow.discard(ix)
+                            ok = True
+                    break
+        except (RpcUnavailable, RpcOverloaded):
+            pass
+        finally:
+            with sh.lock:
+                sh.resyncing.discard(ix)
+                if not ok:
+                    # void the attempt: keep the node out (mark stays /
+                    # returns set, armed ledger dropped — the next full
+                    # push covers it) even when an unexpected exception
+                    # is propagating
+                    sh.gap_overflow.add(ix)
+                    sh.gaps.pop(ix, None)
+        if ok:
+            self._m_resyncs.inc()
+            trace.record(
+                "event", "fleet.resync", shard=sh.sid,
+                node=f"{node.address[0]}:{node.address[1]}",
+                postings=pushed_total,
+            )
+        return ok
+
+    def repair_once(self) -> dict:
+        """One anti-entropy pass over every shard: revive/resync
+        returning nodes, then one symmetric heal per live replica pair
+        (bucket-digest diff → divergent ranges only, pushed both ways).
+        Safe under concurrent inserts — pushes are semantically
+        idempotent and the min-doc merge is monotone; a pass that raced
+        a write simply leaves the remainder to the next pass.  Returns a
+        stats dict."""
+        stats = {"shards": 0, "pushed": 0, "pairs": 0, "unmatched": 0}
+        self._m_repair_rounds.inc()
+        for sh in self._shards:
+            self._try_revive(sh, allow_resync=True)
+            live = sh.live_nodes()
+            stats["shards"] += 1
+            if len(live) < 2:
+                continue
+            ref = live[0]
+            for other in live[1:]:
+                try:
+                    pushed, m_ref, m_other = self._heal_pair(sh, ref, other)
+                except (RpcUnavailable, RpcOverloaded):
+                    stats["unmatched"] += 1
+                    continue
+                stats["pushed"] += pushed
+                stats["pairs"] += 1
+                if not (m_ref and m_other):
+                    stats["unmatched"] += 1
+        return stats
+
+    def start_repair(self, interval: float) -> None:
+        """Arm the background repair loop (idempotent): every
+        ``interval`` seconds one ``repair_once`` pass runs on a daemon
+        thread.  ``ASTPU_FLEET_REPAIR_INTERVAL`` (seconds, 0=off) arms it
+        at construction; ``interval <= 0`` means OFF here too (never a
+        busy loop — ``Event.wait(0)`` returns immediately)."""
+        if interval <= 0:
+            return
+        if self._repair_thread is not None and self._repair_thread.is_alive():
+            return
+        self.repair_interval = float(interval)
+        self._repair_stop.clear()
+
+        def loop():
+            while not self._repair_stop.wait(self.repair_interval):
+                try:
+                    self.repair_once()
+                except Exception:
+                    # the repair plane must never take the client down;
+                    # the next pass retries (faults already counted by
+                    # the per-call paths)
+                    from advanced_scrapper_tpu.obs import trace
+
+                    trace.record("event", "fleet.repair_error")
+
+        self._repair_thread = threading.Thread(
+            target=loop, daemon=True, name=f"astpu-fleet-repair-{self.space}"
+        )
+        self._repair_thread.start()
+
+    def stop_repair(self) -> None:
+        self._repair_stop.set()
+        t = self._repair_thread
+        if t is not None:
+            t.join(timeout=5)
+            self._repair_thread = None
 
     # -- RPC fan-out internals --------------------------------------------
 
@@ -937,8 +1269,10 @@ class ShardedIndexClient:
         return acked
 
     #: per-node gap ledger cap — beyond this many missed postings the
-    #: ledger is dropped and the node sits out this client's lifetime (an
-    #: operator resync is cheaper than unbounded client RAM)
+    #: ledger is dropped and the node is routed through a FULL
+    #: digest-verified resync before it may rejoin (bounded client RAM,
+    #: no node ever sits out forever); instance-overridable via the
+    #: ``gap_limit_postings`` constructor knob
     GAP_LIMIT_POSTINGS = 1 << 20
 
     def _gap_append(self, sh: _Shard, ix: int, rid, keys, docs) -> None:
@@ -947,24 +1281,33 @@ class ShardedIndexClient:
         If a racing ``_try_revive`` brought the node back between our
         fan-out snapshot and this append, the node is live WITHOUT this
         write — re-kill it so the next revive round drains the ledger;
-        the live-node invariant must hold unconditionally."""
-        if ix in sh.gap_overflow:
-            return
+        the live-node invariant must hold unconditionally.
+
+        Overflowed nodes: with no ledger armed the write is dropped — a
+        future resync's full-state push covers it by construction.  A
+        resync in flight ARMS a fresh ledger (``_resync_node``) so the
+        writes it races with are preserved; if even that ledger overflows
+        the resync is voided and restarts."""
+        gap = sh.gaps.get(ix)
+        if ix in sh.gap_overflow and gap is None:
+            return  # awaiting resync; the full-state push will carry this
         if sh.nodes[ix].alive:
             sh.nodes[ix].alive = False
             if sh.nodes[sh.write_target] is sh.nodes[ix]:
                 sh.promoting = True
-        gap = sh.gaps.setdefault(ix, [])
+        if gap is None:
+            gap = sh.gaps.setdefault(ix, [])
         held = sum(int(k.size) for _r, k, _d in gap)
-        if held + int(keys.size) > self.GAP_LIMIT_POSTINGS:
+        if held + int(keys.size) > self.gap_limit_postings:
             sh.gaps.pop(ix, None)
             sh.gap_overflow.add(ix)
             from advanced_scrapper_tpu.obs import telemetry
 
             telemetry.event_counter(
                 "astpu_fleet_gap_overflow_total",
-                "nodes dropped from the fleet for outliving their gap "
-                "ledger (operator must resync the node)",
+                "nodes whose gap ledger outgrew the cap and was dropped; "
+                "they rejoin through digest-verified auto-resync "
+                "(astpu_fleet_resync_total), never by the plain drain path",
             ).inc()
             return
         gap.append((rid, keys, docs))
@@ -1159,8 +1502,13 @@ class ShardedIndexClient:
     def checkpoint(self) -> None:
         """Fan the durability point to every live node; spill journals
         are already fsync'd at append time.  Also the periodic recovery
-        probe: a dark shard that came back replays its spill here."""
+        probe: a dark shard that came back replays its spill here, and a
+        gap-OVERFLOWED node gets its digest-verified resync attempt —
+        checkpoint cadence is the hot-path-safe place for that streaming
+        work (the backend already calls it at its durability cadence)."""
         for sh in self._shards:
+            if any(not n.alive for n in sh.nodes):
+                self._try_revive(sh, allow_resync=True)
             if sh.pending or not sh.live_nodes():
                 self._ensure_write_target(sh)
             for node in sh.live_nodes():
@@ -1238,6 +1586,7 @@ class ShardedIndexClient:
         if self._closed:
             return
         self._closed = True
+        self.stop_repair()
         self._pool.shutdown(wait=True)
         for sh in self._shards:
             if sh.journal is not None:

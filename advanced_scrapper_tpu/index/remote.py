@@ -36,9 +36,10 @@ import numpy as np
 
 import advanced_scrapper_tpu.net.rpc as rpc  # the ONE allowed net import
 
+from advanced_scrapper_tpu.index import repair as antientropy
 from advanced_scrapper_tpu.index.store import PersistentIndex
 
-__all__ = ["IndexShardServer", "RemoteIndex", "serve_main"]
+__all__ = ["IndexShardServer", "RemoteIndex", "paged_fetch_range", "serve_main"]
 
 DEFAULT_SPACES = ("bands", "urls")
 
@@ -149,6 +150,14 @@ class IndexShardServer:
                 "stats": self._h_stats,
                 "dump": self._h_dump,
                 "checkpoint": self._h_checkpoint,
+                # the self-healing plane: anti-entropy digests + range
+                # streaming, on-demand corruption scrub, and the
+                # snapshot/fetch pair tools/fleet_snapshot.py drives
+                "digest": self._h_digest,
+                "fetch_range": self._h_fetch_range,
+                "scrub": self._h_scrub,
+                "snapshot": self._h_snapshot,
+                "fetch_file": self._h_fetch_file,
             },
             host=host,
             port=port,
@@ -292,6 +301,93 @@ class IndexShardServer:
             idx.checkpoint()
         return {}
 
+    # -- self-healing plane ------------------------------------------------
+
+    def _h_digest(self, header, arrays):
+        """Bucketed key-space digest of the SEMANTIC state — the
+        anti-entropy comparison unit (``index/repair.py``)."""
+        idx = self._space(header)
+        bits = int(header.get("bits", antientropy.DEFAULT_BITS))
+        dig, cnt = antientropy.bucket_digests(*idx.semantic_items(), bits)
+        return {"bits": bits}, [dig, cnt]
+
+    def _h_fetch_range(self, header, arrays):
+        """Semantic ``(key, min-doc)`` pairs with key in ``[lo, hi)`` —
+        paged like ``dump`` so a hot bucket can never build a frame past
+        the cap.  ``hi`` may be 2**64 (the last bucket's open end)."""
+        idx = self._space(header)
+        lo, hi = int(header["lo"]), int(header["hi"])
+        keys, docs = idx.semantic_items()
+        # semantic keys are sorted: the [lo, hi) slice is two binary
+        # searches, not a full-array mask per page
+        i0 = int(np.searchsorted(keys, np.uint64(lo), side="left"))
+        i1 = (
+            keys.size
+            if hi >= antientropy.KEY_SPACE_END
+            else int(np.searchsorted(keys, np.uint64(hi), side="left"))
+        )
+        keys, docs = keys[i0:i1], docs[i0:i1]
+        total = int(keys.size)
+        off = int(header.get("offset", 0))
+        limit = header.get("limit")
+        if limit is not None:
+            keys, docs = keys[off : off + int(limit)], docs[off : off + int(limit)]
+        elif off:
+            keys, docs = keys[off:], docs[off:]
+        return {"total": total}, [keys, docs]
+
+    def _h_scrub(self, header, arrays):
+        """On-demand end-to-end corruption scrub: every block CRC + the
+        manifest whole-file digests, per space; corrupt segments are
+        quarantined server-side instead of ever answering a probe."""
+        sp = header.get("space")
+        spaces = [sp] if sp else sorted(self.indexes)
+        return {
+            "shard": self.name,
+            "report": {s: self.indexes[s].scrub() for s in spaces},
+        }
+
+    def _h_snapshot(self, header, arrays):
+        """Consistent-snapshot fence for one space: cut the memtable
+        under the shard write lock (no insert can interleave with the
+        fence), then name every live file with size + digest."""
+        idx = self._space(header)
+        with self._lock:
+            return {"shard": self.name, "snapshot": idx.snapshot_meta()}
+
+    def _h_fetch_file(self, header, arrays):
+        """Raw paged bytes of one snapshot-named file (segments are
+        immutable, so pages of one file always compose consistently)."""
+        idx = self._space(header)
+        data = idx.read_file(
+            header["name"],
+            int(header.get("offset", 0)),
+            header.get("limit"),
+        )
+        return {"bytes": len(data)}, [np.frombuffer(data, np.uint8)]
+
+
+def paged_fetch_range(
+    call, lo: int, hi: int, *, page: int = 1 << 18
+) -> tuple[np.ndarray, np.ndarray]:
+    """The ONE ``fetch_range`` pagination loop (offset/total/empty-page
+    termination), shared by :class:`RemoteIndex` and the fleet client's
+    repair plane so the paging contract cannot drift between them.
+    ``call(header)`` issues one RPC and returns ``(header, [keys, docs])``.
+    """
+    parts_k, parts_d = [], []
+    off = 0
+    while True:
+        h, (keys, docs) = call(
+            {"lo": int(lo), "hi": int(hi), "offset": off, "limit": int(page)}
+        )
+        parts_k.append(np.asarray(keys, np.uint64))
+        parts_d.append(np.asarray(docs, np.uint64))
+        off += int(parts_k[-1].size)
+        if off >= int(h.get("total", off)) or parts_k[-1].size == 0:
+            break
+    return np.concatenate(parts_k), np.concatenate(parts_d)
+
 
 class RemoteIndex:
     """Client handle for ONE key space on ONE shard node.
@@ -390,6 +486,58 @@ class RemoteIndex:
 
     def checkpoint(self) -> None:
         self._call("checkpoint")
+
+    # -- self-healing plane ------------------------------------------------
+
+    def digest(self, *, bits: int | None = None):
+        h, (dig, cnt) = self._call(
+            "digest", {} if bits is None else {"bits": int(bits)}
+        )
+        return dig, cnt
+
+    def fetch_range(
+        self, lo: int, hi: int, *, page: int = 1 << 18
+    ) -> tuple[np.ndarray, np.ndarray]:
+        return paged_fetch_range(
+            lambda header: self._call("fetch_range", header),
+            lo, hi, page=page,
+        )
+
+    def scrub(self) -> dict:
+        h, _ = self._call("scrub")
+        return h["report"]
+
+    def snapshot_meta(self) -> dict:
+        h, _ = self._call("snapshot")
+        return h["snapshot"]
+
+    def fetch_file_into(self, name: str, fh, *, page: int = 4 << 20) -> int:
+        """Stream one snapshot-named file into ``fh`` paged under the
+        frame cap (segments are immutable, so pages compose); returns
+        the byte count.  Memory stays bounded at one page — a multi-GB
+        compacted segment never materialises client-side."""
+        off = 0
+        while True:
+            h, (chunk,) = self._call(
+                "fetch_file", {"name": name, "offset": off, "limit": int(page)}
+            )
+            chunk = np.asarray(chunk, np.uint8).tobytes()
+            if not chunk:
+                break
+            fh.write(chunk)
+            off += len(chunk)
+            if len(chunk) < page:
+                break
+        return off
+
+    def fetch_file(self, name: str, *, page: int = 4 << 20) -> bytes:
+        """:meth:`fetch_file_into` for small files whose bytes the
+        caller wants in hand."""
+        import io
+
+        buf = io.BytesIO()
+        self.fetch_file_into(name, buf, page=page)
+        return buf.getvalue()
 
     def close(self) -> None:
         self.client.close()

@@ -41,7 +41,12 @@ import time
 
 import numpy as np
 
-from advanced_scrapper_tpu.index.segment import Segment, write_segment
+from advanced_scrapper_tpu.index.segment import (
+    Segment,
+    SegmentCorruption,
+    file_digest,
+    write_segment,
+)
 from advanced_scrapper_tpu.index.wal import WriteAheadLog, replay_wal
 from advanced_scrapper_tpu.storage.fsio import atomic_replace, default_fs
 
@@ -49,6 +54,11 @@ __all__ = ["PersistentIndex", "resolve_intra_batch"]
 
 MANIFEST = "manifest.json"
 DOCMAP = "docmap.log"
+
+#: seconds an idle cached semantic state (repair/digest input) survives —
+#: long enough to span one paged repair conversation, short enough that a
+#: finished repair frees the arrays at the next checkpoint beat
+SEMANTIC_CACHE_TTL_S = 60.0
 
 NO_DOC = np.int64(-1)
 
@@ -147,12 +157,33 @@ class PersistentIndex:
         man = self._load_manifest()
         self._seg_seq = int(man.get("seg_seq", 0))
         self._wal_seq = int(man.get("wal_seq", 0))
-        self._segments: list[Segment] = [
-            Segment(os.path.join(directory, name), fs=self._fs)
-            for name in man.get("segments", [])
-        ]
+        #: whole-file digest per live segment (manifest-recorded identity;
+        #: pre-v2 manifests lack entries — scrub backfills them)
+        self._digests: dict[str, str] = dict(man.get("digests", {}))
+        self._segments: list[Segment] = []
+        dirty_manifest = False
+        for name in man.get("segments", []):
+            path = os.path.join(directory, name)
+            try:
+                self._segments.append(Segment(path, fs=self._fs))
+            except (FileNotFoundError, ValueError, SegmentCorruption) as e:
+                # PR 1 torn-artifact philosophy: a segment that cannot be
+                # opened because its BYTES are wrong (header-CRC
+                # mismatch, truncation, bad magic, bit rot in the
+                # resident planes) or is simply gone is quarantined —
+                # sidecar + counter — and the index continues on the
+                # surviving manifest instead of crashing the whole open.
+                # Transient resource errors (EMFILE/ENOMEM/EINTR…) are
+                # NOT corruption and propagate: quarantining a healthy
+                # segment on fd pressure would permanently withdraw its
+                # postings where a plain retry loses nothing.
+                self._quarantine_segment_file(path, str(e))
+                self._digests.pop(name, None)
+                dirty_manifest = True
         if not read_only:
-            self._sweep_orphans(set(man.get("segments", [])))
+            self._sweep_orphans(
+                {os.path.basename(s.path) for s in self._segments}
+            )
         # WAL replay rebuilds the memtable; its doc ids also re-derive the
         # allocation high-water mark a crash may have kept out of the
         # manifest (manifest next_doc_id is only persisted at cut time)
@@ -169,13 +200,22 @@ class PersistentIndex:
         self._next_doc_id = int(man.get("next_doc_id", 0))
         if md.size:
             self._next_doc_id = max(self._next_doc_id, int(md.max()) + 1)
+        #: (state key, (keys, docs), warmed-at) — see semantic_items
+        self._semantic_cache = None
         if read_only:
             self._wal = None
         else:
             self._repair_wal_tail(wal_path, wal_end)
             self._wal = WriteAheadLog(wal_path, fs=self._fs)
+            if dirty_manifest:
+                # commit the quarantine: the manifest must stop naming the
+                # sidelined segment or every reopen re-quarantines a file
+                # that is no longer there
+                self._write_manifest()
         self.reopen_seconds = time.perf_counter() - t0
         self._instrument()
+        if not read_only and os.environ.get("ASTPU_INDEX_SCRUB", "") not in ("", "0"):
+            self.scrub()
 
     def _repair_wal_tail(self, wal_path: str, valid_end: int) -> None:
         """Truncate a torn WAL tail before reopening the appender: records
@@ -215,20 +255,28 @@ class PersistentIndex:
             raise ValueError(f"unknown index manifest version in {path}")
         return man
 
-    def _write_manifest(self) -> None:
-        """Atomic commit point for every structural change (cut, compact,
-        rotation): the swapped file names exactly the live segment set,
-        the live WAL generation and the doc-id high-water mark."""
-        man = {
+    def _manifest_dict(self) -> dict:
+        names = [os.path.basename(s.path) for s in self._segments]
+        return {
             "version": 1,
             "seg_seq": self._seg_seq,
             "wal_seq": self._wal_seq,
-            "segments": [os.path.basename(s.path) for s in self._segments],
+            "segments": names,
             "next_doc_id": self._next_doc_id,
+            # whole-file digests: the corruption detector of last resort
+            # (scrub/fsck recompute and compare) and the snapshot tool's
+            # transfer-verification source
+            "digests": {n: self._digests[n] for n in names if n in self._digests},
         }
+
+    def _write_manifest(self) -> None:
+        """Atomic commit point for every structural change (cut, compact,
+        rotation): the swapped file names exactly the live segment set,
+        the live WAL generation, the doc-id high-water mark and every
+        segment's whole-file digest."""
         atomic_replace(
             os.path.join(self.dir, MANIFEST),
-            json.dumps(man, indent=1).encode("utf-8"),
+            json.dumps(self._manifest_dict(), indent=1).encode("utf-8"),
             fs=self._fs,
         )
 
@@ -253,6 +301,208 @@ class PersistentIndex:
                     self._fs.remove(os.path.join(self.dir, name))
                 except OSError:
                     pass
+
+    # -- integrity: quarantine & scrub ---------------------------------------
+
+    def _quarantine_segment_file(self, path: str, reason: str) -> None:
+        """Sideline one corrupt/torn segment FILE: rename to the PR 1
+        ``.quarantine`` sidecar (evidence preserved for the operator,
+        invisible to every reader pattern) and count it.  In read-only
+        mode the file is left in place — the checker observes, never
+        mutates — but the drop from the live set still counts."""
+        moved = False
+        if not self.read_only:
+            try:
+                if self._fs.exists(path):
+                    self._fs.replace(path, path + ".quarantine")
+                    moved = True
+            except OSError:
+                pass
+        from advanced_scrapper_tpu.obs import telemetry, trace
+
+        telemetry.event_counter(
+            "astpu_quarantine_total",
+            "crash artifacts quarantined, by kind",
+            kind="segment",
+        ).inc()
+        trace.record(
+            "event", "quarantine.segment", path=os.path.basename(path),
+            reason=reason, moved=moved,
+        )
+
+    def _quarantine_live_segment(self, seg: Segment, reason: str) -> None:
+        """Quarantine a segment that is currently serving: drop it from
+        the live set, commit the shrunken manifest, THEN sideline the
+        file.  Postings it held stop answering — wrong answers would be
+        worse — until scrub/repair (or a replica) restores them."""
+        name = os.path.basename(seg.path)
+        with self._lock:
+            if seg not in self._segments:
+                return  # a racing probe already quarantined it
+            self._segments = [s for s in self._segments if s is not seg]
+            self._digests.pop(name, None)
+            if not self.read_only:
+                try:
+                    self._write_manifest()
+                except OSError:
+                    pass  # reopen re-quarantines; the sidecar rename below
+                    #       still stops this file from being served
+        # like compaction's swap: the dropped ref keeps any racing probe
+        # alive (POSIX rename semantics — the memmap outlives the name);
+        # never Segment.close()d here, or a concurrent probe of the same
+        # snapshot would read from released arrays
+        self._quarantine_segment_file(seg.path, reason)
+
+    def scrub(self) -> dict:
+        """End-to-end corruption pass: eagerly verify every block CRC of
+        every live segment plus its manifest-recorded whole-file digest.
+        Corrupt segments are quarantined (never served again); segments
+        predating digest records get their digest backfilled.  Returns a
+        report dict; safe on a read-only open (observe, don't mutate).
+
+        Callers: ``ASTPU_INDEX_SCRUB=1`` runs it at open, the shard
+        server exposes it as the ``scrub`` RPC, ``tools/fsck_index.py``
+        is the offline twin."""
+        t0 = time.perf_counter()
+        with self._lock:
+            snapshot = list(self._segments)
+        report: dict = {
+            "dir": self.dir,
+            "segments": len(snapshot),
+            "corrupt": [],
+            "backfilled_digests": 0,
+        }
+        backfilled = False
+        for seg in snapshot:
+            name = os.path.basename(seg.path)
+            try:
+                digest = seg.verify_all(fs=self._fs)
+            except SegmentCorruption as e:
+                report["corrupt"].append({"segment": name, "detail": e.detail})
+                self._m_scrub_corrupt.inc()
+                self._quarantine_live_segment(seg, e.detail)
+                continue
+            except OSError:
+                # the file vanished under us: a racing compaction
+                # superseded this snapshot entry (its postings live in
+                # the merged segment, which a later scrub covers) — not
+                # corruption, just a stale snapshot row
+                with self._lock:
+                    still_live = seg in self._segments
+                if still_live:
+                    raise
+                continue
+            with self._lock:
+                want = self._digests.get(name)
+                if want is None:
+                    self._digests[name] = digest
+                    report["backfilled_digests"] += 1
+                    backfilled = True
+            if want is not None and want != digest:
+                detail = (
+                    f"whole-file digest mismatch ({digest} != manifest "
+                    f"{want})"
+                )
+                report["corrupt"].append({"segment": name, "detail": detail})
+                self._m_scrub_corrupt.inc()
+                self._quarantine_live_segment(seg, detail)
+        if backfilled and not self.read_only:
+            with self._lock:
+                self._write_manifest()
+        self._m_scrubs.inc()
+        self._m_scrub_s.observe(time.perf_counter() - t0)
+        report["ok"] = not report["corrupt"]
+        return report
+
+    def semantic_items(self) -> tuple[np.ndarray, np.ndarray]:
+        """The index's SEMANTIC state: sorted unique keys + the minimum
+        doc id each attributes to — the representation anti-entropy
+        digests and repair transfers run over (compaction timing and
+        posting multiplicity cancel out of it by construction).
+
+        Cached on the structural state (segment set + memtable size): a
+        repair conversation pages dozens of digest/fetch_range calls
+        against one quiescent state, and each would otherwise re-sort
+        every posting.  The cache is dropped on the next insert and aged
+        out at checkpoint cadence (:data:`SEMANTIC_CACHE_TTL_S`) so a
+        finished repair never pins the materialised state indefinitely.
+        Callers must treat the arrays as read-only."""
+        from advanced_scrapper_tpu.index.repair import semantic_min
+
+        key = self._semantic_key()
+        with self._lock:
+            cached = self._semantic_cache
+            if cached is not None and cached[0] == key:
+                return cached[1]
+        items = semantic_min(*self.dump_postings())
+        with self._lock:
+            # only cache if the state did not move under the computation
+            # (else the arrays would be filed under a stale key)
+            if self._semantic_key() == key:
+                self._semantic_cache = (key, items, time.monotonic())
+        return items
+
+    def _semantic_key(self):
+        with self._lock:
+            return (
+                self._seg_seq, self._wal_seq, self._mem_count,
+                tuple(os.path.basename(s.path) for s in self._segments),
+            )
+
+    def _age_semantic_cache(self) -> None:
+        """Free the materialised semantic arrays once the repair
+        conversation that warmed them has clearly ended."""
+        with self._lock:
+            cached = self._semantic_cache
+            if (
+                cached is not None
+                and time.monotonic() - cached[2] > SEMANTIC_CACHE_TTL_S
+            ):
+                self._semantic_cache = None
+
+    # -- snapshot ------------------------------------------------------------
+
+    def snapshot_meta(self) -> dict:
+        """Consistent-snapshot fence + pin: cut the memtable (after the
+        cut the WAL generation is empty, so the durable state is exactly
+        manifest + immutable segments), then name every live file with
+        its size and digest.  The returned dict + the named files ARE the
+        snapshot; ``tools/fleet_snapshot.py`` assembles them."""
+        if not self.read_only:
+            self.cut_segment()  # no-op on an empty memtable
+        with self._lock:
+            files = []
+            for s in self._segments:
+                name = os.path.basename(s.path)
+                digest = self._digests.get(name)
+                if digest is None:
+                    digest = file_digest(s.path, fs=self._fs)
+                    self._digests[name] = digest
+                files.append(
+                    {"name": name, "bytes": int(self._fs.size(s.path)),
+                     "digest": digest}
+                )
+            docmap = os.path.join(self.dir, DOCMAP)
+            if self._fs.exists(docmap):
+                files.append(
+                    {"name": DOCMAP, "bytes": int(self._fs.size(docmap)),
+                     "digest": file_digest(docmap, fs=self._fs)}
+                )
+            return {"manifest": self._manifest_dict(), "files": files}
+
+    def read_file(self, name: str, offset: int = 0, limit: int | None = None) -> bytes:
+        """Paged raw read of one snapshot-named file (segment, docmap or
+        the manifest itself) — the ``fetch_file`` RPC body.  ``name`` is
+        a bare basename; path traversal is rejected."""
+        if os.path.basename(name) != name or name.startswith("."):
+            raise ValueError(f"bad snapshot file name {name!r}")
+        with self._lock:
+            live = {os.path.basename(s.path) for s in self._segments}
+        if name not in live and name not in (MANIFEST, DOCMAP):
+            raise ValueError(f"{name!r} is not a live snapshot file")
+        with self._fs.open(os.path.join(self.dir, name), "rb") as fh:
+            fh.seek(int(offset))
+            return fh.read(-1 if limit is None else int(limit))
 
     # -- telemetry -----------------------------------------------------------
 
@@ -286,6 +536,19 @@ class PersistentIndex:
         )
         self._m_cut_s = telemetry.histogram(
             "astpu_index_segment_cut_seconds", "segment-cut wall clock", index=iid
+        )
+        self._m_scrubs = telemetry.counter(
+            "astpu_scrub_runs_total", "integrity scrub passes", index=iid
+        )
+        self._m_scrub_s = telemetry.histogram(
+            "astpu_scrub_seconds", "scrub pass wall clock", index=iid
+        )
+        # always-on: silent corruption surfacing is exactly what an
+        # operator audits in an incident, telemetry gate or not
+        self._m_scrub_corrupt = telemetry.event_counter(
+            "astpu_scrub_corrupt_segments_total",
+            "segments failing block-CRC/digest verification (quarantined)",
+            index=iid,
         )
         for name, fn, help in (
             ("astpu_index_segments", lambda s: len(s._segments),
@@ -449,6 +712,7 @@ class PersistentIndex:
             # posted ids raise the allocation floor so it survives the cut
             # (manifest persists next_doc_id) and the crash (WAL replay)
             self._next_doc_id = max(self._next_doc_id, int(docs.max()) + 1)
+            self._semantic_cache = None  # state moved; free the arrays
             self._m_postings.inc(keys.size)
             due = self._mem_count >= self.cut_postings
         if due:
@@ -483,7 +747,16 @@ class PersistentIndex:
                 hit = mem_docs >= 0
                 best[hit] = mem_docs[hit]
         for seg in segments:
-            rows, docs = seg.probe(flat)
+            try:
+                rows, docs = seg.probe(flat)
+            except SegmentCorruption as e:
+                # bit rot surfaced on the probe path: quarantine instead
+                # of serving an answer derived from the corrupt block (a
+                # replica/scrub-repair restores the postings; a silently
+                # wrong attribution would be forever)
+                self._m_scrub_corrupt.inc()
+                self._quarantine_live_segment(seg, e.detail)
+                continue
             if rows.size:
                 np.minimum.at(best, rows, docs.astype(np.int64))
         best = best.reshape(B, -1).min(axis=1)
@@ -550,12 +823,13 @@ class PersistentIndex:
             self._seg_seq += 1
             name = _seg_name(self._seg_seq)
             path = os.path.join(self.dir, name)
-            write_segment(path, keys, docs, seed=self._seg_seq, fs=self._fs)
+            digest = write_segment(path, keys, docs, seed=self._seg_seq, fs=self._fs)
             old_wal = self._wal
             old_wal_path = old_wal.path
             self._wal_seq += 1
             seg = Segment(path, fs=self._fs)
             self._segments.append(seg)
+            self._digests[name] = digest
             try:
                 # the new WAL generation opens BEFORE the commit: if the
                 # manifest swap then commits, no fallible step remains —
@@ -576,6 +850,7 @@ class PersistentIndex:
                     raise
             except BaseException:
                 self._segments.pop()
+                self._digests.pop(name, None)
                 self._seg_seq -= 1
                 self._wal_seq -= 1
                 raise
@@ -636,7 +911,7 @@ class PersistentIndex:
             tombstoned = int(keys.size - first.sum())
             keys, docs = keys[first], docs[first]
             path = os.path.join(self.dir, name)
-            write_segment(path, keys, docs, seed=self._seg_seq, fs=self._fs)
+            digest = write_segment(path, keys, docs, seed=self._seg_seq, fs=self._fs)
             merged = Segment(path, fs=self._fs)
             old_names = {os.path.basename(s.path) for s in snapshot}
             with self._lock:
@@ -645,11 +920,15 @@ class PersistentIndex:
                     if os.path.basename(s.path) not in old_names
                 ]
                 self._segments = [merged] + fresh
+                self._digests[name] = digest
                 try:
                     self._write_manifest()  # ← the commit point
                 except BaseException:
                     self._segments = snapshot + fresh
+                    self._digests.pop(name, None)
                     raise
+                for old in old_names:
+                    self._digests.pop(old, None)
             # old segment files: dropped refs keep any racing probe alive
             # (POSIX unlink semantics); never Segment.close()d here
             for s in snapshot:
@@ -667,6 +946,7 @@ class PersistentIndex:
         """Durability point at the configured cadence: fsync the WAL, and
         cut a segment if the memtable crossed the cadence threshold."""
         self._check_writable()
+        self._age_semantic_cache()
         with self._lock:
             self._wal.sync()
             due = self._mem_count >= self.cut_postings

@@ -110,6 +110,11 @@ class ChaosFile:
     - ``eio_flush``: ``flush()`` raises ``EIO`` without flushing.
     - ``crash``: persist a prefix, flush it, then die (``SimulatedCrash``
       in-process; ``os._exit`` under ``exit=1``) — crash-after-N-bytes.
+    - ``bitflip``: SILENTLY flip one seeded bit of the buffer and persist
+      the rest intact — no error, no short count: the medium lied.  The
+      bit-rot generator the integrity plane (segment block CRCs, scrub,
+      ``tools/fsck_index.py``) exists to catch; binary writes only (a
+      text-mode write passes through unfaulted and uncounted).
     """
 
     def __init__(self, inner, fs: "ChaosFs", path: str):
@@ -120,7 +125,12 @@ class ChaosFile:
     # -- faulted surface ---------------------------------------------------
 
     def write(self, data):
-        kind = self._fs._decide(self._path, "write")
+        binary = isinstance(data, (bytes, bytearray, memoryview))
+        kind = self._fs._decide(self._path, "write", binary=binary)
+        if kind == "bitflip":
+            # silent corruption: the write "succeeds" byte-for-byte except
+            # one seeded flipped bit — exactly what a rotting medium does
+            return self._inner.write(self._fs._flip_bit(self._path, bytes(data)))
         if kind in ("short_write", "crash"):
             # persist a deterministic strict prefix — the byte count comes
             # from the same seeded stream as the fault decision
@@ -173,8 +183,9 @@ class ChaosFs:
     """
 
     #: fault kinds, in decision order (one uniform draw per kind, like
-    #: ChaosTransport's rate cascade)
-    KINDS = ("short_write", "eio_flush", "fsync_error", "crash")
+    #: ChaosTransport's rate cascade; ``bitflip`` sits LAST so enabling it
+    #: never shifts the draw sequence of pre-existing seeded specs)
+    KINDS = ("short_write", "eio_flush", "fsync_error", "crash", "bitflip")
 
     def __init__(
         self,
@@ -185,6 +196,7 @@ class ChaosFs:
         eio_flush_rate: float = 0.0,
         fsync_error_rate: float = 0.0,
         crash_rate: float = 0.0,
+        bitflip_rate: float = 0.0,
         only: str | None = None,
         on_crash=None,
     ):
@@ -195,6 +207,7 @@ class ChaosFs:
             "eio_flush": eio_flush_rate,
             "fsync_error": fsync_error_rate,
             "crash": crash_rate,
+            "bitflip": bitflip_rate,
         }
         self._only = only
         self._on_crash = on_crash  # None → raise SimulatedCrash
@@ -212,7 +225,7 @@ class ChaosFs:
         # processes and threads, like ChaosTransport's (seed, url) scheme
         return random.Random(f"{self._seed}|{os.path.basename(path)}|{op}|{n}")
 
-    def _decide(self, path: str, op: str) -> str | None:
+    def _decide(self, path: str, op: str, *, binary: bool = True) -> str | None:
         if self._only is not None and self._only not in path:
             return None
         with self._lock:
@@ -222,6 +235,8 @@ class ChaosFs:
         r = self._rng(path, op, n).random
         for kind in self.KINDS:
             if self._rates[kind] and r() < self._rates[kind]:
+                if kind == "bitflip" and not binary:
+                    return None  # flip is defined on bytes only
                 if (kind, op) in _KIND_OPS:
                     with self._lock:
                         self.injected[kind] += 1
@@ -240,6 +255,21 @@ class ChaosFs:
                     return kind
                 return None  # kind drawn but not applicable to this op
         return None
+
+    def _flip_bit(self, path: str, data: bytes) -> bytes:
+        """One seeded bit flipped in ``data`` — same determinism contract
+        as every other fault: a pure function of (seed, path, per-path
+        flip index)."""
+        if not data:
+            return data
+        with self._lock:
+            key = (os.path.basename(path), "bitflip")
+            n = self._op_counts.get(key, 0)
+            self._op_counts[key] = n + 1
+        bit = self._rng(path, "bitflip", n).randrange(len(data) * 8)
+        out = bytearray(data)
+        out[bit // 8] ^= 1 << (bit % 8)
+        return bytes(out)
 
     def _prefix_len(self, path: str, total: int) -> int:
         if total <= 1:
@@ -308,6 +338,7 @@ class ChaosFs:
 _KIND_OPS = {
     ("short_write", "write"),
     ("crash", "write"),
+    ("bitflip", "write"),
     ("eio_flush", "flush"),
     ("crash", "flush"),
     ("fsync_error", "fsync"),
@@ -431,6 +462,8 @@ def _parse_env_spec(spec: str):
             kw["fsync_error_rate"] = float(v)
         elif k == "crash":
             kw["crash_rate"] = float(v)
+        elif k == "bitflip":
+            kw["bitflip_rate"] = float(v)
         elif k == "only":
             only = v
         elif k == "exit":

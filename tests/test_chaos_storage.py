@@ -344,3 +344,100 @@ def test_save_index_whole_or_previous_and_torn_quarantine(tmp_path):
     with pytest.raises(IndexFingerprintError):
         other.load_index_if_valid(ckpt)
     assert os.path.exists(ckpt), "mismatch must not quarantine the checkpoint"
+
+
+# -- silent bit rot ----------------------------------------------------------
+
+def test_chaos_bitflip_is_silent_seeded_and_binary_only(tmp_path):
+    """``bitflip`` is the one fault that LIES: the write reports full
+    success while persisting exactly one flipped bit.  Seeded (same seed
+    ⇒ same rotted bytes), counted on the ledger, and defined on binary
+    writes only — text-mode writes pass through unfaulted."""
+    payload = bytes(range(256)) * 8
+
+    def run(seed):
+        fs = ChaosFs(OsFs(), seed=seed, bitflip_rate=1.0)
+        path = str(tmp_path / f"rot-{seed}.bin")
+        with fs.open(path, "wb") as fh:
+            n = fh.write(payload)
+        assert n == len(payload), "the lie must be complete: full count"
+        data = open(path, "rb").read()
+        os.unlink(path)
+        return data, dict(fs.injected), list(fs.ledger)
+
+    d1, i1, l1 = run(3)
+    d2, _i2, _l2 = run(3)
+    d3, _i3, _l3 = run(4)
+    assert len(d1) == len(payload), "no short write, no truncation"
+    diff = [
+        i for i, (a, b) in enumerate(zip(d1, payload)) if a != b
+    ]
+    assert len(diff) == 1, f"exactly one rotted byte, got {diff}"
+    assert bin(d1[diff[0]] ^ payload[diff[0]]).count("1") == 1, "one BIT"
+    assert d1 == d2, "same seed ⇒ same rot"
+    assert d3 != d1, "different seed ⇒ different rot"
+    assert i1.get("bitflip") == 1
+    assert [k for (_p, _o, k) in l1] == ["bitflip"]
+
+    # text mode: the flip is undefined on str — unfaulted, uncounted
+    fs = ChaosFs(OsFs(), seed=3, bitflip_rate=1.0)
+    tpath = str(tmp_path / "rot.txt")
+    with fs.open(tpath, "w") as fh:
+        fh.write("hello text plane")
+    assert open(tpath).read() == "hello text plane"
+    assert fs.injected.get("bitflip", 0) == 0
+
+
+def test_chaos_bitflip_env_spec_round_trip(tmp_path):
+    """`bitflip=` rides the ASTPU_CHAOS_FS env spec like every other
+    rate — the forked-children injection path."""
+    from advanced_scrapper_tpu.storage.fsio import _parse_env_spec
+
+    fs = _parse_env_spec("seed=11,bitflip=1.0,only=rot-")
+    path = str(tmp_path / "rot-env.bin")
+    with fs.open(path, "wb") as fh:
+        fh.write(b"\x00" * 64)
+    assert open(path, "rb").read() != b"\x00" * 64
+    other = str(tmp_path / "spared.bin")
+    with fs.open(other, "wb") as fh:
+        fh.write(b"\x00" * 64)
+    assert open(other, "rb").read() == b"\x00" * 64, "`only=` must scope"
+
+
+def test_chaos_bitflip_caught_by_segment_integrity(tmp_path):
+    """The chaos plane meets the integrity plane: a segment written
+    through a bit-flipping fs FAILS verification — open (header/bloom
+    planes), verify_all (posting planes), or the whole-file digest the
+    manifest would record — instead of ever answering a probe from the
+    rotted bytes."""
+    import numpy as np
+
+    from advanced_scrapper_tpu.index.segment import (
+        Segment,
+        SegmentCorruption,
+        file_digest,
+        write_segment,
+    )
+
+    caught = 0
+    for seed in range(6):
+        fs = ChaosFs(OsFs(), seed=seed, bitflip_rate=0.3, only="seg-")
+        path = str(tmp_path / f"seg-{seed:08d}.seg")
+        keys = np.arange(2000, dtype=np.uint64)
+        manifest_digest = write_segment(path, keys, keys, seed=seed, fs=fs)
+        if not fs.injected.get("bitflip"):
+            os.unlink(path)
+            continue
+        # the manifest digest was computed from the INTENDED bytes; the
+        # medium lied, so at least one detector must fire
+        try:
+            seg = Segment(path)
+            seg.verify_all()
+        except (SegmentCorruption, ValueError):
+            caught += 1
+            continue
+        assert file_digest(path) != manifest_digest, (
+            "rot must at minimum break the whole-file digest"
+        )
+        caught += 1
+    assert caught >= 2, "the sweep must land real flips"

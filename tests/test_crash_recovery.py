@@ -205,3 +205,16 @@ def test_crashsweep_overload_five_instants(tmp_path):
     report = crashsweep.sweep_overload(str(tmp_path), kills=5, seed=11)
     assert not report["problems"], report["problems"]
     assert report["kills"] >= 4, report
+
+
+def test_crashsweep_bitrot_converges(tmp_path):
+    """One seeded silent bit flip planted in a replica's segment
+    mid-stream: scrub detects it, the poisoned segment is quarantined,
+    anti-entropy repair heals the withdrawn postings from the healthy
+    peer, annotations stay byte-equal to the uncorrupted single-node
+    oracle, and the offline fsck reports every node directory clean.
+    (The same workload runs at full width in the default
+    `tools/crashsweep.py` battery.)"""
+    report = crashsweep.sweep_bitrot(str(tmp_path), kills=1, seed=0)
+    assert not report["problems"], report["problems"]
+    assert report["kills"] == 1, "the planted flip was never detected"

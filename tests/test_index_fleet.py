@@ -724,3 +724,267 @@ def test_insert_overload_blocks_not_drops(tmp_path):
         client.close()
         for s in servers:
             s.stop()
+
+
+# -- self-healing: scrub withdrawal, anti-entropy repair, resync, snapshot --
+
+def _flip_bit_at(path: str, byte_off: int) -> None:
+    with open(path, "r+b") as fh:
+        fh.seek(byte_off)
+        b = fh.read(1)[0]
+        fh.seek(byte_off)
+        fh.write(bytes([b ^ 0x10]))
+
+
+def test_anti_entropy_repair_heals_scrubbed_replica_under_churn(tmp_path):
+    """A replica loses postings the honest way — scrub detects planted
+    bit rot and quarantines the segment (withdrawn, not wrong) — then
+    anti-entropy repair heals it from the healthy peer WHILE inserts are
+    in flight: at convergence both replicas hold the identical semantic
+    map, covering every insert, nothing lost, nothing duplicated."""
+    servers, client = _fleet(tmp_path, shards=1, replicas=2)
+    expect: dict[int, int] = {}
+    try:
+        for i in range(4):
+            keys = np.arange(i * 100, i * 100 + 40, dtype=np.uint64)
+            client.insert_batch(keys, np.full(40, i, np.uint64))
+            expect.update({int(k): i for k in keys.tolist()})
+        # rot one bit of a replica segment; scrub withdraws it
+        ridx = servers[1].indexes["bands"]
+        ridx.cut_segment()
+        seg_path = ridx._segments[0].path
+        _flip_bit_at(seg_path, os.path.getsize(seg_path) - 3)
+        report = ridx.scrub()
+        assert not report["ok"], "the planted rot must be detected"
+        assert os.path.exists(seg_path + ".quarantine")
+
+        # churn: inserts in flight while the repair loop runs
+        stop = threading.Event()
+        churned: dict[int, int] = {}
+
+        def churn():
+            j = 0
+            while not stop.is_set():
+                keys = np.arange(
+                    10_000 + j * 50, 10_000 + j * 50 + 16, dtype=np.uint64
+                )
+                client.insert_batch(keys, np.full(16, 500 + j, np.uint64))
+                churned.update({int(k): 500 + j for k in keys.tolist()})
+                j += 1
+                time.sleep(0.01)
+
+        t = threading.Thread(target=churn)
+        t.start()
+        try:
+            for _ in range(4):
+                client.repair_once()
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        expect.update(churned)
+        # the quiesced pass must fully converge
+        stats = client.repair_once()
+        assert stats["pairs"] == 1 and stats["unmatched"] == 0, stats
+        m0 = _min_map(*servers[0].indexes["bands"].dump_postings())
+        m1 = _min_map(*servers[1].indexes["bands"].dump_postings())
+        assert m0 == m1, "replicas still diverged after repair"
+        assert m0 == expect, "repair lost or invented postings"
+        assert client._m_repair_postings.value > 0
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+def test_gap_overflowed_node_rejoins_via_digest_verified_resync(tmp_path):
+    """The headline fix: a node whose gap ledger overflowed used to sit
+    out the client's lifetime pending an operator resync that did not
+    exist.  Now it rejoins through a FULL digest-verified resync — and
+    only through it (the plain drain path must keep refusing) — asserted
+    with writes still flowing during the resync."""
+    from advanced_scrapper_tpu.net.rpc import RpcClient
+    from advanced_scrapper_tpu.obs import telemetry
+
+    # digest_bits=4 keeps one resync pass to a few dozen RPCs, so it
+    # certifies BETWEEN armed-ledger overflows under the throttled churn
+    # (a ledger that overflows mid-resync correctly voids the attempt)
+    servers, client = _fleet(
+        tmp_path, shards=1, replicas=2,
+        gap_limit_postings=64, health_timeout=0.1, digest_bits=4,
+    )
+    expect: dict[int, int] = {}
+
+    def put(lo: int, n: int, doc: int):
+        keys = np.arange(lo, lo + n, dtype=np.uint64)
+        client.insert_batch(keys, np.full(n, doc, np.uint64))
+        expect.update({int(k): doc for k in keys.tolist()})
+
+    try:
+        put(0, 32, 0)
+        sh = client._shards[0]
+        overflow_before = telemetry.event_counter(
+            "astpu_fleet_gap_overflow_total"
+        ).value
+        # replica outage while the primary keeps acking
+        client._note_failure(sh, sh.nodes[1])
+        servers[1].stop()
+        put(1000, 48, 1)
+        put(2000, 48, 2)  # 48 + 48 past the 64-posting cap → dropped
+        assert 1 in sh.gap_overflow and not sh.gaps.get(1)
+        assert telemetry.event_counter(
+            "astpu_fleet_gap_overflow_total"
+        ).value > overflow_before
+
+        # node returns at the same logical slot over its surviving dir
+        revived = IndexShardServer(
+            str(tmp_path / "s0n1"), spaces=("bands", "urls"),
+            cut_postings=96, name="s0n1",
+        )
+        revived.server.port = 0
+        revived.start()
+        sh.nodes[1].address = ("127.0.0.1", revived.port)
+        sh.nodes[1].client.close()
+        sh.nodes[1].client = RpcClient(
+            sh.nodes[1].address, timeout=2.0, retries=1
+        )
+        time.sleep(client.health_timeout + 0.05)
+        # the PLAIN drain path must keep refusing an overflowed node —
+        # its dropped ledger means no drain can certify it
+        client._try_revive(sh)
+        assert not sh.nodes[1].alive, (
+            "an overflowed node must never rejoin by the plain drain path"
+        )
+
+        # resync with writes still flowing
+        stop = threading.Event()
+        churned: dict[int, int] = {}
+
+        def churn():
+            j = 0
+            while not stop.is_set():
+                keys = np.arange(
+                    50_000 + j * 40, 50_000 + j * 40 + 8, dtype=np.uint64
+                )
+                client.insert_batch(keys, np.full(8, 900 + j, np.uint64))
+                churned.update({int(k): 900 + j for k in keys.tolist()})
+                j += 1
+                time.sleep(0.05)  # paced so the armed ledger (cap 64)
+                #                   survives one full resync window
+
+        t = threading.Thread(target=churn)
+        t.start()
+        try:
+            deadline = time.monotonic() + 15
+            while not sh.nodes[1].alive and time.monotonic() < deadline:
+                client.checkpoint()  # the hot-path-safe resync site
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        expect.update(churned)
+        assert sh.nodes[1].alive, "resync never readmitted the node"
+        assert not sh.gap_overflow
+        assert client._m_resyncs.value >= 1
+        assert client._m_resync_postings.value > 0
+        # live-node invariant restored: the rejoined replica holds every
+        # acked posting (drain any tail, then compare semantic maps)
+        client.checkpoint()
+        client.repair_once()
+        m0 = _min_map(*servers[0].indexes["bands"].dump_postings())
+        m1 = _min_map(*revived.indexes["bands"].dump_postings())
+        assert m0 == expect, "primary lost acked postings"
+        assert m1 == expect, "rejoined replica is missing acked postings"
+        revived.stop()
+    finally:
+        client.close()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+
+
+def test_fleet_snapshot_wipe_restore_byte_identical(tmp_path):
+    """Disaster recovery: snapshot a live 2×2 fleet, tear it all down,
+    restore onto a FRESH fleet — replicas byte-identical, manifest
+    digests verified, probe answers equal to the original's."""
+    import sys
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    tools = os.path.join(REPO, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import fleet_snapshot
+
+    servers, client = _fleet(tmp_path, shards=2, replicas=2)
+    q = np.arange(0, 600, dtype=np.uint64).reshape(-1, 4)
+    try:
+        rng = np.random.default_rng(17)
+        for _ in range(5):
+            keys = rng.integers(0, 500, size=(16, 4)).astype(np.uint64)
+            ids = client.allocate_doc_ids(16)
+            client.check_and_add_batch(keys, ids)
+        before = np.asarray(client.probe_batch(q))
+        man = fleet_snapshot.snapshot_fleet(
+            client.spec, str(tmp_path / "snap"), spaces=("bands", "urls")
+        )
+        assert fleet_snapshot.verify_snapshot(str(tmp_path / "snap")) == []
+        assert len(man["shards"]) == 2
+        # the fence is observation, not mutation: answers unchanged
+        assert (np.asarray(client.probe_batch(q)) == before).all()
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+    # total loss: the original fleet is gone; restore onto fresh dirs
+    node_dirs = fleet_snapshot.restore_fleet(
+        str(tmp_path / "snap"), str(tmp_path / "restored"), replicas=2
+    )
+    assert len(node_dirs) == 4
+    # replicas of one shard are byte-identical after restore
+    for sid in range(2):
+        a = os.path.join(tmp_path, "restored", f"s{sid}n0", "bands")
+        b = os.path.join(tmp_path, "restored", f"s{sid}n1", "bands")
+        assert sorted(os.listdir(a)) == sorted(os.listdir(b))
+        for name in os.listdir(a):
+            ab = open(os.path.join(a, name), "rb").read()
+            bb = open(os.path.join(b, name), "rb").read()
+            assert ab == bb, f"replica divergence on restored {name}"
+    # every restored index verifies against its manifest digests
+    for nd in node_dirs:
+        idx = PersistentIndex(os.path.join(nd, "bands"), read_only=True)
+        try:
+            report = idx.scrub()
+            assert report["ok"], report
+            assert report["backfilled_digests"] == 0, (
+                "restored manifest must already carry every digest"
+            )
+        finally:
+            idx.close()
+
+    # a fresh fleet over the restored dirs answers exactly as before
+    servers2 = []
+    parts = []
+    for sid in range(2):
+        nodes = []
+        for rep in range(2):
+            srv = IndexShardServer(
+                os.path.join(tmp_path, "restored", f"s{sid}n{rep}"),
+                spaces=("bands", "urls"), cut_postings=96,
+                name=f"r{sid}n{rep}",
+            ).start()
+            servers2.append(srv)
+            nodes.append(f"127.0.0.1:{srv.port}")
+        parts.append("|".join(nodes))
+    client2 = ShardedIndexClient(
+        ";".join(parts), space="bands",
+        spill_dir=str(tmp_path / "spill2"), timeout=2.0, retries=1,
+    )
+    try:
+        assert (np.asarray(client2.probe_batch(q)) == before).all(), (
+            "restored fleet answers differently"
+        )
+    finally:
+        client2.close()
+        for s in servers2:
+            s.stop()

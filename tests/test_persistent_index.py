@@ -689,3 +689,274 @@ def test_bloom_predicted_fp_gauge_exported():
     finally:
         telemetry.set_enabled(None)
         telemetry.REGISTRY.reset()
+
+
+# -- integrity: v2 block CRCs, scrub, quarantine -----------------------------
+
+
+def _flip_bit(path: str, byte_off: int, bit: int = 0) -> None:
+    """Silent in-place bit rot at ``byte_off`` — the medium lied, no
+    error, no size change."""
+    with open(path, "r+b") as fh:
+        fh.seek(byte_off)
+        b = fh.read(1)[0]
+        fh.seek(byte_off)
+        fh.write(bytes([b ^ (1 << bit)]))
+
+
+def test_segment_v1_transparent_read_parity(tmp_path):
+    """A pre-v2 (CRC-less) segment stays transparently readable: probe
+    answers byte-equal to the v2 twin over the same postings, and the
+    scrub-path ``verify_all`` still returns its whole-file digest (it
+    just has no block CRCs to check)."""
+    from advanced_scrapper_tpu.index.segment import file_digest
+
+    rng = np.random.RandomState(3)
+    keys = rng.randint(0, 1 << 40, size=400).astype(np.uint64)
+    docs = np.arange(400, dtype=np.uint64)
+    p1 = str(tmp_path / "seg-v1.seg")
+    p2 = str(tmp_path / "seg-v2.seg")
+    d1 = write_segment(p1, keys, docs, seed=1, version=1)
+    d2 = write_segment(p2, keys, docs, seed=1)
+    s1, s2 = Segment(p1), Segment(p2)
+    assert (s1.version, s2.version) == (1, 2)
+    q = np.concatenate(
+        [keys[:64], rng.randint(0, 1 << 40, size=64).astype(np.uint64)]
+    )
+    r1, h1 = s1.probe(q)
+    r2, h2 = s2.probe(q)
+    assert (r1 == r2).all() and (h1 == h2).all()
+    assert s1.verify_all() == d1 == file_digest(p1)
+    assert s2.verify_all() == d2 == file_digest(p2)
+
+
+def test_segment_block_crc_detects_probe_path_rot(tmp_path):
+    """v2 lazy verification: a flipped bit in a posting block raises
+    SegmentCorruption on the FIRST probe that touches the block — the
+    corrupt bytes never flow into an attribution."""
+    from advanced_scrapper_tpu.index.segment import (
+        HEADER_LEN,
+        SegmentCorruption,
+    )
+
+    keys = np.arange(1000, 2000, dtype=np.uint64)
+    docs = np.arange(1000, dtype=np.uint64)
+    path = str(tmp_path / "seg-1.seg")
+    write_segment(path, keys, docs, seed=2, block_bytes=256)
+    seg = Segment(path)
+    # rot a key in the block holding row 500 (keys plane, 8 B/row)
+    _flip_bit(path, HEADER_LEN + seg.bloom.memory_bytes + 8 * 500, bit=3)
+    # a probe that never touches the rotted block still answers
+    rows, hit = seg.probe(np.array([1001], np.uint64))
+    assert hit.tolist() == [1]
+    with pytest.raises(SegmentCorruption):
+        seg.probe(np.array([1500], np.uint64))
+
+
+def test_segment_rotted_key_never_reads_as_never_posted(tmp_path):
+    """The nastier rot: the flipped bit moves a STORED key out of its
+    sort position, so the probe's equal-range scan finds nothing — an
+    honest-looking miss.  The bloom-positive-miss path must verify the
+    landing block and raise instead of answering 'fresh'."""
+    from advanced_scrapper_tpu.index.segment import (
+        HEADER_LEN,
+        SegmentCorruption,
+    )
+
+    keys = np.arange(5000, 5256, dtype=np.uint64)
+    docs = np.arange(256, dtype=np.uint64)
+    path = str(tmp_path / "seg-1.seg")
+    write_segment(path, keys, docs, seed=4, block_bytes=256)
+    seg = Segment(path)
+    # flip a HIGH bit of key row 40: 5040 jumps far out of sort order
+    _flip_bit(path, HEADER_LEN + seg.bloom.memory_bytes + 8 * 40 + 4, bit=7)
+    with pytest.raises(SegmentCorruption):
+        seg.probe(np.array([5040], np.uint64))
+
+
+def test_store_probe_quarantines_rotted_segment(tmp_path):
+    """Bit rot surfacing on the store's probe path: the poisoned segment
+    is quarantined (sidecar + manifest shrink + counter) and the probe
+    answers WITHOUT it — withdrawn postings, never wrong ones."""
+    from advanced_scrapper_tpu.index.segment import HEADER_LEN
+    from advanced_scrapper_tpu.obs import telemetry
+
+    d = str(tmp_path / "ix")
+    idx = PersistentIndex(d, cut_postings=8, compact_segments=0)
+    idx.insert_batch(
+        np.arange(100, 116, dtype=np.uint64), np.arange(16, dtype=np.uint64)
+    )
+    assert len(idx._segments) >= 1
+    seg = idx._segments[0]
+    name = os.path.basename(seg.path)
+    before = telemetry.event_counter(
+        "astpu_quarantine_total", kind="segment"
+    ).value
+    _flip_bit(seg.path, HEADER_LEN + seg.bloom.memory_bytes + 8 * 4, bit=5)
+    got = idx.probe_batch(np.array([104], np.uint64))
+    assert int(got[0]) == -1, "withdrawn, not wrong"
+    assert os.path.exists(os.path.join(d, name + ".quarantine"))
+    assert not os.path.exists(os.path.join(d, name))
+    assert telemetry.event_counter(
+        "astpu_quarantine_total", kind="segment"
+    ).value > before
+    # the shrunken manifest is committed: a reopen serves without drama
+    idx.close()
+    idx2 = PersistentIndex(d)
+    assert all(os.path.basename(s.path) != name for s in idx2._segments)
+    idx2.close()
+
+
+def test_scrub_detects_quarantines_and_backfills(tmp_path):
+    """``scrub()`` is the eager end-to-end pass: every block CRC plus the
+    manifest whole-file digest.  A rotted segment is quarantined and
+    reported; a pre-digest manifest entry gets its digest backfilled."""
+    import json
+
+    d = str(tmp_path / "ix")
+    idx = PersistentIndex(d, cut_postings=8, compact_segments=0)
+    for i in range(3):
+        idx.insert_batch(
+            np.arange(i * 50, i * 50 + 16, dtype=np.uint64),
+            np.full(16, i, np.uint64),
+        )
+    assert len(idx._segments) >= 2
+    report = idx.scrub()
+    assert report["ok"] and not report["corrupt"]
+
+    # drop one digest record (a pre-v2 manifest) → scrub backfills it
+    victim = os.path.basename(idx._segments[0].path)
+    rotted = idx._segments[1].path
+    idx._digests.pop(victim)
+    # rot the LAST byte of another segment's docs/table region
+    _flip_bit(rotted, os.path.getsize(rotted) - 1, bit=1)
+    report = idx.scrub()
+    assert not report["ok"]
+    assert report["backfilled_digests"] == 1
+    assert [c["segment"] for c in report["corrupt"]] == [
+        os.path.basename(rotted)
+    ]
+    assert os.path.exists(rotted + ".quarantine")
+    with open(os.path.join(d, "manifest.json")) as fh:
+        man = json.load(fh)
+    assert victim in man["digests"], "backfilled digest must be committed"
+    assert os.path.basename(rotted) not in man["segments"]
+    idx.close()
+
+
+def test_torn_segment_open_quarantined_not_fatal(tmp_path):
+    """Satellite fix: a segment whose HEADER fails its CRC at open no
+    longer crashes the whole index open — it is quarantined and the
+    index continues on the surviving manifest."""
+    d = str(tmp_path / "ix")
+    idx = PersistentIndex(d, cut_postings=8, compact_segments=0)
+    idx.insert_batch(
+        np.arange(0, 16, dtype=np.uint64), np.zeros(16, np.uint64)
+    )
+    idx.insert_batch(
+        np.arange(50, 66, dtype=np.uint64), np.ones(16, np.uint64)
+    )
+    assert len(idx._segments) == 2
+    bad = idx._segments[0].path
+    good_keys = np.arange(50, 66, dtype=np.uint64)
+    idx.close()
+    _flip_bit(bad, 20, bit=2)  # inside the 64-byte header
+
+    idx2 = PersistentIndex(d)  # must NOT raise
+    assert len(idx2._segments) == 1
+    assert os.path.exists(bad + ".quarantine")
+    assert (np.asarray(idx2.probe_batch(good_keys)) == 1).all(), (
+        "surviving segment must still serve"
+    )
+    idx2.close()
+    # quarantine was committed: the next open is clean (nothing left to
+    # re-quarantine, no sidecar churn)
+    idx3 = PersistentIndex(d)
+    assert len(idx3._segments) == 1
+    idx3.close()
+
+
+def test_env_scrub_at_open_quarantines_silent_rot(tmp_path, monkeypatch):
+    """``ASTPU_INDEX_SCRUB=1``: rot planted in a cold directory (docs
+    plane — the probe path would only find it lazily, maybe never) is
+    caught AT OPEN and quarantined before any probe can be answered."""
+    from advanced_scrapper_tpu.index.segment import HEADER_LEN
+
+    d = str(tmp_path / "ix")
+    idx = PersistentIndex(d, cut_postings=8, compact_segments=0)
+    idx.insert_batch(
+        np.arange(0, 16, dtype=np.uint64), np.arange(16, dtype=np.uint64)
+    )
+    seg_path = idx._segments[0].path
+    bloom_b = idx._segments[0].bloom.memory_bytes
+    count = idx._segments[0].count
+    idx.close()
+    # rot a DOC id: digest+CRC change, key order does not
+    _flip_bit(seg_path, HEADER_LEN + bloom_b + 8 * count + 8 * 3, bit=0)
+
+    monkeypatch.setenv("ASTPU_INDEX_SCRUB", "1")
+    idx2 = PersistentIndex(d)
+    assert os.path.exists(seg_path + ".quarantine")
+    assert not idx2._segments
+    idx2.close()
+
+
+def test_segment_downward_rot_at_block_boundary_detected(tmp_path):
+    """Regression: a key rotted DOWNWARD in the LAST row of a CRC block
+    makes the probe's binary search land in the NEXT block — verifying
+    only the landing block would miss the rot and answer 'never
+    posted'.  The miss path must verify the preceding row's block too."""
+    from advanced_scrapper_tpu.index.segment import (
+        HEADER_LEN,
+        SegmentCorruption,
+    )
+
+    keys = np.arange(1000, 2000, dtype=np.uint64)
+    docs = np.arange(1000, dtype=np.uint64)
+    path = str(tmp_path / "seg-1.seg")
+    write_segment(path, keys, docs, seed=7, block_bytes=256)  # 32 rows/block
+    seg = Segment(path)
+    # row 63 = last row of block 1; clear the second little-endian byte
+    # → 1063 becomes 39, far below its sorted position
+    off = HEADER_LEN + seg.bloom.memory_bytes + 8 * 63 + 1
+    with open(path, "r+b") as fh:
+        fh.seek(off)
+        b = fh.read(1)[0]
+        assert b != 0
+        fh.seek(off)
+        fh.write(b"\x00")
+    with pytest.raises(SegmentCorruption):
+        seg.probe(np.array([1063], np.uint64))
+
+
+def test_scrub_skips_segment_swept_by_racing_compaction(tmp_path):
+    """A segment file unlinked between scrub's snapshot and its
+    verify_all (a racing compaction superseding it) is a stale snapshot
+    row, not corruption — scrub continues, nothing quarantined."""
+    d = str(tmp_path / "ix")
+    idx = PersistentIndex(d, cut_postings=8, compact_segments=0)
+    for i in range(2):
+        idx.insert_batch(
+            np.arange(i * 30, i * 30 + 16, dtype=np.uint64),
+            np.full(16, i, np.uint64),
+        )
+    assert len(idx._segments) == 2
+    # interleave the race inside the pass: when scrub reaches the
+    # victim, the compaction swap has already landed (file unlinked,
+    # segment out of the live set) — its verify hook performs the swap
+    # first, then runs the real verification against the vanished file
+    victim = idx._segments[0]
+    survivors = [s for s in idx._segments if s is not victim]
+    real_verify = victim.verify_all
+
+    def raced_verify(fs=None):
+        idx._segments = list(survivors)
+        os.unlink(victim.path)
+        return real_verify(fs=fs)
+
+    victim.verify_all = raced_verify
+    report = idx.scrub()
+    assert report["ok"], report
+    assert report["corrupt"] == []
+    assert not os.path.exists(victim.path + ".quarantine")
+    idx.close()

@@ -737,3 +737,134 @@ def test_crashsweep_overload_workload_registered():
 
     battery = inspect.getsource(crashsweep.main)
     assert "sweep_overload(" in battery
+
+
+def test_fsck_index_clean_then_corrupt(tmp_path, capsys):
+    """The offline verifier: a healthy index directory reports clean
+    (exit 0); one silently flipped bit anywhere turns into a nonzero
+    exit with a per-file problem naming the segment."""
+    import numpy as np
+
+    import fsck_index
+    from advanced_scrapper_tpu.index import PersistentIndex
+
+    d = str(tmp_path / "ix")
+    idx = PersistentIndex(d, cut_postings=24, compact_segments=0)
+    for i in range(3):
+        idx.insert_batch(
+            np.arange(i * 40, i * 40 + 16, dtype=np.uint64),
+            np.full(16, i, np.uint64),
+        )
+    idx.close()
+
+    assert fsck_index.main([d]) == 0
+    out = capsys.readouterr().out
+    assert "clean" in out
+
+    # rot one bit of one segment; fsck must name the file, exit nonzero
+    report = fsck_index.fsck([d])
+    assert report["ok"]
+    seg = next(
+        n for n in sorted(os.listdir(d)) if n.endswith(".seg")
+    )
+    path = os.path.join(d, seg)
+    with open(path, "r+b") as fh:
+        fh.seek(os.path.getsize(path) // 2)
+        b = fh.read(1)[0]
+        fh.seek(os.path.getsize(path) // 2)
+        fh.write(bytes([b ^ 0x04]))
+    assert fsck_index.main([d]) == 1
+    out = capsys.readouterr().out
+    assert "CORRUPT" in out and seg in out
+    report = fsck_index.fsck([d])
+    assert not report["ok"]
+    assert any(seg in p for p in report["problems"])
+    # read-only by construction: fsck never quarantined or repaired
+    assert os.path.exists(path) and not os.path.exists(path + ".quarantine")
+
+
+def test_fsck_index_walks_ancestors_and_notes_torn_wal(tmp_path, capsys):
+    """A DIR argument may be an ancestor: every manifest.json below is
+    checked; a torn WAL tail is a NOTE (normal crash artifact), never a
+    problem."""
+    import numpy as np
+
+    import fsck_index
+    from advanced_scrapper_tpu.index import PersistentIndex
+
+    for sub in ("a", "b"):
+        idx = PersistentIndex(str(tmp_path / "fleet" / sub), cut_postings=8)
+        idx.insert_batch(
+            np.arange(8, dtype=np.uint64), np.zeros(8, np.uint64)
+        )
+        idx.insert_batch(
+            np.arange(20, 24, dtype=np.uint64), np.ones(4, np.uint64)
+        )
+        idx.close()
+    # tear the live WAL tail of one index (crash artifact)
+    wal = next(
+        n for n in os.listdir(tmp_path / "fleet" / "a")
+        if n.startswith("wal-")
+    )
+    with open(tmp_path / "fleet" / "a" / wal, "ab") as fh:
+        fh.write(b"torn-garbage")
+    report = fsck_index.fsck([str(tmp_path / "fleet")])
+    assert report["ok"], report["problems"]
+    assert len(report["dirs"]) == 2
+    notes = [n for r in report["dirs"] for n in r["notes"]]
+    assert any("torn tail" in n for n in notes)
+
+
+def test_fleet_snapshot_verify_cli_refuses_uncommitted(tmp_path, capsys):
+    """A snapshot directory without its MANIFEST.json commit mark is
+    garbage by definition — verify must say so, nonzero."""
+    import fleet_snapshot
+
+    snap = tmp_path / "snap"
+    snap.mkdir()
+    assert fleet_snapshot.main(["verify", "--snapshot", str(snap)]) == 1
+    err = capsys.readouterr().err
+    assert "never committed" in err
+
+
+def test_lint_metrics_covers_selfhealing_series():
+    """The naming linter sees every new scrub/repair/resync series and
+    they conform — one owner each, suffix rules green."""
+    import lint_metrics
+
+    seen: dict[str, set] = {}
+    pkg = os.path.join(REPO, "advanced_scrapper_tpu")
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if fn.endswith(".py"):
+                _problems, regs = lint_metrics.check_file(
+                    os.path.join(dirpath, fn)
+                )
+                for name, _kind, _ln in regs:
+                    seen.setdefault(name, set()).add(fn)
+    for name, owner in (
+        ("astpu_scrub_runs_total", "store.py"),
+        ("astpu_scrub_seconds", "store.py"),
+        ("astpu_scrub_corrupt_segments_total", "store.py"),
+        ("astpu_fleet_resync_total", "fleet.py"),
+        ("astpu_fleet_resync_postings_total", "fleet.py"),
+        ("astpu_repair_rounds_total", "fleet.py"),
+        ("astpu_repair_ranges_total", "fleet.py"),
+        ("astpu_repair_postings_total", "fleet.py"),
+    ):
+        assert name in seen, f"{name} never registered"
+        assert seen[name] == {owner}, (name, seen[name])
+    assert not lint_metrics.lint(), "naming lint must stay clean"
+
+
+def test_crashsweep_bitrot_workload_registered():
+    """Bitrot is a first-class crashsweep workload: child + verifier
+    registered, and the default battery actually schedules it."""
+    import inspect
+
+    import crashsweep
+
+    assert "bitrot" in crashsweep.CHILDREN
+    assert "bitrot" in crashsweep.VERIFIERS
+    battery = inspect.getsource(crashsweep.main)
+    assert "sweep_bitrot(" in battery

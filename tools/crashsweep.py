@@ -941,6 +941,146 @@ def child_overload(case_dir: str, seed: int) -> int:
                 p.kill()
 
 
+def child_bitrot(case_dir: str, seed: int) -> int:
+    """Silent-corruption healing loop on a live fleet.
+
+    Mid-stream, one seeded bit of a REPLICA's on-disk segment is flipped
+    in place — the medium lied: no error, no short write, no crash.  The
+    ``scrub`` RPC must then detect the rot (block CRCs + whole-file
+    digest), quarantine the poisoned segment server-side, and ONE
+    anti-entropy repair pass must heal the withdrawn postings back from
+    the healthy peer — with the stream's dedup annotations staying
+    byte-equal to the uncorrupted single-node oracle throughout.  The
+    fleet corpus/oracle are reused verbatim: bitrot must be invisible in
+    the data plane, so the truth it is checked against is unchanged."""
+    os.environ["ASTPU_TELEMETRY"] = "1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    from advanced_scrapper_tpu.index.fleet import FleetSpec, ShardedIndexClient
+    from advanced_scrapper_tpu.index.remote import RemoteIndex
+
+    rng = random.Random(f"bitrot-child|{seed}")
+    rot_shard = rng.randrange(FLEET_SHARDS)
+    n_batches = (FLEET_DOCS + FLEET_BATCH - 1) // FLEET_BATCH
+    # late enough that the replica holds real segments, early enough that
+    # post-heal batches keep probing the healed ranges
+    rot_batch = rng.randrange(3, n_batches - 1)
+
+    port_list = _fleet_pick_ports(FLEET_SHARDS * FLEET_REPLICAS)
+    ports = {
+        (sid, rep): port_list[sid * FLEET_REPLICAS + rep]
+        for sid in range(FLEET_SHARDS)
+        for rep in range(FLEET_REPLICAS)
+    }
+    procs: dict[tuple[int, int], subprocess.Popen] = {}
+    try:
+        for sid in range(FLEET_SHARDS):
+            for rep in range(FLEET_REPLICAS):
+                procs[(sid, rep)] = _fleet_spawn_server(
+                    case_dir, sid, rep, None, ports[(sid, rep)]
+                )
+        spec = FleetSpec(
+            shards=tuple(
+                tuple(
+                    ("127.0.0.1", ports[(sid, rep)])
+                    for rep in range(FLEET_REPLICAS)
+                )
+                for sid in range(FLEET_SHARDS)
+            )
+        )
+        client = ShardedIndexClient(
+            spec,
+            space="bands",
+            spill_dir=os.path.join(case_dir, "spill"),
+            timeout=2.0,
+            retries=1,
+            health_checks=2,
+            health_timeout=0.3,
+        )
+        _touch_marker(case_dir)
+        ann: list[int] = []
+        rot_extra: dict = {}
+        for b in range(n_batches):
+            if b == rot_batch:
+                # the plant/detect/heal critical section sits BETWEEN
+                # batches: no probe may run between the flip and the
+                # repair, or a lazily-detected block would answer
+                # "withdrawn" where the oracle answers "posted"
+                remote = RemoteIndex(
+                    ("127.0.0.1", ports[(rot_shard, 1)]),
+                    space="bands", timeout=2.0, retries=1,
+                )
+                try:
+                    # snapshot fence = a guaranteed cut, so the replica
+                    # holds at least one immutable segment to rot
+                    meta = remote.snapshot_meta()
+                    segs = sorted(
+                        f["name"] for f in meta["files"]
+                        if f["name"].endswith(".seg")
+                    )
+                    if not segs:
+                        raise RuntimeError("no live segment to corrupt")
+                    victim = rng.choice(segs)
+                    vpath = os.path.join(
+                        case_dir, f"s{rot_shard}n1", "bands", victim
+                    )
+                    bit = rng.randrange(os.path.getsize(vpath) * 8)
+                    with open(vpath, "r+b") as fh:
+                        fh.seek(bit // 8)
+                        byte = fh.read(1)[0]
+                        fh.seek(bit // 8)
+                        fh.write(bytes([byte ^ (1 << (bit % 8))]))
+                    scrub_report = remote.scrub()["bands"]
+                finally:
+                    remote.close()
+                heal = {"pushed": 0, "rounds": 0}
+                for _ in range(3):
+                    stats = client.repair_once()
+                    heal["pushed"] += stats["pushed"]
+                    heal["rounds"] += 1
+                    if not stats["unmatched"]:
+                        break
+                rot_extra = {
+                    "rot_shard": rot_shard,
+                    "rot_batch": rot_batch,
+                    "victim": victim,
+                    "flipped_bit": bit,
+                    "scrub_corrupt": scrub_report["corrupt"],
+                    "repair": heal,
+                }
+            rows = range(
+                b * FLEET_BATCH, min((b + 1) * FLEET_BATCH, FLEET_DOCS)
+            )
+            keys = np.stack([_fleet_doc_keys(i) for i in rows])
+            ids = client.allocate_doc_ids(len(keys))
+            ann += np.asarray(client.check_and_add_batch(keys, ids)).tolist()
+        client.checkpoint()
+        report = {
+            "annotations": ann,
+            "repair_rounds": float(client._m_repair_rounds.value),
+            "repair_postings": float(client._m_repair_postings.value),
+            **rot_extra,
+        }
+        client.close()
+        from advanced_scrapper_tpu.storage.fsio import atomic_replace
+
+        atomic_replace(
+            os.path.join(case_dir, "bitrot_report.json"),
+            json.dumps(report).encode(),
+        )
+        return 0
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 CHILDREN = {
     "harvest": child_harvest,
     "scrape": child_scrape,
@@ -949,6 +1089,7 @@ CHILDREN = {
     "fleet": child_fleet,
     "overload": child_overload,
     "graph": child_graph,
+    "bitrot": child_bitrot,
 }
 
 
@@ -1112,43 +1253,18 @@ def verify_pindex(case_dir: str) -> list[str]:
     return problems
 
 
-def verify_fleet(case_dir: str) -> list[str]:
-    """Fleet convergence against the single-node oracle:
-
-    - the child's dedup annotations are BYTE-identical to the oracle's;
-    - per shard, the union of its node indexes holds exactly the oracle's
-      posting keys for that shard's ring slice, with identical min doc
-      ids — zero lost, zero duplicated (each node checked individually
-      for duplicate keys: a duplicate is a double-applied retry);
-    - the SIGKILLed primary's directory — frozen at its kill point —
-      still opens read-only (manifest whole-or-previous, WAL torn tail
-      dropped);
-    - the spill journal fully replayed (``spill_pending == 0``) and the
-      mode's failover/promotion/spill counters actually moved.
-    """
+def _check_shard_postings(case_dir: str, oracle_minmap: dict) -> list[str]:
+    """Per shard, the union of its node indexes must hold exactly the
+    oracle's posting keys for that shard's ring slice with identical min
+    doc ids — zero lost, zero duplicated (each node also checked
+    individually for duplicate keys: a duplicate is a double-applied
+    retry).  Shared by the fleet and bitrot verifiers."""
     import numpy as np
 
     from advanced_scrapper_tpu.index import PersistentIndex
     from advanced_scrapper_tpu.index.fleet import ring_assign
 
     problems: list[str] = []
-    report_path = os.path.join(case_dir, "fleet_report.json")
-    if not os.path.exists(report_path):
-        return ["fleet child never wrote its report (ingest died)"]
-    with open(report_path) as f:
-        report = json.load(f)
-
-    oracle_ann, oracle_minmap = fleet_oracle_annotations()
-    if report["annotations"] != oracle_ann:
-        diff = [
-            i for i, (a, b) in enumerate(zip(report["annotations"], oracle_ann))
-            if a != b
-        ]
-        problems.append(
-            f"annotations diverge from the single-node oracle at docs "
-            f"{diff[:5]} (of {len(diff)})"
-        )
-
     all_keys = np.array(sorted(oracle_minmap), dtype=np.uint64)
     shard_of = ring_assign(all_keys, FLEET_SHARDS)
     for sid in range(FLEET_SHARDS):
@@ -1191,6 +1307,42 @@ def verify_fleet(case_dir: str) -> list[str]:
                 f"shard {sid} postings lost/invented: missing={len(missing)} "
                 f"extra={len(extra)} wrong_doc={len(wrong)}"
             )
+    return problems
+
+
+def verify_fleet(case_dir: str) -> list[str]:
+    """Fleet convergence against the single-node oracle:
+
+    - the child's dedup annotations are BYTE-identical to the oracle's;
+    - per shard, the union of its node indexes holds exactly the oracle's
+      posting keys for that shard's ring slice, with identical min doc
+      ids — zero lost, zero duplicated (each node checked individually
+      for duplicate keys: a duplicate is a double-applied retry);
+    - the SIGKILLed primary's directory — frozen at its kill point —
+      still opens read-only (manifest whole-or-previous, WAL torn tail
+      dropped);
+    - the spill journal fully replayed (``spill_pending == 0``) and the
+      mode's failover/promotion/spill counters actually moved.
+    """
+    problems: list[str] = []
+    report_path = os.path.join(case_dir, "fleet_report.json")
+    if not os.path.exists(report_path):
+        return ["fleet child never wrote its report (ingest died)"]
+    with open(report_path) as f:
+        report = json.load(f)
+
+    oracle_ann, oracle_minmap = fleet_oracle_annotations()
+    if report["annotations"] != oracle_ann:
+        diff = [
+            i for i, (a, b) in enumerate(zip(report["annotations"], oracle_ann))
+            if a != b
+        ]
+        problems.append(
+            f"annotations diverge from the single-node oracle at docs "
+            f"{diff[:5]} (of {len(diff)})"
+        )
+
+    problems += _check_shard_postings(case_dir, oracle_minmap)
 
     if report.get("spill_pending"):
         problems.append(
@@ -1294,6 +1446,65 @@ def verify_overload(case_dir: str) -> list[str]:
     return problems
 
 
+def verify_bitrot(case_dir: str) -> list[str]:
+    """Bitrot acceptance: the planted flip was DETECTED by scrub (never
+    served), the poisoned segment was quarantined (sidecar evidence on
+    the corrupted node), repair healed the withdrawn postings from the
+    healthy peer (per-shard unions equal the oracle), annotations stayed
+    byte-equal to the uncorrupted single-node oracle, and the offline
+    fsck reports every node directory clean afterwards."""
+    problems: list[str] = []
+    report_path = os.path.join(case_dir, "bitrot_report.json")
+    if not os.path.exists(report_path):
+        return ["bitrot child never wrote its report (ingest died)"]
+    with open(report_path) as f:
+        report = json.load(f)
+
+    oracle_ann, oracle_minmap = fleet_oracle_annotations()
+    if report["annotations"] != oracle_ann:
+        diff = [
+            i for i, (a, b) in enumerate(zip(report["annotations"], oracle_ann))
+            if a != b
+        ]
+        problems.append(
+            f"annotations diverge from the uncorrupted oracle at docs "
+            f"{diff[:5]} (of {len(diff)}) — the flipped bit leaked into "
+            "the data plane"
+        )
+    if not report.get("scrub_corrupt"):
+        problems.append(
+            f"scrub never detected the planted flip in {report.get('victim')}"
+        )
+    if not report.get("repair", {}).get("pushed"):
+        problems.append(
+            "repair pushed nothing — the quarantined postings were never "
+            "healed from the healthy peer"
+        )
+    rot_dir = os.path.join(case_dir, f"s{report.get('rot_shard', 0)}n1", "bands")
+    if os.path.isdir(rot_dir) and not any(
+        n.endswith(".quarantine") for n in os.listdir(rot_dir)
+    ):
+        problems.append(
+            "no .quarantine sidecar on the corrupted node — the poisoned "
+            "segment was dropped without preserving the evidence"
+        )
+    problems += _check_shard_postings(case_dir, oracle_minmap)
+
+    # the offline twin gets the last word: every node dir verifies clean
+    import fsck_index
+
+    node_dirs = [
+        os.path.join(case_dir, f"s{sid}n{rep}")
+        for sid in range(FLEET_SHARDS)
+        for rep in range(FLEET_REPLICAS)
+        if os.path.isdir(os.path.join(case_dir, f"s{sid}n{rep}"))
+    ]
+    fsck_report = fsck_index.fsck(node_dirs)
+    if not fsck_report["ok"]:
+        problems += [f"fsck: {p}" for p in fsck_report["problems"]]
+    return problems
+
+
 def check_graph_safety(case_dir: str) -> list[str]:
     """Kill-point invariants for the stage-graph workload: the annotations
     CSV parses (torn tails are the reader's repair problem, never a loss),
@@ -1368,6 +1579,7 @@ VERIFIERS = {
     "fleet": verify_fleet,
     "overload": verify_overload,
     "graph": verify_graph,
+    "bitrot": verify_bitrot,
 }
 
 #: chaos specs that land the pindex kill-points INSIDE each durability
@@ -1638,6 +1850,53 @@ def sweep_fleet(base_dir: str, *, kills: int, seed: int = 0) -> dict:
     }
 
 
+def sweep_bitrot(base_dir: str, *, kills: int, seed: int = 0) -> dict:
+    """Seeded bitrot sweep: each case streams the fleet corpus with a
+    seeded mid-stream silent bit flip planted in a replica's segment,
+    then verifies the detect→quarantine→heal→byte-equality contract plus
+    a clean offline fsck.  A 'kill landed' = the scrub actually caught
+    the planted flip (every case plants one)."""
+    cases = []
+    for i in range(kills):
+        case_seed = seed * 1000 + i
+        case_dir = os.path.join(base_dir, f"bitrot-k{i}")
+        os.makedirs(case_dir, exist_ok=True)
+        rec: dict = {"workload": "bitrot", "seed": case_seed}
+        proc = _spawn("bitrot", case_dir, case_seed, None)
+        try:
+            proc.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            rec["problems"] = ["bitrot child hung past 240 s"]
+            cases.append(rec)
+            continue
+        problems = []
+        if proc.returncode != 0:
+            problems.append(f"bitrot child exited {proc.returncode}")
+        problems += verify_bitrot(case_dir)
+        report_path = os.path.join(case_dir, "bitrot_report.json")
+        detected = False
+        if os.path.exists(report_path):
+            with open(report_path) as f:
+                r = json.load(f)
+            detected = bool(r.get("scrub_corrupt"))
+            rec["counters"] = {
+                "victim": r.get("victim"),
+                "scrub_corrupt": len(r.get("scrub_corrupt", [])),
+                "repair_pushed": r.get("repair", {}).get("pushed"),
+            }
+        rec["killed"] = detected
+        rec["problems"] = problems
+        cases.append(rec)
+    return {
+        "workload": "bitrot",
+        "cases": cases,
+        "kills": sum(1 for c in cases if c.get("killed")),
+        "problems": [p for c in cases for p in c.get("problems", [])],
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--child", choices=sorted(CHILDREN), default=None)
@@ -1653,7 +1912,7 @@ def main(argv=None) -> int:
     import tempfile
 
     base = args.dir or tempfile.mkdtemp(prefix="crashsweep-")
-    per = max(1, args.kills // 7)
+    per = max(1, args.kills // 8)
     report = {
         "seed": args.seed,
         "workloads": [
@@ -1673,6 +1932,7 @@ def main(argv=None) -> int:
             ),
             sweep_fleet(base, kills=per, seed=args.seed),
             sweep_overload(base, kills=per, seed=args.seed),
+            sweep_bitrot(base, kills=per, seed=args.seed),
             sweep_workload(
                 "graph",
                 base,
@@ -1683,10 +1943,10 @@ def main(argv=None) -> int:
             sweep_workload(
                 "stream",
                 base,
-                # the remainder: six workloads above each land exactly
+                # the remainder: seven workloads above each land exactly
                 # `per` instants, stream takes what's left of --kills
                 # (its one chaos case included)
-                sigkills=max(1, args.kills - 6 * per - 1),
+                sigkills=max(1, args.kills - 7 * per - 1),
                 chaos_kills=1,
                 seed=args.seed,
                 kill_window=(0.05, 1.2),

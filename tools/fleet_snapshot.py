@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Fleet-wide consistent snapshot + byte-identical restore.
+
+The disaster-recovery half of the self-healing plane: scrub/repair heal a
+fleet that is still standing; this tool is for the fleet that is NOT —
+region loss, bulk operator error, a migration.  It speaks the shard RPC
+plane, so it works against a LIVE fleet:
+
+**snapshot** — per shard, per space, the ``snapshot`` RPC fences the WAL
+(the shard cuts its memtable under the write lock, so the durable state
+collapses to manifest + immutable segments) and returns the pinned
+manifest plus every live file's size and whole-file digest.  Files stream
+back through paged ``fetch_file`` frames (immutable, so pages always
+compose) and every byte is digest-verified on arrival; a file swept by a
+racing compaction fails its digest and the shard is re-fenced (bounded
+retries).  The last write is the **manifest of manifests**
+(``MANIFEST.json``, atomic) — a snapshot directory without it is garbage
+by definition, so a killed snapshot can never masquerade as a whole one.
+
+**restore** — materialises the snapshot onto fresh per-node index
+directories (every replica of a shard gets identical bytes — replicas ARE
+byte-identical after restore), re-verifying every digest after the copy.
+Start shard servers on the restored directories and the fleet answers
+probes exactly as the snapshotted one did.
+
+**verify** — offline digest sweep of a snapshot directory.
+
+Usage::
+
+    python tools/fleet_snapshot.py snapshot --fleet "h:p|h:p;h:p" --out SNAP
+    python tools/fleet_snapshot.py restore  --snapshot SNAP --out BASE [--replicas 2]
+    python tools/fleet_snapshot.py verify   --snapshot SNAP
+
+``--fleet`` uses the ``DedupConfig.index_fleet`` wire syntax; the primary
+(first replica) of each shard is snapshotted — by the live-node invariant
+any live node holds every acked posting, and a quiesced fleet's replicas
+are semantically identical.  Snapshot consistency across SHARDS assumes a
+quiesced ingest (fence order is per-shard); for a moving fleet, pause the
+writers for the fence beat — the fence itself is one cut per shard.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+SNAP_MANIFEST = "MANIFEST.json"
+DEFAULT_SPACES = ("bands", "urls")
+FETCH_PAGE = 4 << 20  # 4 MiB per fetch_file frame — far under the RPC cap
+
+
+def _digest(data: bytes) -> str:
+    return hashlib.blake2b(data, digest_size=16).hexdigest()
+
+
+def _file_digest(path: str) -> str:
+    """Chunked on-disk digest — the ONE identity definition, shared with
+    the manifest recorder (``index.segment.file_digest``); multi-GB
+    segments never round-trip through RAM here."""
+    from advanced_scrapper_tpu.index.segment import file_digest
+
+    return file_digest(path)
+
+
+def _copy_verified(src: str, dst: str, want: str) -> None:
+    """Stream ``src`` → ``dst`` atomically (1 MiB chunks), then re-verify
+    the landed bytes against ``want``."""
+    from advanced_scrapper_tpu.storage.fsio import atomic_write
+
+    def writer(fh):
+        with open(src, "rb") as sf:
+            while True:
+                chunk = sf.read(1 << 20)
+                if not chunk:
+                    break
+                fh.write(chunk)
+
+    atomic_write(dst, writer)
+    if _file_digest(dst) != want:
+        raise RuntimeError(f"{dst}: digest mismatch after copy")
+
+
+def snapshot_fleet(
+    fleet: str,
+    out_dir: str,
+    *,
+    spaces=DEFAULT_SPACES,
+    timeout: float = 10.0,
+    retries: int = 2,
+    fence_retries: int = 3,
+) -> dict:
+    """Pull a consistent snapshot of every shard into ``out_dir``;
+    returns the manifest-of-manifests dict (also written atomically as
+    ``MANIFEST.json``, the commit point)."""
+    from advanced_scrapper_tpu.index.fleet import FleetSpec
+    from advanced_scrapper_tpu.index.remote import RemoteIndex
+    from advanced_scrapper_tpu.storage.fsio import atomic_replace, atomic_write
+
+    spec = fleet if isinstance(fleet, FleetSpec) else FleetSpec.parse(fleet)
+    os.makedirs(out_dir, exist_ok=True)
+    manifest: dict = {"version": 1, "spaces": list(spaces), "shards": []}
+    for sid, nodes in enumerate(spec.shards):
+        shard_entry: dict = {"shard": sid, "source": f"{nodes[0][0]}:{nodes[0][1]}",
+                             "spaces": {}}
+        for space in spaces:
+            remote = RemoteIndex(
+                nodes[0], space=space, timeout=timeout, retries=retries
+            )
+            try:
+                for attempt in range(fence_retries):
+                    meta = remote.snapshot_meta()
+                    sdir = os.path.join(out_dir, f"s{sid}", space)
+                    os.makedirs(sdir, exist_ok=True)
+                    ok = True
+                    for f in meta["files"]:
+                        # stream pages straight to disk (bounded memory)
+                        # then digest the landed bytes chunked
+                        target = os.path.join(sdir, f["name"])
+                        atomic_write(
+                            target,
+                            lambda fh, name=f["name"]: remote.fetch_file_into(
+                                name, fh, page=FETCH_PAGE
+                            ),
+                        )
+                        if _file_digest(target) != f["digest"]:
+                            # a racing compaction superseded the file
+                            # mid-stream: re-fence and retry the space
+                            os.unlink(target)
+                            ok = False
+                            break
+                    if ok:
+                        break
+                else:
+                    raise RuntimeError(
+                        f"shard {sid} space {space}: files kept changing "
+                        f"under the snapshot across {fence_retries} fences "
+                        "(quiesce the ingest)"
+                    )
+                man_bytes = json.dumps(meta["manifest"], indent=1).encode()
+                atomic_replace(os.path.join(sdir, "manifest.json"), man_bytes)
+                shard_entry["spaces"][space] = {
+                    "manifest": meta["manifest"],
+                    "manifest_digest": _digest(man_bytes),
+                    "files": {f["name"]: f["digest"] for f in meta["files"]},
+                }
+            finally:
+                remote.close()
+        manifest["shards"].append(shard_entry)
+    # the commit point: a snapshot directory is whole iff this exists
+    atomic_replace(
+        os.path.join(out_dir, SNAP_MANIFEST),
+        json.dumps(manifest, indent=1).encode(),
+    )
+    return manifest
+
+
+def verify_snapshot(snap_dir: str) -> list[str]:
+    """Offline digest sweep; returns problems (empty = intact)."""
+    problems: list[str] = []
+    man_path = os.path.join(snap_dir, SNAP_MANIFEST)
+    if not os.path.exists(man_path):
+        return [f"{SNAP_MANIFEST} missing — snapshot never committed"]
+    with open(man_path) as fh:
+        manifest = json.load(fh)
+    for shard in manifest.get("shards", []):
+        sid = shard["shard"]
+        for space, entry in shard.get("spaces", {}).items():
+            sdir = os.path.join(snap_dir, f"s{sid}", space)
+            for name, want in entry.get("files", {}).items():
+                path = os.path.join(sdir, name)
+                if not os.path.exists(path):
+                    problems.append(f"s{sid}/{space}/{name}: missing")
+                    continue
+                if _file_digest(path) != want:
+                    problems.append(f"s{sid}/{space}/{name}: digest mismatch")
+            mpath = os.path.join(sdir, "manifest.json")
+            if not os.path.exists(mpath):
+                problems.append(f"s{sid}/{space}/manifest.json: missing")
+            else:
+                with open(mpath, "rb") as fh:
+                    if _digest(fh.read()) != entry.get("manifest_digest"):
+                        problems.append(
+                            f"s{sid}/{space}/manifest.json: digest mismatch"
+                        )
+    return problems
+
+
+def restore_fleet(
+    snap_dir: str, out_base: str, *, replicas: int = 1
+) -> list[str]:
+    """Materialise the snapshot onto fresh node directories
+    (``out_base/s<sid>n<rep>/<space>/``), digest-verifying every copied
+    byte; returns the node directories created.  Refuses non-empty
+    targets — restore never silently merges into existing state."""
+    from advanced_scrapper_tpu.storage.fsio import atomic_replace
+
+    problems = verify_snapshot(snap_dir)
+    if problems:
+        raise RuntimeError(f"snapshot {snap_dir} failed verification: {problems}")
+    with open(os.path.join(snap_dir, SNAP_MANIFEST)) as fh:
+        manifest = json.load(fh)
+    created: list[str] = []
+    for shard in manifest["shards"]:
+        sid = shard["shard"]
+        for rep in range(replicas):
+            node_dir = os.path.join(out_base, f"s{sid}n{rep}")
+            for space, entry in shard["spaces"].items():
+                tdir = os.path.join(node_dir, space)
+                if os.path.isdir(tdir) and os.listdir(tdir):
+                    raise RuntimeError(
+                        f"restore target {tdir} is not empty — refusing to "
+                        "merge a snapshot into existing state"
+                    )
+                os.makedirs(tdir, exist_ok=True)
+                sdir = os.path.join(snap_dir, f"s{sid}", space)
+                for name, want in entry["files"].items():
+                    _copy_verified(
+                        os.path.join(sdir, name),
+                        os.path.join(tdir, name),
+                        want,
+                    )
+                with open(os.path.join(sdir, "manifest.json"), "rb") as fh:
+                    man_bytes = fh.read()
+                # the manifest lands LAST — the restore's commit point per
+                # space, mirroring the index's own cut discipline
+                atomic_replace(os.path.join(tdir, "manifest.json"), man_bytes)
+            created.append(node_dir)
+    return created
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("snapshot", help="pull a snapshot from a live fleet")
+    s.add_argument("--fleet", required=True, help="h:p|h:p;h:p spec")
+    s.add_argument("--out", required=True, help="snapshot directory")
+    s.add_argument("--spaces", default=",".join(DEFAULT_SPACES))
+    s.add_argument("--timeout", type=float, default=10.0)
+    r = sub.add_parser("restore", help="materialise onto fresh node dirs")
+    r.add_argument("--snapshot", required=True)
+    r.add_argument("--out", required=True, help="base dir for node dirs")
+    r.add_argument("--replicas", type=int, default=1)
+    v = sub.add_parser("verify", help="offline digest sweep")
+    v.add_argument("--snapshot", required=True)
+    args = ap.parse_args(argv)
+
+    if args.cmd == "snapshot":
+        man = snapshot_fleet(
+            args.fleet, args.out,
+            spaces=tuple(s for s in args.spaces.split(",") if s),
+            timeout=args.timeout,
+        )
+        n_files = sum(
+            len(e["files"]) for sh in man["shards"] for e in sh["spaces"].values()
+        )
+        print(
+            f"snapshot committed: {len(man['shards'])} shards, "
+            f"{n_files} files → {args.out}"
+        )
+        return 0
+    if args.cmd == "restore":
+        dirs = restore_fleet(args.snapshot, args.out, replicas=args.replicas)
+        print(f"restored {len(dirs)} node dirs:")
+        for d in dirs:
+            print(f"  {d}")
+        return 0
+    problems = verify_snapshot(args.snapshot)
+    for p in problems:
+        print(f"PROBLEM: {p}", file=sys.stderr)
+    if not problems:
+        print("snapshot intact")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Offline persistent-index verifier: every CRC, every digest, every frame.
+
+``PersistentIndex.scrub()`` is the ONLINE integrity pass; this is its
+offline twin for directories no process owns — a crashsweep post-condition,
+a pre-restore snapshot check, an operator's "is this disk still good".
+For each index directory (anything holding a ``manifest.json``):
+
+- the manifest parses and names only files that exist;
+- every segment opens (header CRC, CRC-table CRC, structural size),
+  passes a FULL block-CRC sweep over all three body planes, and matches
+  its manifest-recorded whole-file digest (v1 segments — no CRC table —
+  verify structure + digest only, reported as such);
+- the live WAL generation is well-framed (``replay_wal``): a torn TAIL is
+  a normal crash artifact (reported, not an error — the next writable
+  open truncates it), but bytes the replay cannot reach are counted;
+- orphan ``.seg``/``wal-*`` files and ``.quarantine`` sidecars are listed
+  as informational findings.
+
+Exit status: 0 when every directory verified clean, 1 when any corruption
+was found (nonzero-exit per-file report — the crashsweep ``bitrot``
+workload's final gate).  Read-only by construction: fsck never repairs,
+quarantines or truncates — that is the writable open's / scrub's job.
+
+Usage::
+
+    python tools/fsck_index.py DIR [DIR ...] [--json]
+
+A DIR may be an index directory itself or any ancestor — every
+``manifest.json`` found below it is checked.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def find_index_dirs(root: str) -> list[str]:
+    """Every directory at-or-below ``root`` holding a ``manifest.json``."""
+    if os.path.isfile(os.path.join(root, "manifest.json")):
+        return [root]
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        if "manifest.json" in files:
+            out.append(dirpath)
+    return sorted(out)
+
+
+def fsck_dir(directory: str, fs=None) -> dict:
+    """Verify one index directory; returns a report dict with
+    ``problems`` (corruption — nonzero exit) and ``notes``
+    (informational: torn WAL tails, quarantine sidecars, orphans)."""
+    from advanced_scrapper_tpu.index.segment import Segment, SegmentCorruption
+    from advanced_scrapper_tpu.index.wal import replay_wal
+    from advanced_scrapper_tpu.storage.fsio import default_fs
+
+    fs = fs or default_fs()
+    report: dict = {
+        "dir": directory,
+        "segments": 0,
+        "postings": 0,
+        "wal_postings": 0,
+        "problems": [],
+        "notes": [],
+    }
+    man_path = os.path.join(directory, "manifest.json")
+    try:
+        with fs.open(man_path, "rb") as fh:
+            man = json.loads(fh.read().decode("utf-8"))
+    except (OSError, ValueError) as e:
+        report["problems"].append(f"manifest.json unreadable: {e}")
+        return report
+    if int(man.get("version", 1)) != 1:
+        report["problems"].append(
+            f"unknown manifest version {man.get('version')}"
+        )
+        return report
+    digests = man.get("digests", {})
+    live = set(man.get("segments", []))
+    for name in man.get("segments", []):
+        path = os.path.join(directory, name)
+        if not fs.exists(path):
+            report["problems"].append(f"{name}: manifest names a missing file")
+            continue
+        try:
+            seg = Segment(path, fs=fs)
+        except SegmentCorruption as e:
+            report["problems"].append(f"{name}: {e.detail}")
+            continue
+        except (OSError, ValueError) as e:
+            report["problems"].append(f"{name}: unopenable ({e})")
+            continue
+        try:
+            digest = seg.verify_all(fs=fs)
+        except SegmentCorruption as e:
+            report["problems"].append(f"{name}: {e.detail}")
+            seg.close()
+            continue
+        report["segments"] += 1
+        report["postings"] += seg.count
+        want = digests.get(name)
+        if want is None:
+            report["notes"].append(
+                f"{name}: no manifest digest recorded "
+                f"({'v1 segment' if seg.version == 1 else 'pre-digest manifest'})"
+            )
+        elif want != digest:
+            report["problems"].append(
+                f"{name}: whole-file digest mismatch ({digest} != "
+                f"manifest {want})"
+            )
+        if seg.version == 1:
+            report["notes"].append(
+                f"{name}: v1 format — no block CRCs to verify"
+            )
+        seg.close()
+    wal_name = f"wal-{int(man.get('wal_seq', 0)):08d}.log"
+    wal_path = os.path.join(directory, wal_name)
+    if fs.exists(wal_path):
+        keys, _docs, valid_end = replay_wal(wal_path, fs=fs)
+        report["wal_postings"] = int(keys.size)
+        size = fs.size(wal_path)
+        if size > valid_end:
+            report["notes"].append(
+                f"{wal_name}: torn tail ({size - valid_end} bytes past the "
+                "last whole frame — truncated by the next writable open)"
+            )
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        names = []
+    for name in names:
+        if name.endswith(".quarantine"):
+            report["notes"].append(f"{name}: quarantine sidecar present")
+        elif name.endswith(".seg") and name not in live:
+            report["notes"].append(f"{name}: orphan segment (not in manifest)")
+        elif (
+            name.startswith("wal-") and name.endswith(".log")
+            and name != wal_name
+        ):
+            report["notes"].append(f"{name}: superseded WAL generation")
+    report["ok"] = not report["problems"]
+    return report
+
+
+def fsck(paths, fs=None) -> dict:
+    """Walk every given path for index dirs; aggregate report."""
+    reports = []
+    for p in paths:
+        dirs = find_index_dirs(p)
+        if not dirs:
+            reports.append(
+                {"dir": p, "problems": [f"no manifest.json found under {p}"],
+                 "notes": [], "ok": False}
+            )
+            continue
+        for d in dirs:
+            reports.append(fsck_dir(d, fs=fs))
+    return {
+        "dirs": reports,
+        "problems": [
+            f"{r['dir']}: {p}" for r in reports for p in r["problems"]
+        ],
+        "ok": all(r.get("ok") for r in reports),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dirs", nargs="+", help="index dirs (or ancestors)")
+    ap.add_argument("--json", action="store_true", help="machine-readable report")
+    args = ap.parse_args(argv)
+    report = fsck(args.dirs)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        for r in report["dirs"]:
+            verdict = "clean" if r.get("ok") else "CORRUPT"
+            print(
+                f"{r['dir']}: {verdict} "
+                f"({r.get('segments', 0)} segments, "
+                f"{r.get('postings', 0)} postings, "
+                f"{r.get('wal_postings', 0)} WAL postings)"
+            )
+            for p in r["problems"]:
+                print(f"  PROBLEM: {p}")
+            for n in r["notes"]:
+                print(f"  note: {n}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
