@@ -152,6 +152,7 @@ class _Endpoint:
     __slots__ = (
         "name", "url", "samples", "types", "exemplars", "ok", "error",
         "last_ok", "last_attempt", "scrapes", "failures",
+        "profile", "profile_ok",
     )
 
     def __init__(self, name: str, url: str):
@@ -166,6 +167,8 @@ class _Endpoint:
         self.last_attempt = 0.0
         self.scrapes = 0
         self.failures = 0
+        self.profile = ""        # last-known /profile folded text
+        self.profile_ok = False
 
 
 class FleetCollector:
@@ -180,6 +183,7 @@ class FleetCollector:
         obs_dir: str | None = None,
         sidecar_dir: str | None = None,
         stale_after: float = 15.0,
+        profiles: bool = False,
     ):
         """``endpoints``: iterable of ``(name, url)`` pairs or bare urls.
         ``obs_dir``: directory of ``*.endpoint`` announcement files,
@@ -188,11 +192,18 @@ class FleetCollector:
         flight-recorder JSONL dumps land (``ASTPU_FLIGHT_RECORDER``);
         scanned by :meth:`harvest_sidecars`.  ``stale_after``: seconds
         without a good scrape before an endpoint's cached samples are
-        flagged stale in ``/status``."""
+        flagged stale in ``/status``.  ``profiles``: also pull each
+        endpoint's ``GET /profile`` (the continuous host profiler,
+        ``obs/profiler.py``) every scrape round and serve the merged
+        per-instance folded stacks on the collector's own ``/profile``
+        (off by default — profile bodies are bigger than metrics and only
+        exist under ``ASTPU_PROFILE``; :meth:`harvest_profiles` is always
+        callable on demand)."""
         self.timeout = timeout
         self.obs_dir = obs_dir
         self.sidecar_dir = sidecar_dir
         self.stale_after = stale_after
+        self.profiles = profiles
         self._lock = threading.Lock()
         self._endpoints: dict[str, _Endpoint] = {}
         self._sidecars: dict[str, dict] = {}  # path → harvested summary
@@ -283,9 +294,68 @@ class FleetCollector:
             t.join(timeout=self.timeout + 1.0)
         if self.sidecar_dir:
             self.harvest_sidecars()
+        if self.profiles:
+            self.harvest_profiles()
         with self._lock:
             self._rounds += 1
             return {ep.name: ep.ok for ep in eps}
+
+    # -- profile harvest ---------------------------------------------------
+
+    def _fetch_profile(self, ep: _Endpoint) -> None:
+        try:
+            with urllib.request.urlopen(
+                ep.url + "/profile", timeout=self.timeout
+            ) as r:
+                text = r.read().decode("utf-8", errors="replace")
+        except Exception:
+            with self._lock:
+                ep.profile_ok = False
+            return
+        with self._lock:
+            ep.profile = text
+            ep.profile_ok = True
+
+    def harvest_profiles(self) -> dict:
+        """Pull every endpoint's ``GET /profile`` (concurrently, same
+        per-endpoint timeout discipline as the metrics scrape); returns
+        ``{endpoint: ok}``.  A dead or profile-less endpoint keeps its
+        last-known folded stacks — the merged view is a fleet snapshot,
+        staleness travels with the metrics-side markers."""
+        with self._lock:
+            eps = list(self._endpoints.values())
+        threads = [
+            threading.Thread(target=self._fetch_profile, args=(ep,), daemon=True)
+            for ep in eps
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.timeout + 1.0)
+        with self._lock:
+            return {ep.name: ep.profile_ok for ep in eps}
+
+    def merged_profile(self) -> str:
+        """The fleet-wide folded-stack view: every endpoint's last-known
+        ``/profile`` body with the instance name prefixed onto each stack
+        (``instance;root;...;leaf count``) — one text a flamegraph tool
+        renders with per-process towers side by side.  Endpoint header
+        comments are kept, re-tagged per instance."""
+        lines: list[str] = []
+        with self._lock:
+            eps = list(self._endpoints.values())
+        for ep in eps:
+            if not ep.profile:
+                continue
+            for line in ep.profile.splitlines():
+                line = line.strip()
+                if not line:
+                    continue
+                if line.startswith("#"):
+                    lines.append(f"# instance={ep.name} {line.lstrip('# ')}")
+                else:
+                    lines.append(f"{ep.name};{line}")
+        return "\n".join(lines) + "\n"
 
     # -- sidecar harvest ---------------------------------------------------
 
@@ -553,6 +623,12 @@ class FleetCollector:
                         self, 200,
                         json.dumps(collector.status()).encode("utf-8"),
                         "application/json",
+                    )
+                elif self.path == "/profile":
+                    telemetry.send_http_payload(
+                        self, 200,
+                        collector.merged_profile().encode("utf-8"),
+                        "text/plain; charset=utf-8",
                     )
                 else:
                     telemetry.send_http_payload(

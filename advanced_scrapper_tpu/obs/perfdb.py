@@ -1,0 +1,562 @@
+"""Platform-aware bench-history engine: trajectories, not one-shot numbers.
+
+Five ``BENCH_*.json`` rounds, five ``MULTICHIP_*.json`` dryruns and a soak
+record are checked into the repo root, and until now nothing READ them:
+every bench run printed a JSON line into the void, and BENCH_r05's silent
+cpu-fallback cost a full diagnosis cycle because nothing flagged that its
+numbers were being eyeballed against an on-chip round.  This module is
+the append-only ledger + comparison engine behind ``tools/perf_ledger.py``
+and bench's end-of-run history verdict:
+
+- **rows** — one flat dict per measurement run: a *platform key* (from
+  the PR 15 ``platform_fingerprint`` when present, the legacy
+  ``platform`` field otherwise, ``"unlabeled"`` for the pre-r03 rounds
+  that predate the stamp), a source name, an ordering hint (the ``rNN``
+  round number when the filename carries one, else the ingest
+  timestamp), the git sha, and every numeric metric flattened to dotted
+  keys (``stage_ms.encode``);
+- **trajectories** — per-(platform, metric) ordered value series;
+- **verdicts** — regression/improvement/stable per metric, comparing the
+  last row against its predecessor **on the same platform only**: a
+  cpu-fallback round is never judged against an on-chip one (the exact
+  comparison that burned PR 9), and rows whose platform key appears once
+  produce trajectory but no verdict.  Metric direction is resolved by
+  name (rates/recalls up = better, latencies/skews/compiles down =
+  better; unknown shapes get a trajectory but no verdict — a silent
+  wrong-direction verdict is worse than none);
+- **ledger** — an append-only JSONL file (``ASTPU_PERF_LEDGER`` names
+  it for bench; ``tools/sweep_onchip.py`` appends every sweep point) so
+  the history survives outside the checked-in artifacts.
+
+Everything here is stdlib-only and jax-free: the sweep parent (which
+must never import jax — a dead tunnel hangs backend imports) ingests
+through this module directly.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import subprocess
+import time
+
+__all__ = [
+    "PerfLedger",
+    "platform_key",
+    "metric_direction",
+    "flatten_metrics",
+    "row_from_result",
+    "rows_from_artifact",
+    "scan_repo_artifacts",
+    "trajectories",
+    "compute_verdicts",
+    "build_report",
+    "report_markdown",
+    "bench_history_verdict",
+    "git_sha",
+]
+
+SCHEMA = 1
+DEFAULT_THRESHOLD = 0.10  # |relative change| above this → a verdict moves
+
+_ROUND_RE = re.compile(r"_r(\d+)\b")
+#: top-level result keys that are structure, not metrics
+_SKIP_KEYS = {
+    "telemetry", "perf_history", "platform_fingerprint", "metric", "unit",
+    "platform", "regime", "sharded_per_shard", "sharded_mesh", "config",
+    "status",
+}
+
+_HIGHER = (
+    "_per_sec", "_per_s", "_rows_per_sec", "_urls_per_sec",
+    "_vs_baseline", "_vs_pandas", "_caught",
+)
+_LOWER = ("_ms", "_s", "_seconds", "_skew", "_compiles", "_bytes")
+_HIGHER_EXACT = {"value", "vs_baseline", "docs_per_s", "articles_per_s"}
+_HIGHER_PREFIX = ("recall", "precision", "vpu_util")
+_LOWER_EXACT = {"unchained_merges", "false_drops", "measured_fp", "compile_s"}
+
+
+def _segment_direction(seg: str) -> int:
+    if seg in _HIGHER_EXACT or seg.startswith(_HIGHER_PREFIX):
+        return 1
+    if seg in _LOWER_EXACT:
+        return -1
+    for suf in _HIGHER:
+        if seg.endswith(suf):
+            return 1
+    for suf in _LOWER:
+        if seg.endswith(suf):
+            return -1
+    return 0
+
+
+def metric_direction(name: str) -> int:
+    """``+1`` higher-is-better, ``-1`` lower-is-better, ``0`` unknown (a
+    trajectory is still kept; no verdict is issued — wrong-direction
+    verdicts are worse than silence).  Resolved leaf-first, then up the
+    dotted path, so ``stage_ms.encode`` inherits the ``_ms`` suffix its
+    PARENT key carries (the leaf alone says nothing)."""
+    for seg in reversed(name.split(".")):
+        d = _segment_direction(seg)
+        if d:
+            return d
+    return 0
+
+
+def platform_key(result: dict) -> str:
+    """The partition key same-platform comparison runs under.  A PR 15
+    ``platform_fingerprint`` wins (``backend/device_kindxN`` — two
+    tunnels with different chip counts never compare); the legacy
+    ``platform`` string is next; rows predating both are ``unlabeled``
+    and only ever compare among themselves."""
+    fp = result.get("platform_fingerprint")
+    if isinstance(fp, dict) and fp.get("backend"):
+        kind = str(fp.get("device_kind", "?")).replace(" ", "-")
+        return f"{fp['backend']}/{kind}x{fp.get('device_count', '?')}"
+    p = result.get("platform")
+    return str(p) if p else "unlabeled"
+
+
+def flatten_metrics(result: dict, prefix: str = "") -> dict[str, float]:
+    """Every numeric scalar in a result dict, dotted-flattened; bools,
+    strings, lists and the structural keys (telemetry ledger, fingerprint)
+    are skipped."""
+    out: dict[str, float] = {}
+    for k, v in result.items():
+        if not prefix and k in _SKIP_KEYS:
+            continue
+        key = f"{prefix}{k}"
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            if isinstance(v, float) and not math.isfinite(v):
+                continue
+            out[key] = float(v)
+        elif isinstance(v, dict):
+            out.update(flatten_metrics(v, prefix=f"{key}."))
+    return out
+
+
+def git_sha(repo_dir: str | None = None) -> str:
+    """Short HEAD sha of ``repo_dir`` (best-effort; ``"unknown"`` when
+    git is absent or the dir is not a checkout)."""
+    try:
+        return (
+            subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=repo_dir or os.getcwd(),
+                capture_output=True,
+                text=True,
+                timeout=10,
+            ).stdout.strip()
+            or "unknown"
+        )
+    except Exception:
+        return "unknown"
+
+
+def _round_order(source: str) -> float | None:
+    """Ordering hint from an ``_rNN`` round tag in the source name —
+    artifact rounds sort by round number; everything else returns
+    ``None`` (sorted after every round, by timestamp — see
+    ``_row_sort_key``).  ``None``, NOT ``math.inf``: rows are JSONL and
+    ``json.dumps(inf)`` emits the non-standard ``Infinity`` token that
+    breaks every strict parser reading the documented ledger format."""
+    m = _ROUND_RE.search(source)
+    return float(m.group(1)) if m else None
+
+
+def row_from_result(
+    result: dict,
+    *,
+    source: str,
+    kind: str = "bench",
+    ts: float | None = None,
+    platform: str | None = None,
+    git: str | None = None,
+) -> dict:
+    """One ledger row from a result dict (a bench JSON line, a sweep
+    point, an artifact's parsed payload)."""
+    fp = result.get("platform_fingerprint")
+    return {
+        "schema": SCHEMA,
+        "kind": kind,
+        "source": source,
+        "order": _round_order(source),
+        "ts": time.time() if ts is None else ts,
+        "platform": platform or platform_key(result),
+        "fingerprint": fp if isinstance(fp, dict) else None,
+        "git_sha": git
+        or (fp or {}).get("git_sha")
+        or result.get("git_sha")
+        or "",
+        "metrics": flatten_metrics(result),
+    }
+
+
+# -- checked-in artifact ingestion -------------------------------------------
+
+
+def _multichip_payload(raw: dict) -> dict | None:
+    """The ``MULTICHIP {...}`` JSON line from a dryrun record's tail."""
+    tail = raw.get("tail") or ""
+    for line in tail.splitlines():
+        line = line.strip()
+        if line.startswith("MULTICHIP "):
+            try:
+                return json.loads(line[len("MULTICHIP "):])
+            except ValueError:
+                return None
+    return None
+
+
+def rows_from_artifact(path: str) -> list[dict]:
+    """Ledger rows from one checked-in artifact (``BENCH_*.json``,
+    ``MULTICHIP_*.json``, ``SOAK_*.json``).  Driver wrappers (``parsed``
+    payloads, MULTICHIP tails) are unwrapped; a failed round (non-zero
+    rc, no payload) yields no rows — absence IS the honest record."""
+    name = os.path.basename(path)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            raw = json.load(fh)
+    except (OSError, ValueError):
+        return []
+    if not isinstance(raw, dict):
+        return []
+    if name.startswith("BENCH"):
+        payload = raw.get("parsed") if isinstance(raw.get("parsed"), dict) else (
+            raw if "metric" in raw else None
+        )
+        if not payload:
+            return []
+        return [row_from_result(payload, source=name, kind="bench_round", ts=0.0)]
+    if name.startswith("MULTICHIP"):
+        payload = _multichip_payload(raw)
+        if not payload or not raw.get("ok", False):
+            return []
+        metrics: dict = {}
+        for entry in payload.get("scaling", ()):
+            d = entry.get("devices")
+            if d is None:
+                continue
+            for mk, rk in (
+                ("articles_per_s", f"multichip_d{d}_articles_per_s"),
+                ("compile_s", f"multichip_d{d}_compile_s"),
+                ("step_ms", f"multichip_d{d}_step_ms"),
+            ):
+                if isinstance(entry.get(mk), (int, float)):
+                    metrics[rk] = float(entry[mk])
+        if not metrics:
+            return []
+        # dryrun platform: the driver's device count is the only stamp
+        # these records carry — partitioned apart from every bench round
+        plat = f"multichip-{raw.get('n_devices', '?')}dev"
+        return [
+            {
+                "schema": SCHEMA,
+                "kind": "multichip_round",
+                "source": name,
+                "order": _round_order(name),
+                "ts": 0.0,
+                "platform": plat,
+                "fingerprint": None,
+                "git_sha": "",
+                "metrics": metrics,
+            }
+        ]
+    if name.startswith("SOAK"):
+        if not flatten_metrics(raw):
+            return []
+        return [
+            row_from_result(
+                raw,
+                source=name,
+                kind="soak_round",
+                ts=0.0,
+                platform=f"soak/{raw.get('platform') or 'unlabeled'}",
+            )
+        ]
+    return []
+
+
+def scan_repo_artifacts(repo_dir: str) -> list[dict]:
+    """Every checked-in round artifact in ``repo_dir``, as ledger rows
+    ordered by round."""
+    rows: list[dict] = []
+    try:
+        names = sorted(os.listdir(repo_dir))
+    except OSError:
+        return rows
+    for fn in names:
+        if fn.endswith(".json") and fn.split("_")[0] in (
+            "BENCH", "MULTICHIP", "SOAK"
+        ):
+            rows.extend(rows_from_artifact(os.path.join(repo_dir, fn)))
+    rows.sort(key=_row_sort_key)
+    return rows
+
+
+def _row_sort_key(row: dict):
+    order = row.get("order")
+    if order is None:
+        order = math.inf
+    return (order, row.get("ts") or 0.0, row.get("source") or "")
+
+
+# -- the ledger ---------------------------------------------------------------
+
+
+class PerfLedger:
+    """Append-only JSONL ledger of measurement rows.
+
+    Torn-tail tolerant on read (a half-written last line is skipped, the
+    WAL convention every reader in this tree follows); appends are one
+    ``write`` + ``flush`` of a single line, so concurrent appenders from
+    watchdogged sweep subprocesses interleave whole lines on POSIX.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def append(self, row: dict) -> None:
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        with open(self.path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(row, sort_keys=True) + "\n")
+            fh.flush()
+
+    def rows(self) -> list[dict]:
+        out: list[dict] = []
+        try:
+            fh = open(self.path, encoding="utf-8")
+        except OSError:
+            return out
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue  # torn tail / foreign line: skip, never raise
+                if isinstance(row, dict) and row.get("metrics"):
+                    out.append(row)
+        return out
+
+    def sources(self) -> set[str]:
+        return {r.get("source", "") for r in self.rows()}
+
+    def ingest_result(self, result: dict, **kw) -> dict:
+        row = row_from_result(result, **kw)
+        self.append(row)
+        return row
+
+    def ingest_artifacts(self, paths) -> int:
+        """Append rows for artifacts not yet in the ledger (deduped by
+        source name); returns how many rows landed."""
+        seen = self.sources()
+        n = 0
+        for p in paths:
+            for row in rows_from_artifact(p):
+                if row["source"] in seen:
+                    continue
+                self.append(row)
+                seen.add(row["source"])
+                n += 1
+        return n
+
+
+# -- trajectories + verdicts --------------------------------------------------
+
+
+def trajectories(rows) -> dict[str, dict[str, list]]:
+    """``{platform: {metric: [(source, value), ...]}}`` — the ordered
+    per-platform series every verdict and report reads from."""
+    rows = sorted(rows, key=_row_sort_key)
+    out: dict[str, dict[str, list]] = {}
+    for row in rows:
+        plat = row.get("platform") or "unlabeled"
+        per = out.setdefault(plat, {})
+        for metric, v in (row.get("metrics") or {}).items():
+            per.setdefault(metric, []).append((row.get("source", ""), v))
+    return out
+
+
+def compute_verdicts(
+    rows, *, threshold: float = DEFAULT_THRESHOLD
+) -> list[dict]:
+    """Last-vs-previous verdict per (platform, metric) — SAME platform
+    only, direction-aware, ``stable`` inside ±``threshold``.  Metrics
+    with unknown direction or a single same-platform point yield no
+    verdict (their trajectory still prints)."""
+    verdicts: list[dict] = []
+    for plat, series in sorted(trajectories(rows).items()):
+        for metric, pts in sorted(series.items()):
+            if len(pts) < 2:
+                continue
+            direction = metric_direction(metric)
+            if direction == 0:
+                continue
+            (prev_src, prev), (last_src, last) = pts[-2], pts[-1]
+            if prev == 0:
+                continue
+            change = (last - prev) / abs(prev)
+            if abs(change) <= threshold:
+                verdict = "stable"
+            elif (change > 0) == (direction > 0):
+                verdict = "improvement"
+            else:
+                verdict = "regression"
+            verdicts.append(
+                {
+                    "platform": plat,
+                    "metric": metric,
+                    "prev": prev,
+                    "prev_source": prev_src,
+                    "last": last,
+                    "last_source": last_src,
+                    "change": round(change, 4),
+                    "direction": "higher" if direction > 0 else "lower",
+                    "verdict": verdict,
+                }
+            )
+    return verdicts
+
+
+def build_report(
+    rows, *, threshold: float = DEFAULT_THRESHOLD
+) -> dict:
+    """The machine-readable report: platform-partitioned trajectories +
+    verdicts + a one-glance summary."""
+    rows = list(rows)
+    traj = trajectories(rows)
+    verdicts = compute_verdicts(rows, threshold=threshold)
+    by_kind = {"regression": 0, "improvement": 0, "stable": 0}
+    for v in verdicts:
+        by_kind[v["verdict"]] += 1
+    return {
+        "rows": len(rows),
+        "platforms": {
+            plat: {
+                "metrics": len(series),
+                "points": sum(len(p) for p in series.values()),
+            }
+            for plat, series in sorted(traj.items())
+        },
+        "trajectories": {
+            plat: {m: pts for m, pts in sorted(series.items())}
+            for plat, series in sorted(traj.items())
+        },
+        "verdicts": verdicts,
+        "summary": by_kind,
+        "threshold": threshold,
+    }
+
+
+def _fmt_num(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.4g}"
+
+
+def report_markdown(report: dict, *, max_points: int = 8) -> str:
+    """The human half of the report: per-platform verdict tables plus
+    compact trajectories (last ``max_points`` points per metric)."""
+    lines = ["# Performance trajectory report", ""]
+    s = report["summary"]
+    lines.append(
+        f"{report['rows']} rows across {len(report['platforms'])} "
+        f"platform partitions — {s['regression']} regressions, "
+        f"{s['improvement']} improvements, {s['stable']} stable "
+        f"(threshold ±{report['threshold']:.0%}; same-platform "
+        "comparisons only)."
+    )
+    lines.append("")
+    verdicts_by_plat: dict[str, list] = {}
+    for v in report["verdicts"]:
+        verdicts_by_plat.setdefault(v["platform"], []).append(v)
+    for plat, series in report["trajectories"].items():
+        lines.append(f"## {plat}")
+        lines.append("")
+        vs = verdicts_by_plat.get(plat, [])
+        moved = [v for v in vs if v["verdict"] != "stable"]
+        if moved:
+            lines.append("| metric | prev | last | change | verdict |")
+            lines.append("|---|---|---|---|---|")
+            for v in sorted(
+                moved, key=lambda x: (x["verdict"], -abs(x["change"]))
+            ):
+                lines.append(
+                    f"| {v['metric']} | {_fmt_num(v['prev'])} "
+                    f"({v['prev_source']}) | {_fmt_num(v['last'])} "
+                    f"({v['last_source']}) | {v['change']:+.1%} "
+                    f"| **{v['verdict']}** |"
+                )
+        else:
+            n_v = len(vs)
+            lines.append(
+                f"_no movement beyond ±{report['threshold']:.0%} "
+                f"({n_v} comparable metrics)_"
+                if n_v
+                else "_single round — trajectory only, no comparison_"
+            )
+        lines.append("")
+        for metric, pts in series.items():
+            tail = pts[-max_points:]
+            path = " → ".join(_fmt_num(v) for _s, v in tail)
+            lines.append(f"- `{metric}`: {path}")
+        lines.append("")
+    return "\n".join(lines)
+
+
+# -- bench integration --------------------------------------------------------
+
+
+def bench_history_verdict(
+    result: dict,
+    *,
+    repo_dir: str,
+    ledger_path: str | None = None,
+    threshold: float = DEFAULT_THRESHOLD,
+) -> dict:
+    """Judge a just-finished bench result against the history (checked-in
+    artifacts + optional ledger), SAME platform only — what bench folds
+    into its end-of-run verdict.  Returns ``{platform, compared_against,
+    verdicts, regressions, improvements}``; an empty ``compared_against``
+    means no same-platform history exists (first on-chip round, fresh
+    checkout) and no verdict is fabricated."""
+    history = scan_repo_artifacts(repo_dir)
+    if ledger_path:
+        seen = {r.get("source") for r in history}
+        for row in PerfLedger(ledger_path).rows():
+            if row.get("source") not in seen:
+                history.append(row)
+    me = row_from_result(result, source="this-run")
+    same = [r for r in history if r.get("platform") == me["platform"]]
+    if not same:
+        return {
+            "platform": me["platform"],
+            "compared_against": None,
+            "verdicts": [],
+            "regressions": 0,
+            "improvements": 0,
+        }
+    prev = sorted(same, key=_row_sort_key)[-1]
+    verdicts = [
+        v
+        for v in compute_verdicts([prev, me], threshold=threshold)
+        if v["verdict"] != "stable"
+    ]
+    return {
+        "platform": me["platform"],
+        "compared_against": prev.get("source"),
+        "verdicts": verdicts,
+        "regressions": sum(1 for v in verdicts if v["verdict"] == "regression"),
+        "improvements": sum(
+            1 for v in verdicts if v["verdict"] == "improvement"
+        ),
+    }

@@ -17,6 +17,10 @@ Objective kinds:
   violated-then-recovered latency regression has to read as recovered);
 - ``rate_min`` — a counter's per-second rate ≥ ``threshold`` (throughput
   floors per regime);
+- ``rate_max`` — a counter's per-second rate ≤ ``threshold`` (event
+  ceilings: ``threshold: 0`` on ``astpu_jit_compiles_total`` is the
+  recompile-storm alarm — any steady-state compile between evaluations
+  violates, which is exactly what the sentinel exists to surface);
 - ``ratio_max`` — delta(``metric``)/delta(``denominator``) ≤ ``threshold``
   (error-ratio budgets);
 - ``gauge_min`` / ``gauge_max`` — an aggregated gauge vs a floor/ceiling
@@ -49,7 +53,10 @@ __all__ = [
     "percentile_from_buckets",
 ]
 
-KINDS = ("p99_latency_max", "rate_min", "ratio_max", "gauge_min", "gauge_max")
+KINDS = (
+    "p99_latency_max", "rate_min", "rate_max", "ratio_max",
+    "gauge_min", "gauge_max",
+)
 
 
 @dataclass
@@ -268,6 +275,8 @@ class SloEngine:
         if prev is None or dt is None or dt <= 0:
             return None, None  # first sight: no rate yet
         rate = max(0.0, cur - prev) / dt
+        if o.kind == "rate_max":
+            return rate, rate > o.threshold
         return rate, rate < o.threshold
 
     def _eval_ratio(self, o: SloObjective, st: _ObjState, samples):
@@ -316,7 +325,7 @@ class SloEngine:
             st = self._state[o.name]
             if o.kind == "p99_latency_max":
                 value, violated = self._eval_p99(o, st, samples)
-            elif o.kind == "rate_min":
+            elif o.kind in ("rate_min", "rate_max"):
                 value, violated = self._eval_rate(o, st, samples, dt)
             elif o.kind == "ratio_max":
                 value, violated = self._eval_ratio(o, st, samples)

@@ -700,6 +700,14 @@ class StatusServer:
                     serve_metrics(self, reg)
                 elif self.path == "/status":
                     serve_status(self, reg, extra_status)
+                elif self.path == "/profile":
+                    # the continuous host profiler's folded stacks
+                    # (obs/profiler.py; 200 with a comment line when
+                    # ASTPU_PROFILE is unset) — lazy import: profiler
+                    # imports telemetry at module scope
+                    from advanced_scrapper_tpu.obs import profiler
+
+                    profiler.serve_profile(self)
                 else:
                     send_http_payload(
                         self,
@@ -721,6 +729,12 @@ class StatusServer:
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+        # ASTPU_PROFILE=<hz>: any process that exports metrics also
+        # profiles itself — the sampler is process-global and idempotent,
+        # and /profile (above) serves its folded stacks
+        from advanced_scrapper_tpu.obs import profiler
+
+        profiler.maybe_start_global()
         # fleet discovery: under ASTPU_OBS_DIR every exporter announces
         # its endpoint as a one-line file the metrics collector
         # (obs/collector.py) watches — no port registry, no race against

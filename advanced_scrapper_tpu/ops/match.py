@@ -227,6 +227,12 @@ def make_screen_step(
     ``EntityIndex``).  Compiled per static ``(rows, width)`` — callers
     keep both bucketed (O(log) shapes; ``pipeline.matcher``'s tile
     chunker and prewarm share one derivation).
+
+    SENTINEL CONTRACT: the raw ``jax.jit`` object is returned (exposing
+    ``_cache_size``) so ``pipeline.matcher._screen_steps`` can wrap it in
+    the recompile sentinel (``obs.devprof.instrument_jit`` →
+    ``astpu_jit_compiles_total{kernel="matcher_screen_step"}``; ops may
+    not import obs — layering).
     """
     from advanced_scrapper_tpu.ops.pack import unpack_tile_planes
 

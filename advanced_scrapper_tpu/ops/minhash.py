@@ -183,6 +183,14 @@ def make_fused_tile_step(params: MinHashParams, backend: str):
     params arrays are closure-captured (constant-folded into the
     compiled step), so cache the returned callable per (params,
     backend) — ``pipeline.dedup.NearDupEngine`` holds one per engine.
+
+    SENTINEL CONTRACT: this builder returns the raw ``jax.jit`` object
+    (exposing ``_cache_size``) — the pipeline layer wraps it in the
+    recompile sentinel (``obs.devprof.instrument_jit``, counting every
+    jit-cache miss on ``astpu_jit_compiles_total{kernel=
+    "dedup_fused_tile"}``; ops may not import obs — layering).  Wrapping
+    the step in anything that hides ``_cache_size`` silently blinds the
+    sentinel AND the prewarm-set gate tests.
     """
     if backend == "oph":
         from advanced_scrapper_tpu.ops.oph import oph_raw_signatures

@@ -165,6 +165,13 @@ def make_sharded_fused_tile_step(mesh: Mesh, params: MinHashParams, backend: str
     (engine, mesh) — jit then caches per static (rows, width,
     num_articles), the same shape set the single-device chunker draws
     (``pipeline.dedup``'s ``_tile_bs``/``_tile_rows_options``).
+
+    SENTINEL CONTRACT: the raw ``jax.jit`` object is returned (exposing
+    ``_cache_size``) so ``pipeline.dedup._get_sharded_fused_step`` can
+    wrap it in the recompile sentinel (``obs.devprof.instrument_jit`` →
+    ``astpu_jit_compiles_total{kernel="sharded_fused_tile"}``; parallel
+    may not grow an obs dependency for it — layering keeps the counting
+    at the pipeline seam).
     """
     if backend == "oph":
         from advanced_scrapper_tpu.ops.oph import oph_raw_signatures

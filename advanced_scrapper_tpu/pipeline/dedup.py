@@ -47,6 +47,27 @@ from advanced_scrapper_tpu.ops.minhash import resolve_signature_fn
 RERANK_HOOK_EDGE = "dedup.candidates->dedup.resolve"
 
 
+_LSH_EPILOGUES: dict = {}
+
+
+def _lsh_epilogue(name: str):
+    """``ops.lsh``'s fused end-of-corpus epilogues, wrapped ONCE in the
+    recompile sentinel (``obs/devprof.py``) under ``kernel="dedup_<name>"``
+    — so a steady-state epilogue recompile (e.g. a silently-varying
+    ``num_articles`` bucket) is as countable as a tile-step one.  Lazy
+    (the epilogues are jitted at ``ops.lsh`` import, which pulls jax) and
+    memoised (the wrapper is per-process, like the underlying jit
+    cache)."""
+    fn = _LSH_EPILOGUES.get(name)
+    if fn is None:
+        from advanced_scrapper_tpu.obs import devprof
+        from advanced_scrapper_tpu.ops import lsh
+
+        fn = devprof.instrument_jit(getattr(lsh, name), f"dedup_{name}")
+        _LSH_EPILOGUES[name] = fn
+    return fn
+
+
 def _jump_rounds(n: int) -> int:
     r = 1
     while (1 << r) < n:
@@ -265,12 +286,21 @@ class NearDupEngine:
 
     def _get_fused_step(self):
         """The engine's single-dispatch tile step (params constant-folded;
-        built once — jit caches per static (rows, width, num_articles))."""
+        built once — jit caches per static (rows, width, num_articles)).
+        Wrapped in the recompile sentinel (``obs/devprof.py``): every
+        jit-cache miss counts on ``astpu_jit_compiles_total{kernel=
+        "dedup_fused_tile"}`` — prewarm/warmup compiles are expected
+        counts, a steady-state increment is the stall prewarm exists to
+        prevent, tier-1-asserted at zero."""
         step = self._fused_step
         if step is None:
+            from advanced_scrapper_tpu.obs import devprof
             from advanced_scrapper_tpu.ops.minhash import make_fused_tile_step
 
-            step = make_fused_tile_step(self.params, self.cfg.backend)
+            step = devprof.instrument_jit(
+                make_fused_tile_step(self.params, self.cfg.backend),
+                "dedup_fused_tile",
+            )
             self._fused_step = step
         return step
 
@@ -508,6 +538,7 @@ class NearDupEngine:
         # and this thread drains the depth-N staged window and dispatches.
         # The min-combine is order-independent, so out-of-order arrival
         # from the pool never matters.
+        from advanced_scrapper_tpu.obs import devprof
         from advanced_scrapper_tpu.pipeline.dispatch import PipelinedDispatcher
 
         put_workers = resolve_put_workers(cfg)
@@ -534,9 +565,16 @@ class NearDupEngine:
 
             def dispatch(running, item):
                 dev, rows, w, _nb, _pms = item
-                out = step(
-                    running, dev, rows=rows, width=w, num_articles=n_bucket
-                )
+                # latency ledger: per-dispatch wall clock by kernel/shape
+                # (async-submit timing; ASTPU_DISPATCH_TIMING=fenced
+                # blocks until ready for ground truth)
+                with devprof.dispatch_span(
+                    "dedup_fused_tile", rows=rows, width=w, trace=tid
+                ) as sp:
+                    out = step(
+                        running, dev, rows=rows, width=w, num_articles=n_bucket
+                    )
+                    sp.out = out
                 # counted on success, INSIDE the fn: the OOM-backoff
                 # ladder then ledgers exactly its leaf dispatches
                 stages.count_dispatch("dedup")
@@ -593,9 +631,16 @@ class NearDupEngine:
             def dispatch(running, item):
                 t, l, o, _nb, _pms = item
                 stages.count_dispatch("dedup")  # block_fn; the fold below
-                return accumulate_block_signatures(
-                    running, block_fn(t, l, params), o, num_articles=n_bucket
-                )
+                with devprof.dispatch_span(
+                    "dedup_legacy_tile",
+                    rows=int(t.shape[0]), width=int(t.shape[1]), trace=tid,
+                ) as sp:
+                    out = accumulate_block_signatures(
+                        running, block_fn(t, l, params), o,
+                        num_articles=n_bucket,
+                    )
+                    sp.out = out
+                return out
 
         running = jnp.full((n_bucket, params.num_perm), U32_MAX, jnp.uint32)
         dispatched = 0
@@ -706,8 +751,8 @@ class NearDupEngine:
         corpus is ``tiles × 1`` dispatches plus this epilogue before
         resolution."""
         from advanced_scrapper_tpu.obs import stages, trace
-        from advanced_scrapper_tpu.ops.lsh import fused_candidate_epilogue
 
+        fused_candidate_epilogue = _lsh_epilogue("fused_candidate_epilogue")
         tid = trace.new_trace_id()
         n = len(texts)
         raw = [to_bytes(t) for t in texts]  # encode once; identity on bytes
@@ -786,7 +831,7 @@ class NearDupEngine:
                 return rep
         # no hook: the WHOLE resolution is one fused dispatch — a full
         # corpus is tiles × 1 dispatches plus this epilogue
-        from advanced_scrapper_tpu.ops.lsh import fused_resolve_epilogue
+        fused_resolve_epilogue = _lsh_epilogue("fused_resolve_epilogue")
 
         tid = trace.new_trace_id()
         raw = [to_bytes(t) for t in texts]
@@ -827,12 +872,16 @@ class NearDupEngine:
         key = (mesh, "fused")
         step = self._sharded_steps.get(key)
         if step is None:
+            from advanced_scrapper_tpu.obs import devprof
             from advanced_scrapper_tpu.parallel.sharded_packed import (
                 make_sharded_fused_tile_step,
             )
 
-            step = make_sharded_fused_tile_step(
-                mesh, self.params, self.cfg.backend
+            step = devprof.instrument_jit(
+                make_sharded_fused_tile_step(
+                    mesh, self.params, self.cfg.backend
+                ),
+                "sharded_fused_tile",
             )
             self._sharded_steps[key] = step
         return step
@@ -942,6 +991,7 @@ class NearDupEngine:
             mesh_num_shards,
             shard_row_devices,
         )
+        from advanced_scrapper_tpu.obs import devprof
         from advanced_scrapper_tpu.pipeline.dispatch import PipelinedDispatcher
 
         tid = trace_id or trace.new_trace_id()
@@ -985,9 +1035,16 @@ class NearDupEngine:
 
         def dispatch(running, item):
             packed, rows, w, _nb, _pms = item
-            out = step(
-                running, packed, rows=rows, width=w, num_articles=n_bucket
-            )
+            # ONE latency observation per partitioned launch (labeling it
+            # per shard would count the same wall clock nsh times); the
+            # per-shard truth lives in the put/dispatch count ledger below
+            with devprof.dispatch_span(
+                "sharded_fused_tile", rows=rows, width=w, trace=tid
+            ) as sp:
+                out = step(
+                    running, packed, rows=rows, width=w, num_articles=n_bucket
+                )
+                sp.out = out
             # one partitioned launch = one execution per shard
             for s in local_rows:
                 stages.count_dispatch("sharded", shard=s)
@@ -1391,8 +1448,8 @@ class NearDupEngine:
         which on a tunneled link is ~8× the key volume for nothing.
         """
         from advanced_scrapper_tpu.obs import stages, trace
-        from advanced_scrapper_tpu.ops.lsh import fused_keys_epilogue
 
+        fused_keys_epilogue = _lsh_epilogue("fused_keys_epilogue")
         n = len(texts)
         if n == 0:
             nb = self.params.num_bands

@@ -37,6 +37,15 @@ nothing of any workload:
   dispatching) plus at most two packed host buffers awaiting transfer,
   so backpressure — not the encode rate — sets host memory.
 
+Every staged tile is stamped as the put pool finishes it and the gap to
+the caller's pop lands on the always-on
+``astpu_dispatch_queue_lag_seconds{graph}`` histogram (``obs/devprof.py``):
+near-zero lag means the dispatch loop consumes tiles the moment they
+land (dispatch is the bottleneck — deepen nothing), sustained lag means
+H2D runs ahead and the window absorbs it (the transport is the
+bottleneck — the knob sweeps have headroom).  The stamp is internal:
+callers still iterate exactly what their ``put`` returned.
+
 Out-of-order arrival from the put pool never matters to either rider
 (the dedup min-combine is order-independent; matcher tiles carry their
 row→article owners); a worker error closes every edge and re-raises at
@@ -115,10 +124,23 @@ class PipelinedDispatcher:
         name: str = "dedup.h2d",
         watchdog_s: float | None = None,
     ):
+        from advanced_scrapper_tpu.obs import devprof
+
         window = resolve_dispatch_window(window, put_workers)
         self._watchdog_s = resolve_watchdog_s(watchdog_s)
         self._beat = time.monotonic()
         self._finished = threading.Event()
+        self._lag_hist = devprof.queue_lag_histogram(name)
+
+        def stamped_put(item, _put=put):
+            # the staged-pop lag clock starts the instant the transfer
+            # completes (stamp taken AFTER _put returns — stamping first
+            # would fold the whole H2D into "lag" and invert the
+            # bottleneck diagnostic); __iter__ unwraps, so riders never
+            # see the stamp
+            staged = _put(item)
+            return (time.perf_counter(), staged)
+
         self._graph = StageGraph(name)
         # the packed edge is a FIXED two-deep buffer (pack is cheap next
         # to put+dispatch; two keeps the put pool fed across a pop) — it
@@ -132,7 +154,7 @@ class PipelinedDispatcher:
         self._graph.stage(
             "h2d",
             in_edge=packed,
-            fn=put,
+            fn=stamped_put,
             out_edge=self._staged,
             workers=max(1, put_workers),
         )
@@ -209,7 +231,9 @@ class PipelinedDispatcher:
                         "pipelined dispatch worker died mid-corpus"
                     ) from err
                 return
-            yield item
+            staged_ts, payload = item
+            self._lag_hist.observe(time.perf_counter() - staged_ts)
+            yield payload
 
     def close(self, timeout: float = 30.0) -> None:
         """Stop the graph (idempotent; safe mid-iteration on error paths)."""

@@ -665,7 +665,7 @@ def _screen_steps(index: EntityIndex, use_refine: bool):
     key = bool(use_refine)
     step = cache.get(key)
     if step is None:
-        from advanced_scrapper_tpu.obs import stages
+        from advanced_scrapper_tpu.obs import devprof, stages
         from advanced_scrapper_tpu.ops.match import make_screen_step
 
         with stages.timed("matcher_build"):
@@ -678,10 +678,20 @@ def _screen_steps(index: EntityIndex, use_refine: bool):
                 else:
                     # no refine candidates ⇒ the fused mode IS the
                     # screen-only step — alias it instead of compiling an
-                    # identical kernel under a second jit closure
+                    # identical kernel under a second jit closure (the
+                    # recompile sentinel rides the alias too: one wrapped
+                    # object, one jit cache)
                     step = cache[key] = _screen_steps(index, False)
                     return step
-            step = make_screen_step(index.screen_tables(), refine)
+            # recompile sentinel (obs/devprof.py): every jit-cache miss
+            # counts on astpu_jit_compiles_total{kernel=
+            # "matcher_screen_step"} — prewarm_screen's compiles are the
+            # expected counts, a steady-state increment is the stall the
+            # prewarmed shape set exists to prevent
+            step = devprof.instrument_jit(
+                make_screen_step(index.screen_tables(), refine),
+                "matcher_screen_step",
+            )
         cache[key] = step
     return step
 
@@ -720,7 +730,7 @@ def _packed_screen(
     returned by the step), and the host scatter is per-row."""
     import jax
 
-    from advanced_scrapper_tpu.obs import stages, telemetry
+    from advanced_scrapper_tpu.obs import devprof, stages, telemetry
     from advanced_scrapper_tpu.ops.match import FLAG_REFINE_OK, MASK_TEXT_PRUNED
     from advanced_scrapper_tpu.ops.pack import pack_tile_planes
     from advanced_scrapper_tpu.core.tokenizer import bucket_widths, encode_batch
@@ -864,9 +874,12 @@ def _packed_screen(
         for i, item in enumerate(pipe):
             dev, nrows, w, nbytes, put_s = item
             t0 = time.perf_counter()
-            with stages.timed("matcher_screen"):
+            with stages.timed("matcher_screen"), devprof.dispatch_span(
+                "matcher_screen_tile", rows=nrows, width=w
+            ) as sp:
                 # async dispatch; trailing tiles drain below
                 out = step(dev, threshold, rows=nrows, width=w)
+                sp.out = out
             stages.count_dispatch("matcher")
             results.append(out)
             if probe is not None:
@@ -912,7 +925,7 @@ def _legacy_screen(
     import jax
 
     from advanced_scrapper_tpu.core.tokenizer import bucket_len, encode_batch
-    from advanced_scrapper_tpu.obs import stages, telemetry
+    from advanced_scrapper_tpu.obs import devprof, stages, telemetry
     from advanced_scrapper_tpu.ops.match import match_screen
 
     tables = index.screen_tables()
@@ -961,10 +974,14 @@ def _legacy_screen(
             ln_d = jax.device_put(ln)
         for arr in (tok, text_len, title_len, ln):
             stages.count_device_put(arr.nbytes, "matcher")
-        with stages.timed("matcher_screen"):
+        with stages.timed("matcher_screen"), devprof.dispatch_span(
+            "matcher_screen_legacy",
+            rows=int(tok.shape[0]), width=int(tok.shape[1]),
+        ) as sp:
             got = match_screen(
                 tok_d, tl_d, ttl_d, ln_d, tables, threshold=threshold
             )
+            sp.out = got
             stages.count_dispatch("matcher")
         for i in range(len(batch)):
             # articles longer than the screen block fall back to full scan

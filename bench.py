@@ -169,7 +169,7 @@ def _bench_ragged(
     firehose regime (the reference analogue never stalls between 20k-row
     chunks, match_keywords.py:227-230).  Distinct corpora defeat
     transport-level (program, input) caching."""
-    from advanced_scrapper_tpu.obs import stages
+    from advanced_scrapper_tpu.obs import devprof, stages
 
     rng = np.random.RandomState(7)
     engine = _ragged_engine()
@@ -182,6 +182,7 @@ def _bench_ragged(
     warm_rate = n_articles / (time.perf_counter() - t0)
     corpora = [_ragged_corpus(rng, n_articles) for _ in range(n_corpora)]
     dc0 = stages.device_counters()
+    jc0 = devprof.jit_compiles_total()
     t0 = time.perf_counter()
     reps_dev = [engine.dedup_reps_async(c) for c in corpora]
     with stages.timed("resolve"):  # rep readback: the device queue drains here
@@ -191,6 +192,11 @@ def _bench_ragged(
     for r in reps:
         assert r.shape == (n_articles,)
     deltas = {k: int(dc1[k] - dc0[k]) for k in dc0}
+    # recompile sentinel, windowed like the device counters: a healthy
+    # steady state reads 0 (the warmup corpus owns every compile) — a
+    # nonzero here IS the recompile storm the prewarmed shape set exists
+    # to prevent, attributable from the JSON alone
+    deltas["jit_compiles"] = int(devprof.jit_compiles_total() - jc0)
     return warm_rate, n_articles * n_corpora / dt, deltas
 
 
@@ -208,7 +214,7 @@ def _bench_sharded(
     reported number per shard, and the max−min put skew lands on the
     ``astpu_sharded_put_skew`` gauge the declared SLO set gates at 0."""
     from advanced_scrapper_tpu.core.mesh import build_mesh, parse_mesh_shape
-    from advanced_scrapper_tpu.obs import stages
+    from advanced_scrapper_tpu.obs import devprof, stages
 
     ndev = len(jax.devices())
     spec = os.environ.get("ASTPU_BENCH_MESH")
@@ -223,6 +229,7 @@ def _bench_sharded(
     corpora = [_ragged_corpus(rng, n_articles) for _ in range(n_corpora)]
     dc0 = stages.device_counters()
     ps0 = stages.sharded_device_counters()
+    jc0 = devprof.jit_compiles_total()
     t0 = time.perf_counter()
     for c in corpora:
         rep = engine.dedup_reps_sharded(c, mesh)
@@ -231,6 +238,7 @@ def _bench_sharded(
     dc1 = stages.device_counters()
     ps1 = stages.sharded_device_counters()
     totals = {k: int(dc1[k] - dc0[k]) for k in dc0}
+    totals["jit_compiles"] = int(devprof.jit_compiles_total() - jc0)
     per_shard = {
         s: {
             k: int(ps1[s][k] - ps0.get(s, {}).get(k, 0.0)) for k in ps1[s]
@@ -480,7 +488,7 @@ def _bench_matcher(n_articles: int) -> tuple[float, float, dict]:
     timed separately from the steady best-of-3, and the always-on device
     counters window ONLY the steady passes — the per-tile 1-put/1-dispatch
     contract is a reported number, not prose."""
-    from advanced_scrapper_tpu.obs import stages
+    from advanced_scrapper_tpu.obs import devprof, stages
     from advanced_scrapper_tpu.pipeline.matcher import (
         make_verify_pool,
         match_chunk,
@@ -494,6 +502,7 @@ def _bench_matcher(n_articles: int) -> tuple[float, float, dict]:
         match_chunk(df, index, pool=pool)  # warm compile, full shape set
         warm_rate = n_articles / (time.perf_counter() - t0)
         dc0 = stages.device_counters()
+        jc0 = devprof.jit_compiles_total()
         for _ in range(3):  # best-of-N: single-shot swung 38% r3→r4
             t0 = time.perf_counter()
             out = match_chunk(df, index, pool=pool)
@@ -504,6 +513,7 @@ def _bench_matcher(n_articles: int) -> tuple[float, float, dict]:
             pool.shutdown()
     assert len(out) >= n_articles // 8, "planted mentions must match"
     deltas = {k: int(dc1[k] - dc0[k]) for k in dc0}
+    deltas["jit_compiles"] = int(devprof.jit_compiles_total() - jc0)
     return warm_rate, n_articles / dt, deltas
 
 
@@ -711,10 +721,14 @@ def _looks_like_transport_death(e: BaseException) -> bool:
     return False
 
 
-def _reexec_cpu_fallback() -> None:
+def _reexec_cpu_fallback(reason: str = "") -> None:
     """Re-run this script on a scrubbed single-CPU env, labeled
     ``platform: cpu-fallback`` (numbers never silently compared against
-    TPU rounds); exits with the child's return code."""
+    TPU rounds); exits with the child's return code.  ``reason`` rides
+    ``ASTPU_BENCH_FALLBACK_REASON`` into the child so the result JSON's
+    platform fingerprint records WHY the chip was abandoned — the
+    BENCH_r05 shape (a fallback diagnosed from stderr archaeology) is
+    structurally impossible now."""
     import subprocess
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -723,6 +737,8 @@ def _reexec_cpu_fallback() -> None:
 
     env = virtual_mesh_env(dict(os.environ), 1)
     env["ASTPU_BENCH_PLATFORM_FALLBACK"] = "1"
+    if reason:
+        env["ASTPU_BENCH_FALLBACK_REASON"] = reason
     raise SystemExit(
         subprocess.run(
             # forward argv (--regime ...) so the fallback child measures
@@ -776,7 +792,10 @@ def _jax_or_cpu_fallback(timeout_s: float = 240.0):
                     f"({type(probe_error[0]).__name__}: {probe_error[0]}); "
                     "re-running on CPU with platform=cpu-fallback\n"
                 )
-                _reexec_cpu_fallback()
+                _reexec_cpu_fallback(
+                    f"backend init failed: "
+                    f"{type(probe_error[0]).__name__}: {probe_error[0]}"
+                )
             raise probe_error[0]
         import jax
 
@@ -790,7 +809,29 @@ def _jax_or_cpu_fallback(timeout_s: float = 240.0):
         f"bench: device backend init hung >{timeout_s:.0f}s (dead tunnel?); "
         "re-running on CPU with platform=cpu-fallback\n"
     )
-    _reexec_cpu_fallback()
+    _reexec_cpu_fallback(f"backend init hung >{timeout_s:.0f}s (dead tunnel?)")
+
+
+def _platform_fingerprint(jax, platform: str) -> dict:
+    """The top-level platform stamp every result JSON now carries:
+    backend, device kind/count, the cpu-fallback reason when the chip was
+    abandoned, and the git sha — so a number can never again be compared
+    against the wrong platform without the JSON itself saying so
+    (``obs/perfdb.py`` partitions its trajectories on exactly this)."""
+    from advanced_scrapper_tpu.obs import perfdb
+
+    devs = jax.devices()
+    fp = {
+        "backend": platform,
+        "device_kind": getattr(devs[0], "device_kind", "unknown"),
+        "device_count": len(devs),
+        "git_sha": perfdb.git_sha(os.path.dirname(os.path.abspath(__file__))),
+    }
+    if platform == "cpu-fallback":
+        fp["cpu_fallback_reason"] = (
+            os.environ.get("ASTPU_BENCH_FALLBACK_REASON") or "unknown"
+        )
+    return fp
 
 
 def _bench_slo_engine():
@@ -978,6 +1019,9 @@ def main(argv=None) -> None:
         from advanced_scrapper_tpu.obs import stages
 
         mesh = build_mesh(len(jax.devices()), 1)
+        # the platform fingerprint enumerates devices, so it sits inside
+        # the transport-death handler like everything else tunnel-facing
+        out["platform_fingerprint"] = _platform_fingerprint(jax, platform)
         note(f"platform={platform} devices={len(jax.devices())} batch={batch}")
         with xla_trace(os.environ.get("ASTPU_TRACE_DIR") or None):
             uniform = None
@@ -1166,11 +1210,55 @@ def main(argv=None) -> None:
                 f"bench: device transport died mid-run ({type(e).__name__}: "
                 f"{e}); re-running on CPU with platform=cpu-fallback\n"
             )
-            _reexec_cpu_fallback()
+            _reexec_cpu_fallback(
+                f"transport died mid-run: {type(e).__name__}: {e}"
+            )
         raise
 
     out["stage_ms"] = stage_ms
     out["telemetry"] = _telemetry_ledger(slo_engine)
+
+    # bench-history fold (obs/perfdb.py): judge this run against the
+    # checked-in rounds + the optional ledger, SAME platform only — a
+    # cpu-fallback run is never held against an on-chip round.  The
+    # verdict rides the SLO block as an objective-shaped entry but does
+    # NOT flip the run's top-level ok: per-regime SLOs gate THIS run,
+    # the history verdict is cross-run archaeology (the report tool is
+    # where it escalates).  ASTPU_PERF_LEDGER=path additionally appends
+    # this run as a row, so every bench run grows the trajectory.
+    from advanced_scrapper_tpu.obs import perfdb
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    ledger_path = os.environ.get("ASTPU_PERF_LEDGER") or None
+    try:
+        hist = perfdb.bench_history_verdict(
+            out, repo_dir=here, ledger_path=ledger_path
+        )
+    except Exception as e:  # archaeology must never kill a bench record
+        hist = {"error": f"{type(e).__name__}: {e}"}
+    out["perf_history"] = hist
+    slo_v = (out.get("telemetry") or {}).get("slo")
+    if isinstance(slo_v, dict) and "regressions" in hist:
+        slo_v.setdefault("objectives", []).append(
+            {
+                "name": "perf_history_regressions",
+                "kind": "gauge_max",
+                "metric": "perf_history.regressions",
+                "threshold": 0,
+                "value": hist["regressions"],
+                "ok": hist["regressions"] == 0,
+                "advisory": True,
+                "platform": hist.get("platform"),
+                "compared_against": hist.get("compared_against"),
+            }
+        )
+    if ledger_path:
+        try:
+            perfdb.PerfLedger(ledger_path).ingest_result(
+                out, source=f"bench-{time.strftime('%Y%m%d-%H%M%S')}"
+            )
+        except OSError as e:
+            note(f"perf ledger append failed: {e}")
     if uniform is not None:
         # MFU-style utilisation is only meaningful against the v5e peak the
         # constant describes — null on cpu-fallback rounds

@@ -18,6 +18,10 @@ Two modes:
   ``--url`` at a metrics collector (``tools/obs_fleet.py``) for
   per-process endpoint health/staleness, per-instance headline rates,
   harvested crash sidecars (which shard died), and SLO verdicts.
+- ``--prof`` (combinable with ``--once``): the continuous-profiler view —
+  fetch ``GET /profile`` (a process under ``ASTPU_PROFILE``, or a
+  collector's merged fleet view) and render the hottest folded stacks
+  with sample shares (``--prof-top`` rows).
 - live (default): the :class:`obs.console.ConsoleMux` idiom — a sticky
   one-line summary repainted in place (per-stage rates computed from
   successive histogram snapshots, queue depths, fleet health) with notable
@@ -51,6 +55,74 @@ WATCHED_EVENTS = (
 def fetch_status(url: str, timeout: float = 5.0) -> dict:
     with urllib.request.urlopen(url.rstrip("/") + "/status", timeout=timeout) as r:
         return json.loads(r.read())
+
+
+def fetch_profile(url: str, timeout: float = 5.0) -> str:
+    with urllib.request.urlopen(url.rstrip("/") + "/profile", timeout=timeout) as r:
+        return r.read().decode("utf-8", errors="replace")
+
+
+def parse_profile(text: str) -> tuple[list[tuple[str, int]], list[str]]:
+    """Folded-stack text → ``(stacks sorted hottest-first, header
+    comments)``; malformed lines are skipped (the format is
+    whitespace-split with a trailing count)."""
+    stacks: list[tuple[str, int]] = []
+    headers: list[str] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            headers.append(line)
+            continue
+        stack, _sep, count = line.rpartition(" ")
+        if not stack:
+            continue
+        try:
+            stacks.append((stack, int(count)))
+        except ValueError:
+            continue
+    stacks.sort(key=lambda kv: (-kv[1], kv[0]))
+    return stacks, headers
+
+
+def render_prof_frame(text: str, top: int = 20) -> list[str]:
+    """The ``--prof`` frame: hottest stacks by sample share, leaf-first
+    (the leaf names the hot code; the compressed root path gives the
+    tower it lives in)."""
+    stacks, headers = parse_profile(text)
+    lines = list(headers)
+    total = sum(c for _s, c in stacks)
+    if not stacks:
+        lines.append("(no samples — is ASTPU_PROFILE set on the target?)")
+        return lines
+    lines.append(f"{'samples':>8}  {'share':>6}  hottest stacks (leaf ← root)")
+    for stack, count in stacks[:top]:
+        frames = stack.split(";")
+        leaf = frames[-1]
+        root_path = "←".join(frames[:-1][-3:])  # the 3 frames above the leaf
+        lines.append(
+            f"{count:>8}  {count / total:>6.1%}  {leaf}"
+            + (f"  [{root_path}]" if root_path else "")
+        )
+    if len(stacks) > top:
+        rest = sum(c for _s, c in stacks[top:])
+        lines.append(
+            f"{rest:>8}  {rest / total:>6.1%}  ({len(stacks) - top} more stacks)"
+        )
+    return lines
+
+
+def prof_summary_line(text: str) -> str:
+    stacks, _headers = parse_profile(text)
+    total = sum(c for _s, c in stacks)
+    if not stacks:
+        return "prof: no samples yet"
+    leaf = stacks[0][0].split(";")[-1]
+    return (
+        f"prof: {total} samples over {len(stacks)} stacks | "
+        f"hottest {leaf} {stacks[0][1] / total:.0%}"
+    )
 
 
 def _series_key(m: dict) -> str:
@@ -391,11 +463,30 @@ def main(argv=None) -> int:
         "sidecars and SLO verdicts",
     )
     ap.add_argument(
+        "--prof",
+        action="store_true",
+        help="profiler view: render GET /profile's hottest folded stacks "
+        "(a process under ASTPU_PROFILE, or a collector's merged view)",
+    )
+    ap.add_argument(
+        "--prof-top", type=int, default=20,
+        help="stacks shown in the --prof frame",
+    )
+    ap.add_argument(
         "--frames", type=int, default=0, help="stop after N polls (0 = forever)"
     )
     args = ap.parse_args(argv)
 
     if args.once:
+        if args.prof:
+            try:
+                text = fetch_profile(args.url)
+            except OSError as e:
+                print(f"obs_top: cannot reach {args.url}: {e}", file=sys.stderr)
+                return 1
+            head = f"obs_top --prof @ {time.strftime('%H:%M:%S')}"
+            print("\n".join([head] + render_prof_frame(text, args.prof_top)))
+            return 0
         try:
             status = fetch_status(args.url)
         except OSError as e:
@@ -413,6 +504,29 @@ def main(argv=None) -> int:
             lines = [head] + lines
         print("\n".join(lines))
         return 0
+
+    if args.prof:
+        # live profiler mode: the sticky line tracks total samples + the
+        # hottest leaf; ^C exits like the other live views
+        from advanced_scrapper_tpu.obs.console import ConsoleMux, red
+
+        mux = ConsoleMux().start()
+        n = 0
+        try:
+            while True:
+                try:
+                    mux.stats(prof_summary_line(fetch_profile(args.url)))
+                except OSError as e:
+                    mux.stats(red(f"unreachable: {e}"))
+                n += 1
+                if args.frames and n >= args.frames:
+                    return 0
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            mux.stop()
+            print()
 
     from advanced_scrapper_tpu.obs.console import ConsoleMux, green, red
 

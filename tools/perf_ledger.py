@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Perf-ledger CLI: ingest round artifacts, report platform trajectories.
+
+The repo root carries every measurement round ever taken (``BENCH_*.json``
+/ ``MULTICHIP_*.json`` / ``SOAK_*.json``), and ``obs/perfdb.py`` turns
+them — plus any appended ledger rows from bench runs and on-chip sweeps —
+into per-platform trajectories with regression/improvement verdicts that
+NEVER compare across platforms (a cpu-fallback round is data about the
+fallback, not about the chip).
+
+Usage::
+
+    python tools/perf_ledger.py report                 # scan repo rounds
+    python tools/perf_ledger.py report --format json   # machine-readable
+    python tools/perf_ledger.py report --ledger perf_ledger.jsonl
+    python tools/perf_ledger.py ingest BENCH_r05.json --ledger L.jsonl
+    python tools/perf_ledger.py ingest --scan --ledger L.jsonl
+
+``report`` reads the checked-in artifacts directly (no ledger file
+needed) and merges in ``--ledger`` rows when given; ``ingest`` appends
+artifact rows into a ledger (deduped by source name).  Exit code: 0 on a
+clean report, 2 when the latest same-platform comparison found at least
+one regression (``--quiet-regressions`` suppresses that, for cron use).
+
+Deliberately jax-free (stdlib + the jax-free ``obs.perfdb``): this tool
+must run on a box whose tunnel is dead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+from advanced_scrapper_tpu.obs import perfdb  # noqa: E402
+
+
+def _gather_rows(args) -> list[dict]:
+    rows = perfdb.scan_repo_artifacts(args.repo)
+    if args.ledger and os.path.exists(args.ledger):
+        seen = {r.get("source") for r in rows}
+        for row in perfdb.PerfLedger(args.ledger).rows():
+            if row.get("source") not in seen:
+                rows.append(row)
+    return rows
+
+
+def cmd_report(args) -> int:
+    rows = _gather_rows(args)
+    if not rows:
+        print("perf_ledger: no rows (no artifacts found, empty ledger)",
+              file=sys.stderr)
+        return 1
+    report = perfdb.build_report(rows, threshold=args.threshold)
+    if args.format == "json":
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(perfdb.report_markdown(report))
+    if report["summary"]["regression"] and not args.quiet_regressions:
+        return 2
+    return 0
+
+
+def cmd_ingest(args) -> int:
+    if not args.ledger:
+        print("perf_ledger ingest: --ledger PATH is required", file=sys.stderr)
+        return 1
+    ledger = perfdb.PerfLedger(args.ledger)
+    paths = list(args.paths)
+    if args.scan:
+        paths += [
+            os.path.join(args.repo, fn)
+            for fn in sorted(os.listdir(args.repo))
+            if fn.endswith(".json")
+            and fn.split("_")[0] in ("BENCH", "MULTICHIP", "SOAK")
+        ]
+    if not paths:
+        print("perf_ledger ingest: nothing to ingest (pass paths or --scan)",
+              file=sys.stderr)
+        return 1
+    n = ledger.ingest_artifacts(paths)
+    print(f"perf_ledger: {n} new row(s) -> {args.ledger}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--repo", default=HERE,
+        help="repo root holding the checked-in round artifacts",
+    )
+    ap.add_argument(
+        "--ledger", default=os.environ.get("ASTPU_PERF_LEDGER") or None,
+        help="JSONL ledger path (default: $ASTPU_PERF_LEDGER)",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser("report", help="platform-partitioned trajectory report")
+    rp.add_argument("--format", choices=("md", "json"), default="md")
+    rp.add_argument(
+        "--threshold", type=float, default=perfdb.DEFAULT_THRESHOLD,
+        help="relative-change band treated as stable (default 0.10)",
+    )
+    rp.add_argument(
+        "--quiet-regressions", action="store_true",
+        help="exit 0 even when the latest comparison shows regressions",
+    )
+    rp.set_defaults(fn=cmd_report)
+    ip = sub.add_parser("ingest", help="append artifact rows to the ledger")
+    ip.add_argument("paths", nargs="*", help="result JSON files to ingest")
+    ip.add_argument(
+        "--scan", action="store_true",
+        help="also ingest every checked-in BENCH_/MULTICHIP_/SOAK_ artifact",
+    )
+    ip.set_defaults(fn=cmd_ingest)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

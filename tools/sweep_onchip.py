@@ -17,6 +17,15 @@ Interpret: take the best stream/ragged rows, set
 ``ASTPU_BENCH_FEED_WORKERS`` / ``ASTPU_DEDUP_PUT_WORKERS`` /
 ``ASTPU_BENCH_BATCH`` accordingly, then run ``python bench.py`` for the
 round record.
+
+Every successful sweep point also lands in the perf ledger
+(``obs/perfdb.py``; ``--ledger``, default ``$ASTPU_PERF_LEDGER`` or
+``<out>.ledger.jsonl``) stamped with the probed platform — so the first
+tunnel window auto-produces comparable same-platform history instead of
+one more orphaned JSONL.  After the grid, the best point of each regime
+re-runs ONCE under ``ASTPU_TRACE_DIR`` (``--trace-dir``; '' disables) so
+each regime's best configuration leaves an XLA trace to read against its
+rate.
 """
 
 from __future__ import annotations
@@ -44,6 +53,7 @@ import bench
 from advanced_scrapper_tpu.core.hashing import make_params
 from advanced_scrapper_tpu.core.mesh import build_mesh
 from advanced_scrapper_tpu.cpu.hostbatch import HostBatcher
+from advanced_scrapper_tpu.obs.profiler import xla_trace
 from advanced_scrapper_tpu.parallel.sharded import make_sharded_dedup, shard_batch
 from advanced_scrapper_tpu.pipeline.feed import DeviceFeed
 
@@ -63,14 +73,17 @@ def produce():
 t0 = time.perf_counter()
 threading.Thread(target=produce, daemon=True).start()
 pending = []
-for n, tok_dev, len_dev, tags in feed:
-    rep, _h = step(tok_dev, len_dev)
-    try:
-        rep.copy_to_host_async()   # same readback overlap as bench._bench_stream
-    except AttributeError:
-        pass
-    pending.append((rep, tags, n))
-outs = [tags[np.asarray(rep)[:n]] for rep, tags, n in pending]
+# ASTPU_TRACE_DIR (the best-point re-run sets it): the measured region
+# leaves an XLA trace; unset = xla_trace is a no-op
+with xla_trace(os.environ.get("ASTPU_TRACE_DIR") or None):
+    for n, tok_dev, len_dev, tags in feed:
+        rep, _h = step(tok_dev, len_dev)
+        try:
+            rep.copy_to_host_async()   # same readback overlap as bench._bench_stream
+        except AttributeError:
+            pass
+        pending.append((rep, tags, n))
+    outs = [tags[np.asarray(rep)[:n]] for rep, tags, n in pending]
 dt = time.perf_counter() - t0
 feed.join()
 total = batch * n_batches
@@ -93,9 +106,11 @@ rng = np.random.RandomState(7)
 engine = NearDupEngine(DedupConfig(put_workers={put_workers}))
 engine.dedup_reps(bench._ragged_corpus(rng, n))      # warm all shapes
 corpus = bench._ragged_corpus(rng, n)
-t0 = time.perf_counter()
-rep = np.asarray(engine.dedup_reps_async(corpus))[:n]
-dt = time.perf_counter() - t0
+from advanced_scrapper_tpu.obs.profiler import xla_trace
+with xla_trace(os.environ.get("ASTPU_TRACE_DIR") or None):
+    t0 = time.perf_counter()
+    rep = np.asarray(engine.dedup_reps_async(corpus))[:n]
+    dt = time.perf_counter() - t0
 print(json.dumps({{"articles_per_sec": round(n / dt, 1)}}))
 """
 
@@ -120,9 +135,11 @@ engine.prewarm_sharded(mesh, n)                       # warm the shape set
 engine.dedup_reps_sharded(bench._ragged_corpus(rng, n), mesh)
 corpus = bench._ragged_corpus(rng, n)
 ps0 = stages.sharded_device_counters()
-t0 = time.perf_counter()
-rep = engine.dedup_reps_sharded(corpus, mesh)
-dt = time.perf_counter() - t0
+from advanced_scrapper_tpu.obs.profiler import xla_trace
+with xla_trace(os.environ.get("ASTPU_TRACE_DIR") or None):
+    t0 = time.perf_counter()
+    rep = engine.dedup_reps_sharded(corpus, mesh)
+    dt = time.perf_counter() - t0
 ps1 = stages.sharded_device_counters()
 puts = sorted(
     ps1[s]["device_puts"] - ps0.get(s, {{}}).get("device_puts", 0.0)
@@ -223,14 +240,72 @@ def main() -> None:
         "(e.g. 1x8,2x4); 'auto' derives from the probed device count; "
         "'' skips the sharded axis",
     )
+    ap.add_argument(
+        "--ledger",
+        default=os.environ.get("ASTPU_PERF_LEDGER") or "",
+        help="perf-ledger JSONL every successful sweep point appends to "
+        "(default: $ASTPU_PERF_LEDGER, else <out>.ledger.jsonl; "
+        "tools/perf_ledger.py report reads it)",
+    )
+    ap.add_argument(
+        "--trace-dir",
+        default=os.path.join(HERE, "sweep_traces"),
+        help="after the grid, re-run each regime's best point once under "
+        "ASTPU_TRACE_DIR=<trace-dir>/<regime> to capture an XLA trace "
+        "('' disables)",
+    )
     args = ap.parse_args()
+    ledger_path = args.ledger or (args.out + ".ledger.jsonl")
 
     env = dict(os.environ)  # default env: the axon chip when healthy
+    # jax-free by construction: obs.perfdb is stdlib-only, and this
+    # parent must never touch a backend import (a dead tunnel hangs them)
+    from advanced_scrapper_tpu.obs import perfdb
 
-    def emit(rec: dict) -> None:
+    ledger = perfdb.PerfLedger(ledger_path)
+    git = perfdb.git_sha(HERE)
+    platform = "unknown"
+    #: regime → [(rate, tag, snippet)] for the best-point trace pass
+    by_regime: dict[str, list] = {}
+
+    def emit(rec: dict, snippet: str | None = None) -> None:
         print(json.dumps(rec), flush=True)
         with open(args.out, "a") as f:
             f.write(json.dumps(rec) + "\n")
+        rate = rec.get("articles_per_sec")
+        if rec.get("status") != "ok" or not isinstance(rate, (int, float)):
+            return
+        if rec["config"].endswith(":trace"):
+            # the best-point trace re-run pays jax.profiler overhead —
+            # ledgering it as the newest same-platform row would read as
+            # a fresh regression caused by the sweep's own tracing pass
+            return
+        regime = rec["config"].split(":", 1)[0]
+        if snippet is not None:
+            by_regime.setdefault(regime, []).append(
+                (float(rate), rec["config"], snippet)
+            )
+        try:
+            ledger.append(
+                {
+                    "schema": perfdb.SCHEMA,
+                    "kind": "sweep",
+                    "source": f"sweep:{rec['config']}",
+                    # None, not inf: json.dumps(inf) emits the
+                    # non-standard Infinity token (perfdb._round_order
+                    # has the same rule); None sorts after every rNN row
+                    "order": None,
+                    "ts": time.time(),
+                    "platform": platform,
+                    "fingerprint": None,
+                    "git_sha": git,
+                    "metrics": {
+                        f"{regime}_articles_per_sec": float(rate),
+                    },
+                }
+            )
+        except OSError as e:
+            print(f"sweep: ledger append failed: {e}", file=sys.stderr)
 
     # 0) transport probe under its own watchdog — if this fails, stop early
     probe = run_config("probe", PROBE_SNIPPET, env, min(args.timeout, 300.0))
@@ -238,44 +313,49 @@ def main() -> None:
     if probe["status"] != "ok":
         print("sweep: device probe failed — tunnel down, aborting", file=sys.stderr)
         raise SystemExit(1)
+    # the ledger's platform partition: same grammar as the bench
+    # fingerprint key, so sweep points and bench rounds on the same
+    # transport compare (and cpu dev-box dryruns never do)
+    platform = f"{probe.get('platform', 'unknown')}/swept-x{probe.get('n', '?')}"
 
     batch = 16384 if args.quick else 65536
     n_batches = 2 if args.quick else 4
     ragged_n = 2048 if args.quick else 8192
 
     for workers in (1, 2, 4, 8):
+        snip = STREAM_SNIPPET.format(
+            here=HERE, batch=batch, block=1024,
+            n_batches=n_batches, workers=workers,
+        )
         emit(
             run_config(
                 f"stream:batch={batch},feed_workers={workers}",
-                STREAM_SNIPPET.format(
-                    here=HERE, batch=batch, block=1024,
-                    n_batches=n_batches, workers=workers,
-                ),
-                env,
-                args.timeout,
-            )
+                snip, env, args.timeout,
+            ),
+            snip,
         )
     # batch-size axis at the best-known worker count
     for b in ((8192, 32768) if args.quick else (16384, 32768, 131072)):
+        snip = STREAM_SNIPPET.format(
+            here=HERE, batch=b, block=1024,
+            n_batches=n_batches, workers=4,
+        )
         emit(
             run_config(
-                f"stream:batch={b},feed_workers=4",
-                STREAM_SNIPPET.format(
-                    here=HERE, batch=b, block=1024,
-                    n_batches=n_batches, workers=4,
-                ),
-                env,
-                args.timeout,
-            )
+                f"stream:batch={b},feed_workers=4", snip, env, args.timeout
+            ),
+            snip,
         )
     for pw in (1, 2, 4, 8):
+        snip = RAGGED_SNIPPET.format(
+            here=HERE, put_workers=pw, n_articles=ragged_n
+        )
         emit(
             run_config(
-                f"ragged:n={ragged_n},put_workers={pw}",
-                RAGGED_SNIPPET.format(here=HERE, put_workers=pw, n_articles=ragged_n),
-                env,
+                f"ragged:n={ragged_n},put_workers={pw}", snip, env,
                 args.timeout,
-            )
+            ),
+            snip,
         )
     # mesh-shape axis: the sharded packed plane (per-shard fused donated
     # tiles) swept over (data, seq) factorisations × put workers, so the
@@ -284,17 +364,32 @@ def main() -> None:
         shapes = _mesh_shapes(args.mesh, int(probe.get("n", 1)))
         for dp, sp in shapes:
             for pw in (1, 4):
+                snip = SHARDED_SNIPPET.format(
+                    here=HERE, n_articles=ragged_n,
+                    dp=dp, sp=sp, put_workers=pw,
+                )
                 emit(
                     run_config(
                         f"sharded:n={ragged_n},mesh={dp}x{sp},put_workers={pw}",
-                        SHARDED_SNIPPET.format(
-                            here=HERE, n_articles=ragged_n,
-                            dp=dp, sp=sp, put_workers=pw,
-                        ),
-                        env,
-                        args.timeout,
-                    )
+                        snip, env, args.timeout,
+                    ),
+                    snip,
                 )
+
+    # best-point XLA traces: one re-run per regime at its winning config,
+    # with ASTPU_TRACE_DIR plumbed through the snippet's xla_trace wrap —
+    # the tunnel window's sweep leaves a kernel timeline to read against
+    # each best rate, not just a number
+    if args.trace_dir:
+        for regime, entries in sorted(by_regime.items()):
+            rate, tag, snip = max(entries, key=lambda e: e[0])
+            tdir = os.path.join(args.trace_dir, regime)
+            os.makedirs(tdir, exist_ok=True)
+            tenv = dict(env, ASTPU_TRACE_DIR=tdir)
+            rec = run_config(f"{tag}:trace", snip, tenv, args.timeout)
+            rec["trace_dir"] = tdir
+            rec["traced_best_of"] = {"config": tag, "articles_per_sec": rate}
+            emit(rec)
     print(f"sweep complete -> {args.out}", file=sys.stderr)
 
 
