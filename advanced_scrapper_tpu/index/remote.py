@@ -158,6 +158,12 @@ class IndexShardServer:
                 "scrub": self._h_scrub,
                 "snapshot": self._h_snapshot,
                 "fetch_file": self._h_fetch_file,
+                # the reshard control plane: handed-off range marks + the
+                # mid-cutover fence (index/reshard.py drives these through
+                # the fleet client's two-phase cutover)
+                "retire_range": self._h_retire_range,
+                "unretire_range": self._h_unretire_range,
+                "reshard_mark": self._h_reshard_mark,
             },
             host=host,
             port=port,
@@ -305,28 +311,54 @@ class IndexShardServer:
 
     def _h_digest(self, header, arrays):
         """Bucketed key-space digest of the SEMANTIC state — the
-        anti-entropy comparison unit (``index/repair.py``)."""
+        anti-entropy comparison unit (``index/repair.py``).
+
+        ``mixed`` mode buckets by the key's RING POSITION (``mix64``) and
+        restricts to positions in ``[lo, hi)`` when given — the reshard
+        cutover's digest gate compares one migrating ring arc between its
+        old and new owner this way (raw-key bucketing could never name a
+        ring arc: ring position decorrelates the two spaces by design)."""
         idx = self._space(header)
         bits = int(header.get("bits", antientropy.DEFAULT_BITS))
-        dig, cnt = antientropy.bucket_digests(*idx.semantic_items(), bits)
+        keys, docs = idx.semantic_items()
+        if header.get("mixed"):
+            pos = antientropy.mix64(keys)
+            if "lo" in header:
+                lo, hi = int(header["lo"]), int(header["hi"])
+                m = pos >= np.uint64(lo)
+                if hi < antientropy.KEY_SPACE_END:
+                    m &= pos < np.uint64(hi)
+                keys, docs, pos = keys[m], docs[m], pos[m]
+            dig, cnt = antientropy.bucket_digests(keys, docs, bits, positions=pos)
+        else:
+            dig, cnt = antientropy.bucket_digests(keys, docs, bits)
         return {"bits": bits}, [dig, cnt]
 
     def _h_fetch_range(self, header, arrays):
         """Semantic ``(key, min-doc)`` pairs with key in ``[lo, hi)`` —
         paged like ``dump`` so a hot bucket can never build a frame past
-        the cap.  ``hi`` may be 2**64 (the last bucket's open end)."""
+        the cap.  ``hi`` may be 2**64 (the last bucket's open end).
+        ``mixed`` selects by ring position instead of raw key — the
+        migration stream's page source."""
         idx = self._space(header)
         lo, hi = int(header["lo"]), int(header["hi"])
         keys, docs = idx.semantic_items()
-        # semantic keys are sorted: the [lo, hi) slice is two binary
-        # searches, not a full-array mask per page
-        i0 = int(np.searchsorted(keys, np.uint64(lo), side="left"))
-        i1 = (
-            keys.size
-            if hi >= antientropy.KEY_SPACE_END
-            else int(np.searchsorted(keys, np.uint64(hi), side="left"))
-        )
-        keys, docs = keys[i0:i1], docs[i0:i1]
+        if header.get("mixed"):
+            pos = antientropy.mix64(keys)
+            m = pos >= np.uint64(lo)
+            if hi < antientropy.KEY_SPACE_END:
+                m &= pos < np.uint64(hi)
+            keys, docs = keys[m], docs[m]
+        else:
+            # semantic keys are sorted: the [lo, hi) slice is two binary
+            # searches, not a full-array mask per page
+            i0 = int(np.searchsorted(keys, np.uint64(lo), side="left"))
+            i1 = (
+                keys.size
+                if hi >= antientropy.KEY_SPACE_END
+                else int(np.searchsorted(keys, np.uint64(hi), side="left"))
+            )
+            keys, docs = keys[i0:i1], docs[i0:i1]
         total = int(keys.size)
         off = int(header.get("offset", 0))
         limit = header.get("limit")
@@ -366,9 +398,42 @@ class IndexShardServer:
         )
         return {"bytes": len(data)}, [np.frombuffer(data, np.uint8)]
 
+    def _h_retire_range(self, header, arrays):
+        """Mark ring range ``[lo, hi)`` handed off (idempotent, one
+        atomic manifest write) — the cutover's last step per range."""
+        idx = self._space(header)
+        idx.retire_range(int(header["lo"]), int(header["hi"]))
+        return {"handed_off": len(idx.handed_off_ranges())}
+
+    def _h_unretire_range(self, header, arrays):
+        """Re-acquire a previously handed-off range (the N→M→N round
+        trip) — idempotent."""
+        idx = self._space(header)
+        idx.unretire_range(int(header["lo"]), int(header["hi"]))
+        return {"handed_off": len(idx.handed_off_ranges())}
+
+    def _h_reshard_mark(self, header, arrays):
+        """Set/clear/read the mid-reshard fence on every space this node
+        hosts (a reshard moves the whole node's ring slice, not one
+        space's)."""
+        op = header.get("op", "get")
+        if op == "set":
+            for idx in self.indexes.values():
+                idx.set_reshard_mark(str(header["token"]))
+        elif op == "clear":
+            for idx in self.indexes.values():
+                idx.clear_reshard_mark()
+        elif op != "get":
+            raise ValueError(f"reshard_mark op must be set/clear/get, not {op!r}")
+        return {
+            "marks": {
+                sp: idx.reshard_mark() for sp, idx in self.indexes.items()
+            }
+        }
+
 
 def paged_fetch_range(
-    call, lo: int, hi: int, *, page: int = 1 << 18
+    call, lo: int, hi: int, *, page: int = 1 << 18, mixed: bool = False
 ) -> tuple[np.ndarray, np.ndarray]:
     """The ONE ``fetch_range`` pagination loop (offset/total/empty-page
     termination), shared by :class:`RemoteIndex` and the fleet client's
@@ -378,9 +443,10 @@ def paged_fetch_range(
     parts_k, parts_d = [], []
     off = 0
     while True:
-        h, (keys, docs) = call(
-            {"lo": int(lo), "hi": int(hi), "offset": off, "limit": int(page)}
-        )
+        header = {"lo": int(lo), "hi": int(hi), "offset": off, "limit": int(page)}
+        if mixed:
+            header["mixed"] = True
+        h, (keys, docs) = call(header)
         parts_k.append(np.asarray(keys, np.uint64))
         parts_d.append(np.asarray(docs, np.uint64))
         off += int(parts_k[-1].size)
@@ -489,19 +555,47 @@ class RemoteIndex:
 
     # -- self-healing plane ------------------------------------------------
 
-    def digest(self, *, bits: int | None = None):
-        h, (dig, cnt) = self._call(
-            "digest", {} if bits is None else {"bits": int(bits)}
-        )
+    def digest(
+        self,
+        *,
+        bits: int | None = None,
+        lo: int | None = None,
+        hi: int | None = None,
+        mixed: bool = False,
+    ):
+        header: dict = {} if bits is None else {"bits": int(bits)}
+        if mixed:
+            header["mixed"] = True
+            if lo is not None:
+                header["lo"], header["hi"] = int(lo), int(hi)
+        h, (dig, cnt) = self._call("digest", header)
         return dig, cnt
 
     def fetch_range(
-        self, lo: int, hi: int, *, page: int = 1 << 18
+        self, lo: int, hi: int, *, page: int = 1 << 18, mixed: bool = False
     ) -> tuple[np.ndarray, np.ndarray]:
         return paged_fetch_range(
             lambda header: self._call("fetch_range", header),
-            lo, hi, page=page,
+            lo, hi, page=page, mixed=mixed,
         )
+
+    # -- reshard control plane ----------------------------------------------
+
+    def retire_range(self, lo: int, hi: int) -> None:
+        self._call("retire_range", {"lo": int(lo), "hi": int(hi)})
+
+    def unretire_range(self, lo: int, hi: int) -> None:
+        self._call("unretire_range", {"lo": int(lo), "hi": int(hi)})
+
+    def set_reshard_mark(self, token: str) -> None:
+        self._call("reshard_mark", {"op": "set", "token": str(token)})
+
+    def clear_reshard_mark(self) -> None:
+        self._call("reshard_mark", {"op": "clear"})
+
+    def reshard_marks(self) -> dict:
+        h, _ = self._call("reshard_mark", {"op": "get"})
+        return h["marks"]
 
     def scrub(self) -> dict:
         h, _ = self._call("scrub")

@@ -200,6 +200,18 @@ class PersistentIndex:
         self._next_doc_id = int(man.get("next_doc_id", 0))
         if md.size:
             self._next_doc_id = max(self._next_doc_id, int(md.max()) + 1)
+        #: ring ranges (mixed space, [lo, hi) Python ints) this node has
+        #: legitimately handed off to a new owner: physically present
+        #: postings inside them are excluded from every semantic read
+        #: (probe/dump/digest) and new inserts for them are dropped —
+        #: logical tombstones, so replicas retired at different instants
+        #: still digest-agree and fsck sees handoff, not loss
+        self._handed_off: list[tuple[int, int]] = [
+            (int(a), int(b)) for a, b in man.get("handed_off", [])
+        ]
+        #: active reshard fence ({"token": ...}) — snapshot tooling
+        #: refuses to capture a node mid-cutover
+        self._reshard_mark: dict | None = man.get("reshard") or None
         #: (state key, (keys, docs), warmed-at) — see semantic_items
         self._semantic_cache = None
         if read_only:
@@ -257,7 +269,7 @@ class PersistentIndex:
 
     def _manifest_dict(self) -> dict:
         names = [os.path.basename(s.path) for s in self._segments]
-        return {
+        man = {
             "version": 1,
             "seg_seq": self._seg_seq,
             "wal_seq": self._wal_seq,
@@ -268,6 +280,11 @@ class PersistentIndex:
             # transfer-verification source
             "digests": {n: self._digests[n] for n in names if n in self._digests},
         }
+        if self._handed_off:
+            man["handed_off"] = [[a, b] for a, b in self._handed_off]
+        if self._reshard_mark:
+            man["reshard"] = dict(self._reshard_mark)
+        return man
 
     def _write_manifest(self) -> None:
         """Atomic commit point for every structural change (cut, compact,
@@ -447,6 +464,7 @@ class PersistentIndex:
             return (
                 self._seg_seq, self._wal_seq, self._mem_count,
                 tuple(os.path.basename(s.path) for s in self._segments),
+                tuple(self._handed_off),
             )
 
     def _age_semantic_cache(self) -> None:
@@ -503,6 +521,70 @@ class PersistentIndex:
         with self._fs.open(os.path.join(self.dir, name), "rb") as fh:
             fh.seek(int(offset))
             return fh.read(-1 if limit is None else int(limit))
+
+    # -- resharding: handed-off ranges + cutover fence -----------------------
+
+    def retire_range(self, lo: int, hi: int) -> None:
+        """Record that ring range ``[lo, hi)`` (mixed space) was handed
+        off to a new owner: one atomic manifest write, idempotent, after
+        which every semantic read excludes the range and inserts for it
+        are dropped.  Logical — no postings are physically deleted (the
+        next compaction naturally rewrites without them being special)."""
+        from advanced_scrapper_tpu.index.repair import interval_add
+
+        self._check_writable()
+        with self._lock:
+            merged = interval_add(self._handed_off, int(lo), int(hi))
+            if merged == self._handed_off:
+                return
+            self._handed_off = merged
+            self._semantic_cache = None
+            self._write_manifest()
+
+    def unretire_range(self, lo: int, hi: int) -> None:
+        """Re-acquire ``[lo, hi)`` — the N→M→N round trip hands an arc
+        back to a node that once retired it; from this write on, inserts
+        for the range land again.  (Postings resident from BEFORE the
+        original handoff become visible again too — strictly older
+        attributions the incoming migration stream re-asserts, and the
+        cutover digest gate verifies the merged state byte-for-byte
+        before this node answers reads for the range.)"""
+        from advanced_scrapper_tpu.index.repair import interval_sub
+
+        self._check_writable()
+        with self._lock:
+            cut = interval_sub(self._handed_off, int(lo), int(hi))
+            if cut == self._handed_off:
+                return
+            self._handed_off = cut
+            self._semantic_cache = None
+            self._write_manifest()
+
+    def handed_off_ranges(self) -> list[tuple[int, int]]:
+        with self._lock:
+            return list(self._handed_off)
+
+    def set_reshard_mark(self, token: str) -> None:
+        """Fence: a reshard involving this node is in flight.  Snapshot
+        tooling refuses (or waits out) marked nodes — a manifest-of-
+        manifests captured across a half-flipped range would restore a
+        fleet that disagrees with itself."""
+        self._check_writable()
+        with self._lock:
+            self._reshard_mark = {"token": str(token)}
+            self._write_manifest()
+
+    def clear_reshard_mark(self) -> None:
+        self._check_writable()
+        with self._lock:
+            if self._reshard_mark is None:
+                return
+            self._reshard_mark = None
+            self._write_manifest()
+
+    def reshard_mark(self) -> dict | None:
+        with self._lock:
+            return dict(self._reshard_mark) if self._reshard_mark else None
 
     # -- telemetry -----------------------------------------------------------
 
@@ -607,17 +689,24 @@ class PersistentIndex:
 
     def dump_postings(self) -> tuple[np.ndarray, np.ndarray]:
         """Every live posting ``(keys, docs)`` — verification surface for
-        the crash sweep's zero-lost / zero-duplicated assertions."""
+        the crash sweep's zero-lost / zero-duplicated assertions.  Keys in
+        handed-off ranges are excluded: they belong to another node now,
+        and counting them here would read as duplication fleet-wide."""
         with self._lock:
             parts = [s.arrays() for s in self._segments]
             parts += [(k, d) for k, d in zip(self._mem_keys, self._mem_docs)]
+            handed = list(self._handed_off)
         if not parts:
             e = np.zeros((0,), np.uint64)
             return e, e
-        return (
-            np.concatenate([p[0] for p in parts]),
-            np.concatenate([p[1] for p in parts]),
-        )
+        keys = np.concatenate([p[0] for p in parts])
+        docs = np.concatenate([p[1] for p in parts])
+        if handed and keys.size:
+            from advanced_scrapper_tpu.index.repair import range_mask
+
+            keep = ~range_mask(keys, handed)
+            keys, docs = keys[keep], docs[keep]
+        return keys, docs
 
     # -- doc-id allocation / attribution -------------------------------------
 
@@ -697,6 +786,17 @@ class PersistentIndex:
         self._check_writable()
         keys = np.ascontiguousarray(keys, dtype=np.uint64).ravel()
         docs = np.ascontiguousarray(docs, dtype=np.uint64).ravel()
+        if keys.size and self._handed_off:
+            # keys this node handed off are another owner's now — dropping
+            # them makes a late retry/replay harmless and keeps retired
+            # replicas digest-identical
+            from advanced_scrapper_tpu.index.repair import range_mask
+
+            with self._lock:
+                handed = list(self._handed_off)
+            keep = ~range_mask(keys, handed)
+            if not keep.all():
+                keys, docs = keys[keep], docs[keep]
         if keys.size == 0:
             return
         with self._lock:
@@ -759,6 +859,15 @@ class PersistentIndex:
                 continue
             if rows.size:
                 np.minimum.at(best, rows, docs.astype(np.int64))
+        with self._lock:
+            handed = list(self._handed_off)
+        if handed:
+            # a handed-off key must probe as absent HERE even though its
+            # postings are still physically resident — the new owner
+            # answers for it
+            from advanced_scrapper_tpu.index.repair import range_mask
+
+            best[range_mask(flat, handed)] = np.iinfo(np.int64).max
         best = best.reshape(B, -1).min(axis=1)
         out = np.where(best == np.iinfo(np.int64).max, NO_DOC, best)
         self._m_probe_rows.inc(B)

@@ -25,6 +25,11 @@ order does it all stop).  The runtime answers them once:
   names flow through), plus declared brownout steps with enter/exit
   hysteresis that consumers (RPC server, shard server, lease server,
   dedup engine) honour at their decision points.
+- :class:`Autoscaler` (``runtime/autoscaler.py``) — the elastic-fleet
+  policy head: watches admission pressure (and the SLO engine) and
+  decides WHEN shard counts change, with ladder-style enter/exit
+  hysteresis + dwell + cooldown so oscillating load never flaps
+  topology; the HOW (live resharding) is injected as callbacks.
 - :class:`FanoutPool` — a tiny Edge-fed executor for bounded parallel
   fan-out (the index fleet's per-shard RPCs ride it), so remote hops use
   the same queue abstraction as local stages.
@@ -52,6 +57,10 @@ from advanced_scrapper_tpu.runtime.admission import (
     DegradationLadder,
     LadderStep,
 )
+from advanced_scrapper_tpu.runtime.autoscaler import (
+    Autoscaler,
+    admission_pressure,
+)
 from advanced_scrapper_tpu.runtime.graph import (
     DONE,
     RETRY,
@@ -73,6 +82,7 @@ __all__ = [
     "RETRY",
     "AdmissionController",
     "AdmissionDecision",
+    "Autoscaler",
     "DegradationLadder",
     "Edge",
     "EdgeClosed",
@@ -80,6 +90,7 @@ __all__ = [
     "LadderStep",
     "PauseGate",
     "StageGraph",
+    "admission_pressure",
     "live_graphs",
     "snapshot_all",
 ]

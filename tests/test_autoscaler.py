@@ -1,0 +1,196 @@
+"""The pressure-driven autoscaler: hysteresis, dwell, cooldown, SLO gate.
+
+Every test drives :class:`Autoscaler` with an explicit ``now`` (fake
+clock), so the flap-resistance claims are exact: an oscillating load
+accumulates ZERO dwell, a hold-band dip keeps the timer armed, the middle
+band resets it, cooldown vetoes a back-to-back reshard, and capacity is
+never removed under a violated SLO.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from advanced_scrapper_tpu.runtime.autoscaler import (
+    Autoscaler,
+    admission_pressure,
+)
+
+
+class Clock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _scaler(shards: int = 2, **kw):
+    """An autoscaler with recording callbacks and the default thresholds
+    (out: arm ≥0.7 hold >0.4; in: arm ≤0.15 hold <0.3; dwell 30s,
+    cooldown 300s)."""
+    calls: list[tuple[str, int]] = []
+    clock = Clock()
+    kw.setdefault("max_shards", 8)
+    sc = Autoscaler(
+        shards,
+        scale_out=lambda t: calls.append(("out", t)),
+        scale_in=lambda t: calls.append(("in", t)),
+        clock=clock,
+        **kw,
+    )
+    return sc, clock, calls
+
+
+# -- construction ------------------------------------------------------------
+
+def test_threshold_ordering_is_validated():
+    with pytest.raises(ValueError, match="thresholds"):
+        _scaler(out_at=0.4, out_exit=0.7)  # inverted out band
+    with pytest.raises(ValueError, match="thresholds"):
+        _scaler(in_at=0.5, in_exit=0.3)  # inverted in band
+    with pytest.raises(ValueError, match="thresholds"):
+        _scaler(in_exit=0.5, out_exit=0.5)  # bands must not touch
+    with pytest.raises(ValueError, match="min_shards"):
+        _scaler(shards=2, min_shards=4)
+
+
+def test_admission_pressure_reads_the_max_gate():
+    samples = [
+        ("astpu_admission_pressure", {"gate": "a"}, 0.3),
+        ("astpu_other_gauge", {}, 9.0),
+        ("astpu_admission_pressure", {"gate": "b"}, 0.7),
+    ]
+    assert admission_pressure(samples) == 0.7
+    assert admission_pressure([]) == 0.0
+
+
+# -- flap resistance ---------------------------------------------------------
+
+def test_oscillating_pressure_never_transitions():
+    """The satellite claim, exactly: pressure flapping across the
+    scale-out threshold every 20s (dwell 30s) accumulates no dwell — the
+    middle band resets the timer every time — so over ten minutes the
+    topology never changes."""
+    sc, _clock, calls = _scaler()
+    for t in range(0, 600, 20):
+        p = 0.9 if (t // 20) % 2 == 0 else 0.35  # 0.35: the middle band
+        assert sc.observe(p, now=float(t)) == "none"
+    assert calls == []
+    assert sc.shards == 2
+    assert sc._m_trans["out"].value == 0
+    assert sc._m_trans["in"].value == 0
+
+
+def test_sustained_pressure_fires_exactly_one_scale_out():
+    sc, _clock, calls = _scaler()
+    assert sc.observe(0.9, now=0.0) == "none"  # arms
+    assert sc.observe(0.9, now=15.0) == "none"  # dwelling
+    assert sc.observe(0.9, now=31.0) == "out"  # dwell complete
+    assert calls == [("out", 4)], "power-of-two step: 2 → 4"
+    assert sc.shards == 4
+    assert sc._m_trans["out"].value == 1
+
+
+def test_hold_band_keeps_the_timer_armed():
+    """A dip that stays ABOVE out_exit does not disarm — enter/exit
+    hysteresis, not a simple threshold."""
+    sc, _clock, calls = _scaler()
+    sc.observe(0.9, now=0.0)
+    assert sc.observe(0.45, now=10.0) == "none"  # hold band (>0.4)
+    assert sc.observe(0.9, now=31.0) == "out"
+    assert calls == [("out", 4)]
+
+
+def test_middle_band_resets_the_timer():
+    sc, _clock, _calls = _scaler()
+    sc.observe(0.9, now=0.0)
+    sc.observe(0.35, now=10.0)  # middle band: timer dies
+    sc.observe(0.9, now=20.0)  # re-arms from scratch
+    assert sc.observe(0.9, now=45.0) == "none", "only 25s of dwell"
+    assert sc.observe(0.9, now=51.0) == "out"
+
+
+def test_cooldown_vetoes_back_to_back_reshards():
+    sc, _clock, calls = _scaler()
+    sc.observe(0.9, now=0.0)
+    assert sc.observe(0.9, now=31.0) == "out"
+    # pressure stays high; dwell completes again but cooldown (300s) vetoes
+    sc.observe(0.9, now=40.0)
+    assert sc.observe(0.9, now=75.0) == "none"
+    assert sc._m_blocked["cooldown"].value >= 1
+    # after the cooldown expires the armed dwell fires the second step
+    assert sc.observe(0.9, now=340.0) == "out"
+    assert calls == [("out", 4), ("out", 8)]
+    assert sc.shards == 8
+
+
+def test_bounds_block_both_directions():
+    sc, _clock, calls = _scaler(shards=4, max_shards=4, min_shards=4)
+    sc.observe(0.9, now=0.0)
+    assert sc.observe(0.9, now=31.0) == "none"
+    sc.observe(0.05, now=40.0)
+    assert sc.observe(0.05, now=71.0) == "none"
+    assert calls == []
+    assert sc._m_blocked["bounds"].value == 2
+
+
+def test_slo_gate_blocks_capacity_removal_only():
+    """Scale-in under a violated SLO is vetoed (reason recorded); the
+    moment the SLO is healthy again the still-armed dwell fires.  The
+    gate never touches scale-OUT."""
+    sc, _clock, calls = _scaler(shards=4)
+    sc.observe(0.05, now=0.0)
+    assert sc.observe(0.05, now=31.0, slo_ok=False) == "none"
+    assert sc._m_blocked["slo"].value == 1
+    assert sc.observe(0.05, now=32.0, slo_ok=True) == "in"
+    assert calls == [("in", 2)]
+    assert sc.shards == 2
+    assert sc._m_trans["in"].value == 1
+    # scale-out ignores the gate entirely
+    sc2, _c2, calls2 = _scaler()
+    sc2.observe(0.9, now=0.0)
+    assert sc2.observe(0.9, now=31.0, slo_ok=False) == "out"
+    assert calls2 == [("out", 4)]
+
+
+def test_failed_callback_keeps_the_timers_armed():
+    """A reshard that raises is NOT recorded — the transition re-attempts
+    on the next observation instead of silently losing the decision."""
+    clock = Clock()
+    attempts: list[int] = []
+
+    def flaky_out(target: int):
+        attempts.append(target)
+        if len(attempts) == 1:
+            raise RuntimeError("migration transport died")
+
+    sc = Autoscaler(
+        2, scale_out=flaky_out, scale_in=lambda t: None, clock=clock
+    )
+    sc.observe(0.9, now=0.0)
+    with pytest.raises(RuntimeError, match="transport died"):
+        sc.observe(0.9, now=31.0)
+    assert sc.shards == 2, "a failed transition must not be recorded"
+    assert sc._m_trans["out"].value == 0
+    assert sc.observe(0.9, now=32.0) == "out"  # dwell still satisfied
+    assert attempts == [4, 4]
+    assert sc.shards == 4
+
+
+def test_status_reports_armed_timers_and_cooldown():
+    sc, clock, _calls = _scaler()
+    clock.t = 10.0
+    sc.observe(0.9, now=10.0)
+    clock.t = 25.0
+    st = sc.status()
+    assert st["shards"] == 2
+    assert st["pressure"] == 0.9
+    assert st["out_armed_s"] == pytest.approx(15.0)
+    assert st["in_armed_s"] is None
+    assert st["cooldown_s"] == 0.0
+    clock.t = 41.0
+    assert sc.observe(0.9, now=41.0) == "out"
+    st = sc.status()
+    assert st["out_armed_s"] is None
+    assert st["cooldown_s"] == pytest.approx(300.0)

@@ -63,6 +63,19 @@ FLEET_REPLICAS = 2
 #: chaos-exit the primary INSIDE a WAL append syscall
 FLEET_KILL_MODES = ("insert", "probe", "promotion", "wal")
 
+#: reshard workload: a live 2→4 cutover under the planted-dup stream with
+#: the ORCHESTRATING child SIGKILLed at a seeded instant — landing mid
+#: migration stream, mid dual-write window, or mid flip — or chaos-exited
+#: INSIDE a migration-WAL (``reshard-wal-*``) write.  One replica per
+#: shard keeps the case at four server processes; the reduced vnode count
+#: keeps the plan at ~a dozen arcs so a kill window spans whole cutover
+#: lifecycles instead of the first percent of one.
+RESHARD_DOCS = 64
+RESHARD_BATCH = 8
+RESHARD_SHARDS = 2        # ring before the cutover
+RESHARD_SHARDS_NEW = 4    # ring after
+RESHARD_VNODES = 8
+
 #: overload workload: a mixed-priority storm at ≥10× the shards' declared
 #: write-admission capacity, with a mid-storm REPLICA SIGKILL — the
 #: acceptance is zero collapse, ZERO promotions (a dead replica is not a
@@ -418,6 +431,66 @@ def fleet_oracle_annotations():
     return ann, minmap
 
 
+def _reshard_doc_keys(i: int):
+    """Band keys for reshard doc ``i`` — the planted-dup scheme under its
+    own salt (never aliases fleet/overload/pindex cases)."""
+    import numpy as np
+
+    src = i - 3 if (i % 7 == 3 and i >= 3) else i
+    x = (np.arange(PINDEX_BANDS, dtype=np.uint64)
+         + np.uint64(src * 1000 + 13)) * np.uint64(0xD1B54A32D192ED03)
+    x ^= x >> np.uint64(31)
+    return x
+
+
+_RESHARD_ORACLE_CACHE: list = []
+
+
+def reshard_oracle():
+    """The never-resharded single-node truth the elastic cutover must
+    byte-match: the same fixed-doc-id posting stream through ONE
+    PersistentIndex (the reshard child posts ``doc=i`` directly — fixed
+    ids make every insert idempotent across crash/resume, so the killed
+    run and its resume converge on the same postings).  Returns
+    ``(probe answers per doc, min-doc posting map)``; memoized."""
+    if _RESHARD_ORACLE_CACHE:
+        return _RESHARD_ORACLE_CACHE[0]
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from advanced_scrapper_tpu.index import PersistentIndex
+
+    base = tempfile.mkdtemp(prefix="reshard-oracle-")
+    idx = PersistentIndex(
+        os.path.join(base, "oracle"),
+        cut_postings=6 * PINDEX_BANDS,
+        compact_segments=4,
+        compact_inline=True,
+    )
+    try:
+        for i in range(RESHARD_DOCS):
+            keys = _reshard_doc_keys(i)
+            idx.insert_batch(keys, np.full(keys.shape, i, np.uint64))
+        probes = np.asarray(
+            idx.probe_batch(
+                np.stack([_reshard_doc_keys(i) for i in range(RESHARD_DOCS)])
+            ),
+            np.int64,
+        ).tolist()
+        keys_all, docs_all = idx.dump_postings()
+        minmap: dict[int, int] = {}
+        for k, d in zip(keys_all.tolist(), docs_all.tolist()):
+            if k not in minmap or d < minmap[k]:
+                minmap[k] = d
+    finally:
+        idx.close()
+        shutil.rmtree(base, ignore_errors=True)
+    _RESHARD_ORACLE_CACHE.append((probes, minmap))
+    return probes, minmap
+
+
 def _fleet_pick_ports(n: int) -> list[int]:
     """Reserve ``n`` distinct free ports up front: a killed node must be
     respawnable at the SAME address, so the client's failover/rejoin path
@@ -656,6 +729,145 @@ def child_fleet(case_dir: str, seed: int) -> int:
 
         atomic_replace(
             os.path.join(case_dir, "fleet_report.json"),
+            json.dumps(report).encode(),
+        )
+        return 0
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def child_reshard(case_dir: str, seed: int) -> int:
+    """Live elastic cutover under seeded orchestrator kills.
+
+    Spawns RESHARD_SHARDS_NEW single-replica shard servers, streams the
+    planted-dup corpus with FIXED doc ids through a client built on the
+    2-shard ring, and at a seeded batch starts ``reshard_to`` the 4-shard
+    ring on a background thread while the inserts keep flowing — so the
+    parent's SIGKILL lands mid migration stream, mid dual-write window or
+    mid flip (chaos mode instead hard-exits INSIDE a migration-WAL
+    write).  The resumed child re-binds the SAME ports, reads the
+    migration WAL to decide which ring reality is in (absent/active →
+    old ring + resume the cutover; done → new ring), replays the
+    idempotent stream, and reports the final probe matrix for the
+    verifier to byte-compare against the single-node oracle."""
+    os.environ["ASTPU_TELEMETRY"] = "1"  # counters must be real in here
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    from advanced_scrapper_tpu.index.fleet import FleetSpec, ShardedIndexClient
+    from advanced_scrapper_tpu.index.reshard import ReshardLedger, ledger_path
+    from advanced_scrapper_tpu.obs import trace
+    from advanced_scrapper_tpu.storage.fsio import atomic_replace
+
+    trace.set_dump_path(os.path.join(case_dir, "client.flight.jsonl"))
+    rng = random.Random(f"reshard-child|{seed}")
+    n_batches = (RESHARD_DOCS + RESHARD_BATCH - 1) // RESHARD_BATCH
+    reshard_batch = rng.randrange(1, n_batches - 2)
+
+    # the topology must survive the orchestrator's own SIGKILL: the
+    # resumed child re-binds the SAME ports so the specs sealed in the
+    # migration WAL still name the running servers
+    ports_path = os.path.join(case_dir, "ports.json")
+    if os.path.exists(ports_path):
+        with open(ports_path) as f:
+            port_list = json.load(f)
+    else:
+        port_list = _fleet_pick_ports(RESHARD_SHARDS_NEW)
+        atomic_replace(ports_path, json.dumps(port_list).encode())
+
+    procs: dict[int, subprocess.Popen] = {}
+    try:
+        for sid in range(RESHARD_SHARDS_NEW):
+            procs[sid] = _fleet_spawn_server(
+                case_dir, sid, 0, None, port_list[sid]
+            )
+
+        def spec_of(n: int) -> FleetSpec:
+            return FleetSpec(
+                shards=tuple(
+                    (("127.0.0.1", port_list[sid]),) for sid in range(n)
+                )
+            )
+
+        new_spec = spec_of(RESHARD_SHARDS_NEW)
+        spill = os.path.join(case_dir, "spill")
+        led = ReshardLedger.load(ledger_path(spill, "bands"))
+        done = led is not None and led.phase == "done"
+        client = ShardedIndexClient(
+            new_spec if done else spec_of(RESHARD_SHARDS),
+            space="bands",
+            spill_dir=spill,
+            vnodes=RESHARD_VNODES,
+            timeout=1.0,
+            retries=1,
+            health_checks=2,
+            health_timeout=0.3,
+        )
+        _touch_marker(case_dir)
+        stats_box: dict = {}
+
+        def run_reshard() -> None:
+            try:
+                stats_box.update(client.reshard_to(new_spec))
+            except BaseException as e:  # reported after the join
+                stats_box["error"] = repr(e)
+
+        t = None
+        for b in range(n_batches):
+            if b == reshard_batch and not done:
+                t = threading.Thread(target=run_reshard, daemon=True)
+                t.start()
+            rows = range(
+                b * RESHARD_BATCH, min((b + 1) * RESHARD_BATCH, RESHARD_DOCS)
+            )
+            # one doc per insert so the in-batch planted dup is filtered
+            # by the server's semantic idempotency (probe-first), exactly
+            # like a redelivery — the store never holds a key twice
+            for i in rows:
+                keys = _reshard_doc_keys(i)
+                client.insert_batch(keys, np.full(keys.shape, i, np.uint64))
+        if t is None and not done:
+            # first run killed before the start batch: cut over now, so
+            # every surviving case ends on the new ring
+            t = threading.Thread(target=run_reshard, daemon=True)
+            t.start()
+        if t is not None:
+            t.join(timeout=120)
+            if t.is_alive():
+                raise RuntimeError("reshard never finished inside 120 s")
+            if "error" in stats_box:
+                raise RuntimeError(f"reshard failed: {stats_box['error']}")
+        client.checkpoint()  # recovery probe: drains any remaining spill
+        probes = client.probe_batch(
+            np.stack([_reshard_doc_keys(i) for i in range(RESHARD_DOCS)])
+        )
+        led2 = ReshardLedger.load(ledger_path(spill, "bands"))
+        trace.dump(reason="reshard sweep end")
+        report = {
+            "resumed": led is not None,
+            "reshard_batch": reshard_batch,
+            "reshard": stats_box or None,
+            "probes": np.asarray(probes, np.int64).tolist(),
+            "ledger_phase": led2.phase if led2 else None,
+            "all_retired": bool(led2.all_retired()) if led2 else False,
+            "voids": int(led2.doc.get("voids", 0)) if led2 else 0,
+            "route_shards": client._route_shards,
+            "spill_pending": sum(
+                int(k.size)
+                for sh in client._shards
+                for (_r, k, _d) in sh.pending
+            ),
+        }
+        client.close()
+        atomic_replace(
+            os.path.join(case_dir, "reshard_report.json"),
             json.dumps(report).encode(),
         )
         return 0
@@ -1087,6 +1299,7 @@ CHILDREN = {
     "stream": child_stream,
     "pindex": child_pindex,
     "fleet": child_fleet,
+    "reshard": child_reshard,
     "overload": child_overload,
     "graph": child_graph,
     "bitrot": child_bitrot,
@@ -1253,12 +1466,32 @@ def verify_pindex(case_dir: str) -> list[str]:
     return problems
 
 
-def _check_shard_postings(case_dir: str, oracle_minmap: dict) -> list[str]:
+def _check_shard_postings(
+    case_dir: str,
+    oracle_minmap: dict,
+    *,
+    num_shards: int = FLEET_SHARDS,
+    replicas: int = FLEET_REPLICAS,
+    vnodes: int = 64,
+    allow_superseded: bool = False,
+) -> list[str]:
     """Per shard, the union of its node indexes must hold exactly the
     oracle's posting keys for that shard's ring slice with identical min
     doc ids — zero lost, zero duplicated (each node also checked
     individually for duplicate keys: a duplicate is a double-applied
-    retry).  Shared by the fleet and bitrot verifiers."""
+    retry).  Shared by the fleet, bitrot and reshard verifiers — the
+    reshard one passes the POST-cutover ring, so the check doubles as
+    proof every migrated posting landed on its new owner and nowhere
+    else (handed-off residue is excluded by ``dump_postings`` itself).
+
+    ``allow_superseded`` relaxes the per-node shape for the dual-write
+    window's documented artifact: a dual-applied write can land on the
+    NEW owner before the migration stream delivers the same key's older
+    posting, leaving a raw higher-doc posting the later arrival
+    supersedes.  Min-doc attribution is untouched (still asserted
+    exactly); only an exact ``(key, doc)`` pair applied twice — a true
+    double-apply, which the server's semantic filter makes impossible
+    for any single delivery — stays a problem."""
     import numpy as np
 
     from advanced_scrapper_tpu.index import PersistentIndex
@@ -1266,14 +1499,14 @@ def _check_shard_postings(case_dir: str, oracle_minmap: dict) -> list[str]:
 
     problems: list[str] = []
     all_keys = np.array(sorted(oracle_minmap), dtype=np.uint64)
-    shard_of = ring_assign(all_keys, FLEET_SHARDS)
-    for sid in range(FLEET_SHARDS):
+    shard_of = ring_assign(all_keys, num_shards, vnodes)
+    for sid in range(num_shards):
         expect = {
             int(k): oracle_minmap[int(k)]
             for k in all_keys[shard_of == sid].tolist()
         }
         union: dict[int, int] = {}
-        for rep in range(FLEET_REPLICAS):
+        for rep in range(replicas):
             sdir = os.path.join(case_dir, f"s{sid}n{rep}", "bands")
             if not os.path.isdir(sdir):
                 continue
@@ -1286,12 +1519,19 @@ def _check_shard_postings(case_dir: str, oracle_minmap: dict) -> list[str]:
                 keys, docs = idx.dump_postings()
             finally:
                 idx.close()
-            if len(keys) != len(set(keys.tolist())):
+            pairs = list(zip(keys.tolist(), docs.tolist()))
+            if allow_superseded:
+                if len(pairs) != len(set(pairs)):
+                    problems.append(
+                        f"duplicated postings on s{sid}n{rep} "
+                        f"(same (key, doc) pair applied twice)"
+                    )
+            elif len(keys) != len(set(keys.tolist())):
                 problems.append(
                     f"duplicated postings on s{sid}n{rep} (double-applied retry)"
                 )
-            for k, d in zip(keys.tolist(), docs.tolist()):
-                if k in union and union[k] != d:
+            for k, d in pairs:
+                if not allow_superseded and k in union and union[k] != d:
                     problems.append(
                         f"shard {sid} replicas disagree on key {k}: "
                         f"{union[k]} vs {d}"
@@ -1387,6 +1627,91 @@ def verify_fleet(case_dir: str) -> list[str]:
             "shards_healthy SLO still violated at sweep end (fleet never "
             "recovered a proven write target per shard)"
         )
+    return problems
+
+
+def check_reshard_safety(case_dir: str) -> list[str]:
+    """Kill-point invariant for the migration WAL: at any crash instant
+    the ledger is absent or ONE whole, schema-valid document (atomic
+    replace — a half-flipped range is unrepresentable on disk)."""
+    from advanced_scrapper_tpu.index.reshard import ReshardLedger, ledger_path
+
+    path = ledger_path(os.path.join(case_dir, "spill"), "bands")
+    try:
+        led = ReshardLedger.load(path)
+    except Exception as e:
+        return [f"reshard ledger torn or unrepresentable: {e}"]
+    if led is not None and led.phase not in ("active", "done"):
+        return [f"reshard ledger in unknown phase {led.phase!r}"]
+    return []
+
+
+def verify_reshard(case_dir: str) -> list[str]:
+    """Elastic-cutover acceptance against the unresharded single-node
+    oracle:
+
+    - probe answers for every doc are byte-identical to the oracle's
+      (min-doc attribution survived the migration);
+    - the migration WAL is sealed (phase ``done``) with every range
+      ``retired``, and the client ended routing on the new ring;
+    - per NEW-ring shard, the node index holds exactly the oracle's
+      postings for that slice — zero lost, zero duplicated — proving
+      every migrated posting landed on its new owner and nowhere else;
+    - the spill journal fully replayed, and the offline fsck reports
+      every node directory clean (handed-off arcs are notes, not loss).
+    """
+    problems: list[str] = []
+    report_path = os.path.join(case_dir, "reshard_report.json")
+    if not os.path.exists(report_path):
+        return ["reshard child never wrote its report (cutover died)"]
+    with open(report_path) as f:
+        report = json.load(f)
+
+    oracle_probes, oracle_minmap = reshard_oracle()
+    if report["probes"] != oracle_probes:
+        diff = [
+            i for i, (a, b) in enumerate(zip(report["probes"], oracle_probes))
+            if a != b
+        ]
+        problems.append(
+            f"probe answers diverge from the single-node oracle at docs "
+            f"{diff[:5]} (of {len(diff)})"
+        )
+    if report.get("ledger_phase") != "done":
+        problems.append(
+            f"migration WAL never sealed (phase={report.get('ledger_phase')})"
+        )
+    if not report.get("all_retired"):
+        problems.append("ranges left un-retired after the cutover finished")
+    if report.get("route_shards") != RESHARD_SHARDS_NEW:
+        problems.append(
+            f"client ended routing on {report.get('route_shards')} shards, "
+            f"not the new ring's {RESHARD_SHARDS_NEW}"
+        )
+    if report.get("spill_pending"):
+        problems.append(
+            f"{report['spill_pending']} spilled postings never replayed"
+        )
+    problems += _check_shard_postings(
+        case_dir,
+        oracle_minmap,
+        num_shards=RESHARD_SHARDS_NEW,
+        replicas=1,
+        vnodes=RESHARD_VNODES,
+        allow_superseded=True,
+    )
+
+    # the offline twin gets the last word: every node dir verifies clean
+    import fsck_index
+
+    node_dirs = [
+        os.path.join(case_dir, f"s{sid}n0")
+        for sid in range(RESHARD_SHARDS_NEW)
+        if os.path.isdir(os.path.join(case_dir, f"s{sid}n0"))
+    ]
+    fsck_report = fsck_index.fsck(node_dirs)
+    if not fsck_report["ok"]:
+        problems += [f"fsck: {p}" for p in fsck_report["problems"]]
     return problems
 
 
@@ -1569,6 +1894,7 @@ SAFETY_CHECKS = {
     "harvest": check_harvest_safety,
     "stream": check_stream_safety,
     "pindex": check_pindex_safety,
+    "reshard": check_reshard_safety,
     "graph": check_graph_safety,
 }
 VERIFIERS = {
@@ -1577,6 +1903,7 @@ VERIFIERS = {
     "stream": verify_stream,
     "pindex": verify_pindex,
     "fleet": verify_fleet,
+    "reshard": verify_reshard,
     "overload": verify_overload,
     "graph": verify_graph,
     "bitrot": verify_bitrot,
@@ -1912,7 +2239,7 @@ def main(argv=None) -> int:
     import tempfile
 
     base = args.dir or tempfile.mkdtemp(prefix="crashsweep-")
-    per = max(1, args.kills // 8)
+    per = max(1, args.kills // 9)
     report = {
         "seed": args.seed,
         "workloads": [
@@ -1931,6 +2258,16 @@ def main(argv=None) -> int:
                 chaos_only=PINDEX_CHAOS_TARGETS,
             ),
             sweep_fleet(base, kills=per, seed=args.seed),
+            sweep_workload(
+                "reshard",
+                base,
+                sigkills=max(1, per - 1),
+                chaos_kills=1,
+                seed=args.seed,
+                # the post-marker window spans inserts AND the cutover
+                kill_window=(0.05, 1.5),
+                chaos_only=("reshard-wal",),
+            ),
             sweep_overload(base, kills=per, seed=args.seed),
             sweep_bitrot(base, kills=per, seed=args.seed),
             sweep_workload(
@@ -1943,10 +2280,10 @@ def main(argv=None) -> int:
             sweep_workload(
                 "stream",
                 base,
-                # the remainder: seven workloads above each land exactly
+                # the remainder: eight workloads above each land exactly
                 # `per` instants, stream takes what's left of --kills
                 # (its one chaos case included)
-                sigkills=max(1, args.kills - 7 * per - 1),
+                sigkills=max(1, args.kills - 8 * per - 1),
                 chaos_kills=1,
                 seed=args.seed,
                 kill_window=(0.05, 1.2),

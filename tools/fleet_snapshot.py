@@ -95,10 +95,17 @@ def snapshot_fleet(
     timeout: float = 10.0,
     retries: int = 2,
     fence_retries: int = 3,
+    allow_reshard: bool = False,
 ) -> dict:
     """Pull a consistent snapshot of every shard into ``out_dir``;
     returns the manifest-of-manifests dict (also written atomically as
-    ``MANIFEST.json``, the commit point)."""
+    ``MANIFEST.json``, the commit point).
+
+    A shard carrying a live reshard fence mark is REFUSED (unless
+    ``allow_reshard``): mid-cutover, range ownership is split between the
+    old and new rings and a per-shard snapshot would freeze half-migrated
+    state that no single ring can serve — finish (or void) the cutover,
+    then snapshot."""
     from advanced_scrapper_tpu.index.fleet import FleetSpec
     from advanced_scrapper_tpu.index.remote import RemoteIndex
     from advanced_scrapper_tpu.storage.fsio import atomic_replace, atomic_write
@@ -116,6 +123,15 @@ def snapshot_fleet(
             try:
                 for attempt in range(fence_retries):
                     meta = remote.snapshot_meta()
+                    mark = meta["manifest"].get("reshard")
+                    if mark and not allow_reshard:
+                        raise RuntimeError(
+                            f"shard {sid} space {space} is fenced by a live "
+                            f"reshard (mark {mark}): a mid-cutover snapshot "
+                            "would freeze half-migrated ownership — finish or "
+                            "void the cutover first (--allow-mid-reshard to "
+                            "override)"
+                        )
                     sdir = os.path.join(out_dir, f"s{sid}", space)
                     os.makedirs(sdir, exist_ok=True)
                     ok = True
@@ -243,6 +259,12 @@ def main(argv=None) -> int:
     s.add_argument("--out", required=True, help="snapshot directory")
     s.add_argument("--spaces", default=",".join(DEFAULT_SPACES))
     s.add_argument("--timeout", type=float, default=10.0)
+    s.add_argument(
+        "--allow-mid-reshard", action="store_true",
+        help="snapshot even through a live reshard fence mark (the "
+             "result freezes half-migrated ownership — restore it only "
+             "with the matching migration WAL in hand)",
+    )
     r = sub.add_parser("restore", help="materialise onto fresh node dirs")
     r.add_argument("--snapshot", required=True)
     r.add_argument("--out", required=True, help="base dir for node dirs")
@@ -256,6 +278,7 @@ def main(argv=None) -> int:
             args.fleet, args.out,
             spaces=tuple(s for s in args.spaces.split(",") if s),
             timeout=args.timeout,
+            allow_reshard=args.allow_mid_reshard,
         )
         n_files = sum(
             len(e["files"]) for sh in man["shards"] for e in sh["spaces"].values()

@@ -82,6 +82,23 @@ def fsck_dir(directory: str, fs=None) -> dict:
             f"unknown manifest version {man.get('version')}"
         )
         return report
+    # elastic-reshard context: handed-off arcs mean this node's on-disk
+    # postings legitimately EXCEED its semantic read surface — another
+    # node owns those ranges now, so a fleet-wide count treating them as
+    # live would read as duplication, and treating their absence from
+    # reads as loss would be just as wrong.  Both are notes, not errors.
+    handed = man.get("handed_off") or []
+    if handed:
+        report["notes"].append(
+            f"{len(handed)} ring range(s) handed off to another owner "
+            "(migrated away — excluded from semantic reads, not a loss)"
+        )
+    mark = man.get("reshard")
+    if mark:
+        report["notes"].append(
+            f"reshard fence mark present (token {mark.get('token')!r}) — "
+            "a cutover was live at the last manifest write"
+        )
     digests = man.get("digests", {})
     live = set(man.get("segments", []))
     for name in man.get("segments", []):

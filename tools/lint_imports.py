@@ -29,6 +29,11 @@ Rules (banned prefixes per source layer)::
                          so a stage fn can be anything but the runtime
                          itself knows no workload)
 
+Two modules carry rules STRICTER than their layer (``MODULE_RULES``):
+``index/reshard.py`` (the pure cutover plan/ledger — loses even the
+``net.rpc`` exemption) and ``runtime/autoscaler.py`` (policy head — no
+storage/, parallel/ either; the reshard mechanism is injected).
+
 Every ``import``/``from`` statement is found by walking the AST — including
 function-local imports, which the hot paths use deliberately — so a lazy
 import cannot dodge the rule.  Wired as a tier-1 test in
@@ -77,6 +82,22 @@ ALLOW: dict[str, tuple[str, ...]] = {
     "index": (f"{PACKAGE}.net.rpc",),
 }
 
+#: per-MODULE rules STRICTER than the module's layer: package-relative
+#: path → (extra banned target layers, honor the layer's ALLOW list).
+#: ``index/reshard.py`` is the pure half of the elastic cutover — plan
+#: math and the migration WAL — so it loses even the ``net.rpc``
+#: exemption the rest of ``index/`` rides (every RPC that acts on a plan
+#: lives in ``fleet.py``/``remote.py``); the autoscaler is a clock-driven
+#: policy head that must stay free of transport, durable state and
+#: mechanism (its reshard callback is injected by the caller).
+MODULE_RULES: dict[str, tuple[tuple[str, ...], bool]] = {
+    os.path.join("index", "reshard.py"): (("pipeline", "net"), False),
+    os.path.join("runtime", "autoscaler.py"): (
+        ("pipeline", "extractors", "net", "index", "storage", "parallel"),
+        False,
+    ),
+}
+
 
 def _imported_modules(tree: ast.AST):
     """Yield ``(lineno, module_name)`` for every import in the file, at any
@@ -90,14 +111,22 @@ def _imported_modules(tree: ast.AST):
                 yield node.lineno, node.module   # the tree uses no relative ones
 
 
-def check_file(path: str, layer: str, banned: tuple[str, ...]) -> list[str]:
+def check_file(
+    path: str,
+    layer: str,
+    banned: tuple[str, ...],
+    allowed: tuple[str, ...] | None = None,
+    label: str | None = None,
+) -> list[str]:
     with open(path, "rb") as fh:
         try:
             tree = ast.parse(fh.read(), filename=path)
         except SyntaxError as e:
             return [f"{path}: unparseable ({e})"]
     problems = []
-    allowed = ALLOW.get(layer, ())
+    if allowed is None:
+        allowed = ALLOW.get(layer, ())
+    label = label or f"{layer}/"
     for lineno, mod in _imported_modules(tree):
         if any(mod == a or mod.startswith(a + ".") for a in allowed):
             continue
@@ -105,7 +134,7 @@ def check_file(path: str, layer: str, banned: tuple[str, ...]) -> list[str]:
             prefix = f"{PACKAGE}.{target}"
             if mod == prefix or mod.startswith(prefix + "."):
                 problems.append(
-                    f"{path}:{lineno}: {layer}/ must not import {target}/ "
+                    f"{path}:{lineno}: {label} must not import {target}/ "
                     f"(imports {mod})"
                 )
     return problems
@@ -120,10 +149,22 @@ def lint(root: str = REPO) -> list[str]:
             continue
         for dirpath, _dirs, files in os.walk(layer_dir):
             for name in sorted(files):
-                if name.endswith(".py"):
-                    problems += check_file(
-                        os.path.join(dirpath, name), layer, banned
-                    )
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                rel = os.path.relpath(path, pkg_root)
+                mod_rule = MODULE_RULES.get(rel)
+                if mod_rule is None:
+                    problems += check_file(path, layer, banned)
+                    continue
+                extra, honor_allow = mod_rule
+                problems += check_file(
+                    path,
+                    layer,
+                    tuple(dict.fromkeys(banned + extra)),
+                    allowed=ALLOW.get(layer, ()) if honor_allow else (),
+                    label=rel,
+                )
     return problems
 
 
@@ -137,7 +178,10 @@ def main(argv=None) -> int:
     for p in problems:
         print(p, file=sys.stderr)
     if not problems:
-        print(f"lint_imports: {len(RULES)} layers clean")
+        print(
+            f"lint_imports: {len(RULES)} layers + {len(MODULE_RULES)} "
+            "module rules clean"
+        )
     return 1 if problems else 0
 
 

@@ -26,7 +26,10 @@ Modes::
 
 The crashsweep ``overload`` workload reuses :func:`storm_rpc` against a
 live 2×2 fleet with a mid-storm SIGKILL; this CLI is the operator's
-hand tool and the CI smoke.
+hand tool and the CI smoke.  :func:`storm_fleet` is the index-level
+sibling — a checked probe/insert storm through a ``ShardedIndexClient``
+— which the elastic-reshard tests run THROUGH a live 2→4 cutover to
+prove zero downtime (no transport failures, no wrong answers).
 """
 
 from __future__ import annotations
@@ -168,6 +171,108 @@ def storm_rpc(
             "p99": round(_percentile(vals, 0.99) * 1e3, 3),
         }
     return out
+
+
+def storm_fleet(
+    client,
+    probes,
+    *,
+    duration: float,
+    workers: int = 4,
+    fresh=None,
+    insert_every: int = 4,
+) -> dict:
+    """Drive a live ``ShardedIndexClient`` with a mixed probe/insert
+    storm from ``workers`` threads for ``duration`` seconds — the
+    zero-downtime harness the elastic-reshard proof rides: start the
+    storm, cut the fleet over UNDERNEATH it, then assert the ledger
+    shows zero transport failures and zero wrong answers.
+
+    ``probes`` is a list of ``(key_row, expected_min_doc)`` — known
+    corpus the storm re-asks continuously, checking every answer.
+    ``fresh`` (optional) is ``seq -> (key_row, doc_id)`` yielding
+    never-seen keys; every ``insert_every``-th operation inserts one and
+    immediately probes it back (a write acked then unfindable is a
+    wrong answer, not a transport failure).  Returns the ledger: ops /
+    probe / insert counts, ``wrong_answers`` (with the first few
+    samples), ``transport_failures``, ``rejected`` and ``errors``."""
+    import numpy as np
+
+    from advanced_scrapper_tpu.net.rpc import RpcOverloaded, RpcUnavailable
+
+    stop_at = time.monotonic() + duration
+    lock = threading.Lock()
+    ledger = {
+        "ops": 0,
+        "probes": 0,
+        "inserts": 0,
+        "wrong_answers": 0,
+        "wrong_samples": [],
+        "transport_failures": 0,
+        "rejected": 0,
+        "errors": [],
+    }
+    seq_lock = threading.Lock()
+    seq_box = [0]
+
+    def _next_seq() -> int:
+        with seq_lock:
+            seq_box[0] += 1
+            return seq_box[0]
+
+    def one_worker(wid: int):
+        k = wid  # stagger the corpus walk across workers
+        while time.monotonic() < stop_at:
+            k += 1
+            do_insert = fresh is not None and k % insert_every == 0
+            try:
+                if do_insert:
+                    keys, doc = fresh(_next_seq())
+                    keys = np.asarray(keys, np.uint64)
+                    client.insert_batch(
+                        keys, np.full(keys.shape, doc, np.uint64)
+                    )
+                    got = int(client.probe_batch(keys[None, :])[0])
+                    want = int(doc)
+                else:
+                    keys, want = probes[k % len(probes)]
+                    keys = np.asarray(keys, np.uint64)
+                    got = int(client.probe_batch(keys[None, :])[0])
+                    want = int(want)
+                with lock:
+                    ledger["ops"] += 1
+                    ledger["inserts" if do_insert else "probes"] += 1
+                    if got != want:
+                        ledger["wrong_answers"] += 1
+                        if len(ledger["wrong_samples"]) < 5:
+                            ledger["wrong_samples"].append(
+                                {"want": want, "got": got,
+                                 "insert": do_insert}
+                            )
+            except RpcOverloaded:
+                with lock:
+                    ledger["ops"] += 1
+                    ledger["rejected"] += 1
+            except RpcUnavailable as e:
+                with lock:
+                    ledger["ops"] += 1
+                    ledger["transport_failures"] += 1
+                    if len(ledger["errors"]) < 5:
+                        ledger["errors"].append(repr(e))
+            except Exception as e:  # anything else is a harness bug
+                with lock:
+                    ledger["errors"].append(repr(e))
+                raise
+
+    threads = [
+        threading.Thread(target=one_worker, args=(i,), daemon=True)
+        for i in range(workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + 60)
+    return ledger
 
 
 def admission_snapshot() -> dict:
