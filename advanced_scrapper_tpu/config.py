@@ -170,6 +170,50 @@ class DedupConfig:
     exact_verify_cap: int = 8192  # max exact-Jaccard checks per corpus —
     #   beyond it remaining borderline edges keep their estimator verdict
     #   (a pathological all-borderline corpus must not degrade to O(n²))
+    rerank: bool = True      # install the device-batched precision tier
+    #   (pipeline/rerank.py) on RERANK_HOOK_EDGE at engine init: candidate
+    #   pairs are settled by a vmap'd bottom-sketch Jaccard kernel in
+    #   packed device tiles (1 put + 1 dispatch per tile through the
+    #   dispatch executor, verdicts folded on-device and read back once
+    #   per corpus), then clusters are precision-evicted to the ≥0.95 bar.
+    #   ASTPU_DEDUP_RERANK=0 opts out (rerank_hook=None, the pre-tier
+    #   hookless paths, byte-identical); the skip_rerank brownout bypasses
+    #   it counted-and-reversibly without uninstalling.
+    rerank_sketch: int = 1024  # bottom-S sketch lanes per document: the
+    #   settle estimator's σ≈√(J(1−J)/S) (≈0.014 at 1024, 3× tighter than
+    #   the 128-perm signature) and EXACT when |shingle union| ≤ S.  Pair
+    #   rows are 8·S bytes on the wire; 2·S lanes per sort keeps the
+    #   kernel aligned to 128-lane tiles.
+    rerank_margin: float = 0.04  # half-width of the borderline band
+    #   around sim_threshold: settled pairs with |J − thr| < margin are
+    #   re-settled on host (exact shingle Jaccard up to rerank_exact_cap,
+    #   then the persistent index's ANN re-probe when attached, else the
+    #   sketch verdict stands).  ~3σ of the sketch estimator.
+    rerank_precision_target: float = 0.96  # predicted merged-pair
+    #   precision the greedy eviction walk stops at (ops.rerank.
+    #   evict_for_precision; measured 5-seed operating points: pooled
+    #   0.981 recall / 0.961 precision on the representative mix, and
+    #   0.963 / 0.928 — a strict Pareto win over the hookless baseline's
+    #   0.952 / 0.921 — on the adversarial knee-heavy suite, where the
+    #   recall floor binds before the target is reached)
+    rerank_recall_floor: float = 0.955  # hard predicted-recall guard:
+    #   eviction never crosses below this fraction of the candidate
+    #   work-list's expected oracle-recall mass (ops.rerank.op_weight
+    #   prices each settled pair's probability of being counted by the
+    #   estimator oracle), keeping the measured ≥0.95 recall bar with
+    #   margin for estimator drift — on adversarial mixes this floor,
+    #   not the target, is what stops eviction
+    rerank_exact_cap: int = 8192  # max host exact-Jaccard re-settles per
+    #   corpus (borderline band + wave-2 residue); beyond it borderline
+    #   pairs fall to the ANN re-probe / sketch verdict — a pathological
+    #   all-borderline corpus must not degrade to O(n²) host work
+    rerank_tile_rows: int = 1024  # pair rows per full settle tile; the
+    #   tile shape set is tile_rows_options(rerank_tile_rows) — shared
+    #   with the engine prewarm derivation so the PR 15 recompile
+    #   sentinel stays zero in steady state
+    rerank_pair_cap: int = 1 << 16  # fold-buffer slots: max device-settled
+    #   pairs per corpus (256 KiB int32 on device).  Overflow pairs keep
+    #   their estimator verdict and are counted in the tier stats.
     seed: int = 1            # datasketch's default seed for oracle parity
     backend: str = "scan"    # scan (dense, datasketch-parity) | oph | pallas
     put_workers: int = 0     # H2D put threads INSIDE the pipelined

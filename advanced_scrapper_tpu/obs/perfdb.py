@@ -56,6 +56,8 @@ __all__ = [
     "report_markdown",
     "bench_history_verdict",
     "git_sha",
+    "parse_source_knobs",
+    "best_knob_profile",
 ]
 
 SCHEMA = 1
@@ -511,6 +513,71 @@ def report_markdown(report: dict, *, max_points: int = 8) -> str:
             lines.append(f"- `{metric}`: {path}")
         lines.append("")
     return "\n".join(lines)
+
+
+# -- per-platform knob profiles -----------------------------------------------
+
+#: sweep-tag knob key → DedupConfig field name.  Only keys listed here
+#: ever flow back into an engine config — a sweep tag's corpus-shape
+#: keys (``n=…``) are the sweep's business, not dispatch knobs.
+KNOB_FIELDS = {
+    "put_workers": "put_workers",
+    "window": "dispatch_window",
+    "tile_rows": "rerank_tile_rows",
+}
+
+
+def parse_source_knobs(source: str) -> dict[str, int]:
+    """Dispatch knobs encoded in a sweep row's source tag
+    (``sweep:rerank:n=2048,put_workers=2,window=4,tile_rows=512``) as
+    ``{config_field: value}``.  Unknown keys and non-integer values are
+    skipped — the tag is free-form by design."""
+    out: dict[str, int] = {}
+    tail = source.rsplit(":", 1)[-1]
+    for part in tail.split(","):
+        k, sep, v = part.partition("=")
+        field = KNOB_FIELDS.get(k.strip())
+        if not sep or field is None:
+            continue
+        try:
+            out[field] = int(v)
+        except ValueError:
+            continue
+    return out
+
+
+def best_knob_profile(path: str, platform_token: str) -> dict[str, int]:
+    """Dispatch knobs from the ledger's best same-platform sweep row.
+
+    Scans ``path`` for ``kind == "sweep"`` rows whose platform partition
+    starts with ``platform_token`` (sweep rows stamp
+    ``f"{backend}/swept-xN"``, so the bare jax backend name matches),
+    takes the row with the highest ``*_articles_per_sec`` metric, and
+    returns the knobs its source tag encodes.  Empty dict when the
+    ledger has no matching row — callers fall back to their defaults.
+    """
+    best_rate, best_knobs = -1.0, {}
+    for row in PerfLedger(path).rows():
+        if row.get("kind") != "sweep":
+            continue
+        plat = str(row.get("platform") or "")
+        if not plat.startswith(platform_token):
+            continue
+        rate = max(
+            (
+                v
+                for k, v in (row.get("metrics") or {}).items()
+                if k.endswith("_articles_per_sec")
+                and isinstance(v, (int, float))
+            ),
+            default=None,
+        )
+        if rate is None or rate <= best_rate:
+            continue
+        knobs = parse_source_knobs(str(row.get("source") or ""))
+        if knobs:
+            best_rate, best_knobs = float(rate), knobs
+    return best_knobs
 
 
 # -- bench integration --------------------------------------------------------

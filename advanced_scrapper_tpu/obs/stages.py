@@ -178,6 +178,27 @@ def device_counters() -> dict[str, float]:
     return out
 
 
+def regime_device_counters(regime: str) -> dict[str, float]:
+    """Cumulative device-traffic totals for ONE regime label:
+    ``{"device_puts", "device_dispatches", "h2d_bytes"}`` — the
+    per-regime twin of :func:`device_counters`.  The rerank launch-count
+    gate windows this (subtract two snapshots) to assert a settled
+    corpus cost exactly ``tiles + 1`` puts and ``tiles + 1`` dispatches
+    on the ``"rerank"`` plane regardless of what the dedup plane did in
+    between."""
+    short = {
+        "astpu_device_puts_total": "device_puts",
+        "astpu_device_dispatches_total": "device_dispatches",
+        "astpu_h2d_bytes_total": "h2d_bytes",
+    }
+    out = {"device_puts": 0.0, "device_dispatches": 0.0, "h2d_bytes": 0.0}
+    for name, key in short.items():
+        for c in telemetry.REGISTRY.find(name):
+            if c.labels.get("regime") == regime:
+                out[key] += c.value
+    return out
+
+
 def sharded_device_counters(regime: str = "sharded") -> dict[str, dict[str, float]]:
     """Per-shard cumulative device-traffic totals for one regime:
     ``{shard: {"device_puts", "device_dispatches", "h2d_bytes"}}`` —

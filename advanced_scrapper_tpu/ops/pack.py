@@ -79,6 +79,55 @@ def unpack_tile_planes(packed, rows: int, width: int, n_planes: int):
     return tok, [vals[k].astype(jnp.int32) for k in range(n_planes)]
 
 
+def pair_tile_nbytes(rows: int, sketch: int) -> int:
+    """Size of a packed RERANK pair tile: two ``uint32[sketch]`` lanes per
+    row (the pair's bottom-``sketch`` shingle sketches, 8·sketch bytes)
+    plus one int32 fold-slot plane."""
+    return packed_nbytes(rows, 8 * sketch, n_planes=1)
+
+
+def pack_pair_tile(
+    ska: np.ndarray, skb: np.ndarray, idx: np.ndarray
+) -> np.ndarray:
+    """``uint8[rows*(8*sketch+4)]`` single-buffer form of a rerank pair
+    tile — the two sides' bottom-sketches side by side as the "token"
+    block (little-endian uint32 bytes, side A then side B per row) and
+    the pair's fold slot as the one int32 plane.  Same layout contract
+    as :func:`pack_tile_planes`: rows/sketch are static per compiled
+    step, so the whole tile crosses H2D as ONE ``device_put``."""
+    rows, sketch = ska.shape
+    tok = (
+        np.ascontiguousarray(
+            np.concatenate([ska, skb], axis=1), dtype="<u4"
+        )
+        .view(np.uint8)
+        .reshape(rows, 8 * sketch)
+    )
+    return pack_tile_planes(tok, idx)
+
+
+def unpack_pair_tile(packed, rows: int, sketch: int):
+    """Device-side inverse of :func:`pack_pair_tile` — traceable under
+    jit.
+
+    Returns ``(ska uint32[rows, sketch], skb uint32[rows, sketch],
+    idx int32[rows])``.  The uint32 lanes are rebuilt with the same
+    four-shift-or recipe the int32 planes use (portable across jax
+    releases, fused into the kernel prologue by XLA).
+    """
+    import jax.numpy as jnp
+
+    tok, (idx,) = unpack_tile_planes(packed, rows, 8 * sketch, 1)
+    words = tok.reshape(rows, 2 * sketch, 4).astype(jnp.uint32)
+    vals = (
+        words[..., 0]
+        | (words[..., 1] << 8)
+        | (words[..., 2] << 16)
+        | (words[..., 3] << 24)
+    )
+    return vals[:, :sketch], vals[:, sketch:], idx
+
+
 def pack_tile(
     tok: np.ndarray, lens: np.ndarray, owners: np.ndarray
 ) -> np.ndarray:

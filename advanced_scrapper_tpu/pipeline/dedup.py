@@ -12,6 +12,7 @@
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Sequence
 
@@ -113,6 +114,66 @@ def _prewarm_widths(cfg: DedupConfig) -> list[int]:
     return widths
 
 
+#: dispatch knobs the perf-ledger profile may resolve, with the explicit
+#: env key that always wins over a ledger row
+_KNOB_PROFILE_FIELDS: tuple[tuple[str, str], ...] = (
+    ("put_workers", "ASTPU_DEDUP_PUT_WORKERS"),
+    ("dispatch_window", "ASTPU_DEDUP_DISPATCH_WINDOW"),
+    ("rerank_tile_rows", "ASTPU_DEDUP_RERANK_TILE_ROWS"),
+)
+
+
+def _resolve_knob_profile(cfg: DedupConfig) -> DedupConfig:
+    """Per-platform knob-profile store: fill still-default dispatch knobs
+    from the perf ledger's best same-platform sweep row.
+
+    Resolution order per knob (unit-tested in ``tests/test_perf_obs.py``):
+
+    1. explicit env (``ASTPU_DEDUP_PUT_WORKERS`` etc.) — always wins,
+       applied here so a directly-constructed ``DedupConfig()`` honours
+       it exactly like a ``config.from_env`` one;
+    2. a caller-pinned config value (field differs from the dataclass
+       default) — the constructor argument is an explicit choice;
+    3. the best same-platform row of ``$ASTPU_PERF_LEDGER``
+       (``obs.perfdb.best_knob_profile`` — max articles/sec sweep row
+       whose platform partition matches this process's jax backend);
+    4. the dataclass default (no ledger / no matching row / no knob in
+       the winning row's tag) — current constants, unchanged.
+    """
+    import dataclasses
+
+    defaults = DedupConfig()
+    env_updates: dict[str, int] = {}
+    open_knobs: list[str] = []
+    for f, env_key in _KNOB_PROFILE_FIELDS:
+        raw = os.environ.get(env_key)
+        if raw is not None:
+            try:
+                env_updates[f] = int(raw)
+            except ValueError:
+                pass  # malformed env: leave the field as constructed
+            continue
+        if getattr(cfg, f) == getattr(defaults, f):
+            open_knobs.append(f)
+    if env_updates:
+        cfg = dataclasses.replace(cfg, **env_updates)
+    path = os.environ.get("ASTPU_PERF_LEDGER", "")
+    if not path or not os.path.exists(path) or not open_knobs:
+        return cfg
+    try:
+        import jax
+
+        from advanced_scrapper_tpu.obs.perfdb import best_knob_profile
+
+        profile = best_knob_profile(path, jax.devices()[0].platform)
+    except Exception:  # a torn/foreign ledger must never fail engine init
+        return cfg
+    updates = {
+        k: v for k, v in profile.items() if k in open_knobs and v
+    }
+    return dataclasses.replace(cfg, **updates) if updates else cfg
+
+
 def resolve_put_workers(cfg: DedupConfig) -> int:
     """Effective H2D put-thread count: ``cfg.put_workers``, with 0 meaning
     the transport default (``core.mesh.auto_h2d_workers`` — 4 on the
@@ -147,10 +208,32 @@ class NearDupEngine:
         #: the single-dispatch packed tile step (ops.minhash.
         #: make_fused_tile_step), built lazily — params constant-fold in
         self._fused_step = None
+        # per-platform knob-profile resolution (perf-ledger defaults):
+        # still-default dispatch knobs pick up the best same-platform
+        # sweep row's values; explicit env / caller-pinned fields win
+        self.cfg = _resolve_knob_profile(self.cfg)
         #: the rerank tier's slot on :data:`RERANK_HOOK_EDGE` — when set,
         #: every resolution path passes its candidate matrix through it
         #: before union-find (None = pass-through)
         self.rerank_hook = None
+        #: whether the LAST corpus's candidates actually passed through
+        #: an AUTHORITATIVE tier (settled true-Jaccard verdicts): the
+        #: certified path then resolves the rewritten matrix verbatim
+        #: instead of re-litigating edges with the estimator-era
+        #: exact-verify stage.  False whenever the hook is absent,
+        #: bypassed by the skip_rerank brownout, or non-authoritative.
+        self._rerank_applied = False
+        #: the default precision tier (pipeline/rerank.py) when
+        #: ``cfg.rerank`` — kept as an attribute so callers can attach a
+        #: persistent index for the borderline ANN re-probe or read the
+        #: per-corpus settlement stats; ``rerank_hook = None`` (or
+        #: ASTPU_DEDUP_RERANK=0) remains the opt-out
+        self.rerank_tier = None
+        if self.cfg.rerank:
+            from advanced_scrapper_tpu.pipeline.rerank import RerankTier
+
+            self.rerank_tier = RerankTier(self.cfg, self.params)
+            self.rerank_hook = self.rerank_tier
         #: optional :class:`~advanced_scrapper_tpu.runtime.admission.
         #: DegradationLadder` — when installed, the engine honours the
         #: declared brownout steps at its decision points: a halved
@@ -345,6 +428,12 @@ class NearDupEngine:
                     running, packed, rows=rows, width=w, num_articles=n_bucket
                 ).block_until_ready()
                 compiled += 1
+        if self.rerank_tier is not None:
+            # the precision tier's settle tiles ride the same shared
+            # tile_rows_options derivation — prewarm them (plus the
+            # finalize) here so a first real corpus leaves the recompile
+            # sentinel flat
+            compiled += self.rerank_tier.prewarm()
         return compiled
 
     def _host_tiles(self, raw: list, trace_id=None):
@@ -771,6 +860,7 @@ class NearDupEngine:
                 densify_oph=use_oph,
             )
             stages.count_dispatch("dedup")
+        self._rerank_applied = False
         if self.rerank_hook is not None:
             if self.ladder is not None and self.ladder.active("skip_rerank"):
                 # brownout step 2: the precision tier is bypassed under
@@ -782,6 +872,9 @@ class NearDupEngine:
                 # the rerank tier before EITHER resolution path sees them
                 with trace.span("dedup.rerank", trace=tid, docs=n):
                     rep_bands = self.rerank_hook(raw, sigs, rep_bands, valid)
+                self._rerank_applied = bool(
+                    getattr(self.rerank_hook, "authoritative", False)
+                )
         return raw, sigs, keys, valid, rep_bands, n_bucket, tid
 
     def dedup_reps_async(self, texts: Sequence[str | bytes], *, _regime: str = "async"):
@@ -812,6 +905,24 @@ class NearDupEngine:
             with stages.timed("resolve"), trace.span(
                 "dedup.resolve", trace=tid, regime=_regime, docs=len(texts)
             ):
+                if self._rerank_applied:
+                    # an authoritative tier rewrote the matrix: its cells
+                    # are settled TRUE-Jaccard cluster edges, already
+                    # exact-verified where it mattered — re-screening them
+                    # by estimator agreement would re-drop precisely the
+                    # true pairs whose signatures underestimate (the tier
+                    # keeps them on settled evidence), so resolve trusts
+                    # every non-self cell
+                    rb_host = np.asarray(rep_bands)
+                    ok = rb_host != np.arange(
+                        rb_host.shape[0], dtype=rb_host.dtype
+                    )[:, None]
+                    rep = resolve_rep_bands_from_ok(
+                        rep_bands, ok, valid,
+                        jump_rounds=_jump_rounds(n_bucket),
+                    )
+                    stages.count_dispatch("dedup")
+                    return rep
                 if self.cfg.cand_subbands and self.cfg.fine_margin:
                     thr = fine_edge_thresholds(
                         rep_bands,
@@ -1410,7 +1521,21 @@ class NearDupEngine:
         raw, sigs, keys, valid, rep_bands, n_bucket, tid = self._prepare(texts)
         self._m_docs["oneshot"].inc(n)
         with trace.span("dedup.resolve", trace=tid, regime="oneshot", docs=n):
-            ok = self._exact_verified_ok(raw, sigs, keys, valid, rep_bands)
+            if self._rerank_applied:
+                # the tier settled every cell by TRUE (sketch/exact)
+                # Jaccard and already paid its precision eviction —
+                # re-litigating with the estimator-era exact-verify
+                # stage would refute deliberate keeps (settled recall
+                # pairs with true J just under threshold) and re-admit
+                # nothing: resolve the rewritten matrix verbatim
+                rb_host = np.asarray(rep_bands)
+                ok = rb_host != np.arange(
+                    rb_host.shape[0], dtype=rb_host.dtype
+                )[:, None]
+            else:
+                ok = self._exact_verified_ok(
+                    raw, sigs, keys, valid, rep_bands
+                )
             rep = resolve_rep_bands_from_ok(
                 rep_bands, ok, valid, jump_rounds=_jump_rounds(n_bucket)
             )

@@ -29,6 +29,12 @@ Sweep knobs (env):
   ASTPU_DEDUP_DISPATCH_WINDOW=N  in-flight tile window depth (0 = auto)
   ASTPU_DEDUP_PACKED_H2D=0    legacy 3-put/2-dispatch tile transport
                               (parity escape hatch; default = packed)
+  ASTPU_DEDUP_RERANK=0|1      precision rerank tier on/off — wins over
+                              every regime pin (throughput regimes pin
+                              it OFF for bench-history comparability;
+                              the rerank regime pins it ON)
+  ASTPU_DEDUP_RERANK_TILE_ROWS=N  settle-tile row budget for the packed
+                              pair tiles of the rerank regime
   ASTPU_MATCH_PACKED=0        legacy per-batch matcher screen loop
                               (parity escape hatch; default = packed
                               single-dispatch screen tiles)
@@ -55,9 +61,14 @@ exact reading).
 
 Observability (the telemetry plane rides the bench):
   --regime NAME               run one regime (uniform|ragged|stream|sharded|
-                              recall|exact|matcher|index|fleet) instead of
-                              the full battery; the JSON line carries only
-                              that regime's keys
+                              rerank|recall|exact|matcher|index|fleet)
+                              instead of the full battery; the JSON line
+                              carries only that regime's keys.  The rerank
+                              regime measures the precision tier on a
+                              near-dup-heavy corpus and gates its
+                              tiles+1-launch budget via the always-on
+                              ``astpu_rerank_launch_excess`` gauge (SLO
+                              ``rerank_launch_budget``)
   ASTPU_TELEMETRY=1           serve live GET /metrics + /status for the
                               whole run (port: ASTPU_METRICS_PORT, default
                               ephemeral — address printed to stderr); the
@@ -138,16 +149,43 @@ def _ragged_corpus(rng: np.random.RandomState, n: int) -> list[bytes]:
     return docs
 
 
-def _ragged_engine():
+def _rerank_corpus(rng: np.random.RandomState, n: int) -> list[bytes]:
+    """Near-dup-heavy mix for the precision-tier regime: ~35% MUTATED
+    dups (~1% edit rate — pairs land across the Jaccard knee instead of
+    at J=1) so the settle kernel, margin band and eviction walk all do
+    real work; the rest is the ragged length mix capped at 8 kB."""
+    docs: list[bytes] = []
+    for i in range(n):
+        if i >= 8 and rng.rand() < 0.35:
+            src = bytearray(docs[rng.randint(0, i)])
+            for _ in range(max(1, len(src) // 100)):
+                src[rng.randint(0, len(src))] = rng.randint(32, 127)
+            docs.append(bytes(src))
+        else:
+            ln = int(np.clip(rng.lognormal(6.55, 0.8), 100, 8000))
+            docs.append(
+                rng.randint(32, 127, size=ln, dtype=np.uint8).tobytes()
+            )
+    return docs
+
+
+def _ragged_engine(**pins):
     """The ragged-regime engine, built from env so the ASTPU_DEDUP_* sweep
     knobs (notably ASTPU_DEDUP_PUT_WORKERS, the threaded-H2D axis) actually
     reach it — ``NearDupEngine()`` raw defaults silently ignored them.
     ``put_workers=0`` (the default) resolves per transport inside the
-    engine (``pipeline.dedup.resolve_put_workers``)."""
+    engine (``pipeline.dedup.resolve_put_workers``).
+
+    The throughput regimes pin ``rerank=False`` (via ``pins``) so their
+    rates stay comparable against the pre-tier bench history — the tier
+    has its own regime — but an explicit ``ASTPU_DEDUP_RERANK`` always
+    wins over a pin."""
     from advanced_scrapper_tpu.config import DedupConfig, from_env
     from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
 
-    return NearDupEngine(from_env(DedupConfig, "dedup"))
+    if "ASTPU_DEDUP_RERANK" in os.environ:
+        pins.pop("rerank", None)
+    return NearDupEngine(from_env(DedupConfig, "dedup", **pins))
 
 
 def _bench_ragged(
@@ -172,7 +210,7 @@ def _bench_ragged(
     from advanced_scrapper_tpu.obs import devprof, stages
 
     rng = np.random.RandomState(7)
-    engine = _ragged_engine()
+    engine = _ragged_engine(rerank=False)
     t0 = time.perf_counter()
     # warm the SAME path the steady loop times (dedup_reps_async →
     # fused resolve epilogue) — warming the oneshot path would leave the
@@ -220,7 +258,7 @@ def _bench_sharded(
     spec = os.environ.get("ASTPU_BENCH_MESH")
     dp, sp = parse_mesh_shape(spec) if spec else (ndev, 1)
     mesh = build_mesh(dp, sp)
-    engine = _ragged_engine()
+    engine = _ragged_engine(rerank=False)
     rng = np.random.RandomState(7)
     t0 = time.perf_counter()
     warm = engine.dedup_reps_sharded(_ragged_corpus(rng, n_articles), mesh)
@@ -248,6 +286,67 @@ def _bench_sharded(
     stages.record_sharded_put_skew(ps0)  # steady window → the gauge_max SLO
     mesh_shape = {"data": dp, "seq": sp, "shards": dp * sp}
     return warm_rate, n_articles * n_corpora / dt, totals, per_shard, mesh_shape
+
+
+def _bench_rerank(
+    n_articles: int, n_corpora: int = 3
+) -> tuple[float, float, dict]:
+    """``(warmup_rate, steady_rate, deltas)`` for the precision tier:
+    the near-dup-heavy corpus (``_rerank_corpus``) through the DEFAULT
+    engine with the rerank tier pinned ON (``ASTPU_DEDUP_RERANK`` still
+    wins, like every pin).
+
+    The deltas window ONLY the steady corpora, on the tier's own
+    ``"rerank"`` regime ledger (``obs.stages.regime_device_counters``),
+    and carry the launch-count gate as data: a settled corpus costs
+    exactly ``tiles + 1`` device_puts (settle tiles + the fold-init
+    buffer) and ``tiles + 1`` dispatches (settle tiles + finalize).  Any
+    surplus lands on the always-on ``astpu_rerank_launch_excess`` gauge
+    the declared SLO set gates at 0 — the single-dispatch contract is a
+    machine-checked verdict, not prose.  The warmup corpus owns the
+    compiles (the engine prewarm compiles the whole shared
+    ``tile_rows_options`` shape set first, so steady corpora with
+    different pair counts still hit compiled settle tiles)."""
+    from advanced_scrapper_tpu.obs import devprof, stages, telemetry
+
+    engine = _ragged_engine(rerank=True)
+    if engine.rerank_tier is None:
+        raise RuntimeError(
+            "rerank regime needs the tier: unset ASTPU_DEDUP_RERANK=0"
+        )
+    rng = np.random.RandomState(11)
+    engine.prewarm(n_articles)
+    t0 = time.perf_counter()
+    warm = engine.dedup_reps(_rerank_corpus(rng, n_articles))
+    assert warm.shape[0] == n_articles
+    warm_rate = n_articles / (time.perf_counter() - t0)
+    corpora = [_rerank_corpus(rng, n_articles) for _ in range(n_corpora)]
+    rr0 = stages.regime_device_counters("rerank")
+    jc0 = devprof.jit_compiles_total()
+    tiles = pairs = 0
+    t0 = time.perf_counter()
+    for c in corpora:
+        rep = engine.dedup_reps(c)
+        assert rep.shape == (n_articles,)
+        tiles += int(engine.rerank_tier.stats.get("tiles", 0))
+        pairs += int(engine.rerank_tier.stats.get("pairs", 0))
+    dt = time.perf_counter() - t0
+    rr1 = stages.regime_device_counters("rerank")
+    deltas = {k: int(rr1[k] - rr0[k]) for k in rr0}
+    deltas["jit_compiles"] = int(devprof.jit_compiles_total() - jc0)
+    deltas["tiles"] = tiles
+    deltas["pairs"] = pairs
+    budget = tiles + n_corpora  # per corpus: tiles + fold-init/finalize
+    excess = (
+        deltas["device_puts"] + deltas["device_dispatches"] - 2 * budget
+    )
+    telemetry.REGISTRY.gauge(
+        "astpu_rerank_launch_excess",
+        "rerank-plane puts+dispatches beyond 2*(tiles + corpora) in the "
+        "bench steady window (0 = single-dispatch contract held)",
+        always=True,
+    ).set(float(excess))
+    return warm_rate, n_articles * n_corpora / dt, deltas
 
 
 def _feed_workers() -> int | None:
@@ -878,6 +977,21 @@ def _bench_slo_engine():
     )
     objectives.append(
         {
+            # the precision tier's declared launch budget: a settled
+            # corpus costs EXACTLY tiles + 1 puts (settle tiles + fold
+            # init) and tiles + 1 dispatches (settle tiles + finalize)
+            # on the "rerank" plane — any surplus launch is a violated
+            # SLO.  The gauge only exists once a rerank regime ran
+            # (_bench_rerank), so non-rerank runs skip it instead of
+            # vacuously passing.
+            "name": "rerank_launch_budget",
+            "kind": "gauge_max",
+            "metric": "astpu_rerank_launch_excess",
+            "threshold": 0.0,
+        }
+    )
+    objectives.append(
+        {
             # the declared reject-ratio objective of the overload plane:
             # a bench run is UNLOADED relative to its own capacity, so
             # any admission activity it does produce must stay almost
@@ -931,8 +1045,8 @@ def _telemetry_ledger(slo_engine) -> dict:
 
 
 REGIMES = (
-    "uniform", "ragged", "stream", "sharded", "recall", "exact", "matcher",
-    "index", "fleet",
+    "uniform", "ragged", "stream", "sharded", "rerank", "recall", "exact",
+    "matcher", "index", "fleet",
 )
 
 
@@ -1123,6 +1237,25 @@ def main(argv=None) -> None:
                 out.update({f"sharded_{k}": v for k, v in sharded_dc.items()})
                 out["sharded_per_shard"] = sharded_ps
                 out.update(_adm_delta("sharded"))
+            if "rerank" in want:
+                rerank_warm, rerank_rate, rerank_dc = _bench_rerank(
+                    512 if quick else 4096
+                )
+                note(
+                    f"rerank done: {rerank_rate:.0f}/s steady "
+                    f"(warmup corpus {rerank_warm:.0f}/s; "
+                    f"{rerank_dc['tiles']} settle tiles over "
+                    f"{rerank_dc['pairs']} pairs, "
+                    f"{rerank_dc['device_puts']} puts / "
+                    f"{rerank_dc['device_dispatches']} dispatches steady)"
+                )
+                out["rerank_articles_per_sec"] = round(rerank_rate, 1)
+                out["rerank_warmup_articles_per_sec"] = round(rerank_warm, 1)
+                # steady window on the tier's own regime ledger; the
+                # tiles+1 launch budget is gated by the declared
+                # rerank_launch_budget SLO, not prose
+                out.update({f"rerank_{k}": v for k, v in rerank_dc.items()})
+                out.update(_adm_delta("rerank"))
             stage_ms = {k: 0.0 for k in ("encode", "h2d", "kernel", "resolve")}
             stage_ms.update(stages.snapshot_ms())
             if "recall" in want:

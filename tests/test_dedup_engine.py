@@ -183,9 +183,15 @@ def test_exact_verify_refutes_borderline_false_merge():
     mut = mutate_to_jaccard(rng, base, 0.66)
     assert jaccard(shingle_set(base, 5), shingle_set(mut, 5)) < 0.7
 
-    est_only = dataclasses.replace(DedupConfig(), exact_verify_band=0.0)
+    # rerank=False on both: this test isolates the exact-verify STAGE —
+    # the rerank tier would also refute the pair (tests/test_rerank_
+    # dispatch.py covers it), erasing the estimator-only contrast
+    est_only = dataclasses.replace(
+        DedupConfig(rerank=False), exact_verify_band=0.0
+    )
     assert NearDupEngine(est_only).dedup_reps([base, mut]).tolist() == [0, 0]
-    assert NearDupEngine().dedup_reps([base, mut]).tolist() == [0, 1]
+    cfg = DedupConfig(rerank=False)
+    assert NearDupEngine(cfg).dedup_reps([base, mut]).tolist() == [0, 1]
 
 
 def test_exact_verify_keeps_true_near_dups():

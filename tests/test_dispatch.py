@@ -122,11 +122,14 @@ def test_per_tile_traffic_one_put_one_dispatch():
             after["h2d_bytes"] - before["h2d_bytes"],
         )
 
+    # rerank=False: the traffic ledger here audits the SIGNATURE tile
+    # plane; the precision tier's own tiles+1/tiles+1 contract has its
+    # dedicated gate in test_rerank_dispatch.py on the "rerank" regime
     rep_p, tiles_p, puts_p, disp_p, bytes_p = run(
-        DedupConfig(packed_h2d=True)
+        DedupConfig(packed_h2d=True, rerank=False)
     )
     rep_l, tiles_l, puts_l, disp_l, bytes_l = run(
-        DedupConfig(packed_h2d=False)
+        DedupConfig(packed_h2d=False, rerank=False)
     )
     assert tiles_p == tiles_l and tiles_p > 1
     # packed async: 1 put/tile + 1 valid-mask put; 1 dispatch/tile + ONE
@@ -224,7 +227,12 @@ def test_fused_resolve_matches_two_stage_hook_path():
     without the fine-margin per-edge bars."""
     rng = np.random.RandomState(31)
     docs = _corpus(rng, 96)
-    for cfg in (DedupConfig(), DedupConfig(fine_margin=0.05)):
+    # rerank=False: the comparison needs a passthrough hook vs NO hook;
+    # the default tier rewrites the matrix and is covered elsewhere
+    for cfg in (
+        DedupConfig(rerank=False),
+        DedupConfig(rerank=False, fine_margin=0.05),
+    ):
         hooked = NearDupEngine(cfg)
         hooked.rerank_hook = lambda raw, sigs, rb, valid: rb  # passthrough
         a = np.asarray(hooked.dedup_reps_async(docs))

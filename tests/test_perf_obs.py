@@ -598,3 +598,113 @@ def test_ledger_rows_are_strict_json():
     line = _json.dumps(row, sort_keys=True)
     assert "Infinity" not in line
     assert _json.loads(line)["order"] is None
+
+
+# -- per-platform knob-profile store (perf-ledger dispatch defaults) ---------
+
+
+def _sweep_row(platform, source, rate, order=1):
+    return {
+        "schema": perfdb.SCHEMA,
+        "kind": "sweep",
+        "source": source,
+        "order": order,
+        "ts": 0.0,
+        "platform": platform,
+        "fingerprint": None,
+        "git_sha": "",
+        "metrics": {"ragged_articles_per_sec": rate},
+    }
+
+
+def test_parse_source_knobs_round_trip():
+    src = "sweep/onchip:rerank:n=4096,put_workers=3,window=6,tile_rows=512"
+    assert perfdb.parse_source_knobs(src) == {
+        "put_workers": 3,
+        "dispatch_window": 6,
+        "rerank_tile_rows": 512,
+    }
+    # unknown keys and malformed values are skipped, never fatal
+    assert perfdb.parse_source_knobs("sweep:ragged:n=8192,foo=1,window=oops") == {}
+    assert perfdb.parse_source_knobs("no knobs here") == {}
+
+
+def test_best_knob_profile_max_rate_same_platform_sweeps_only(tmp_path):
+    path = str(tmp_path / "ledger.jsonl")
+    led = perfdb.PerfLedger(path)
+    led.append(_sweep_row(
+        "cpu/swept-x4",
+        "sweep/onchip:ragged:n=4096,put_workers=1,window=2,tile_rows=256",
+        500.0,
+    ))
+    led.append(_sweep_row(
+        "cpu/swept-x4",
+        "sweep/onchip:ragged:n=4096,put_workers=3,window=6,tile_rows=512",
+        900.0,
+        order=2,
+    ))
+    # other platform partitions never leak across
+    led.append(_sweep_row(
+        "tpu/TPU-v5ex8",
+        "sweep/onchip:ragged:n=4096,put_workers=8,window=12,tile_rows=2048",
+        5000.0,
+        order=3,
+    ))
+    # bench rounds are not sweeps: no knob tags, excluded by kind
+    led.append(_row("cpu", "BENCH_r01.json", 4, ragged_articles_per_sec=9999.0))
+    assert perfdb.best_knob_profile(path, "cpu") == {
+        "put_workers": 3,
+        "dispatch_window": 6,
+        "rerank_tile_rows": 512,
+    }
+    assert perfdb.best_knob_profile(path, "tpu") == {
+        "put_workers": 8,
+        "dispatch_window": 12,
+        "rerank_tile_rows": 2048,
+    }
+    assert perfdb.best_knob_profile(path, "gpu") == {}
+
+
+def test_engine_knob_profile_resolution_order(tmp_path, monkeypatch):
+    """env > caller-pinned > ledger best row > dataclass default — per
+    knob, not per profile."""
+    from advanced_scrapper_tpu.config import DedupConfig
+    from advanced_scrapper_tpu.pipeline.dedup import _resolve_knob_profile
+
+    path = str(tmp_path / "perf.jsonl")
+    led = perfdb.PerfLedger(path)
+    led.append(_sweep_row(
+        "cpu/swept-x4",
+        "sweep/onchip:ragged:n=4096,put_workers=3,window=6,tile_rows=512",
+        900.0,
+    ))
+    monkeypatch.setenv("ASTPU_PERF_LEDGER", path)
+    monkeypatch.delenv("ASTPU_DEDUP_PUT_WORKERS", raising=False)
+    monkeypatch.delenv("ASTPU_DEDUP_DISPATCH_WINDOW", raising=False)
+    monkeypatch.delenv("ASTPU_DEDUP_RERANK_TILE_ROWS", raising=False)
+
+    # 3) the ledger's best same-platform row fills still-default knobs
+    cfg = _resolve_knob_profile(DedupConfig())
+    assert (cfg.put_workers, cfg.dispatch_window, cfg.rerank_tile_rows) == (
+        3, 6, 512,
+    )
+    # 2) a caller-pinned field is an explicit choice the ledger respects
+    #    — while the OTHER knobs still resolve from the row
+    cfg = _resolve_knob_profile(DedupConfig(put_workers=2))
+    assert cfg.put_workers == 2
+    assert (cfg.dispatch_window, cfg.rerank_tile_rows) == (6, 512)
+    # 1) explicit env beats both the pin and the ledger
+    monkeypatch.setenv("ASTPU_DEDUP_PUT_WORKERS", "5")
+    cfg = _resolve_knob_profile(DedupConfig(put_workers=2))
+    assert cfg.put_workers == 5
+    assert cfg.dispatch_window == 6
+    monkeypatch.delenv("ASTPU_DEDUP_PUT_WORKERS")
+
+    # 4) no ledger → untouched construction
+    monkeypatch.setenv("ASTPU_PERF_LEDGER", str(tmp_path / "missing.jsonl"))
+    assert _resolve_knob_profile(DedupConfig()) == DedupConfig()
+    # a torn/foreign ledger must never fail engine init
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"torn": ')
+    monkeypatch.setenv("ASTPU_PERF_LEDGER", str(bad))
+    assert _resolve_knob_profile(DedupConfig()) == DedupConfig()

@@ -1,0 +1,209 @@
+"""Device-traffic, determinism and parity gates for the rerank tier.
+
+The precision tier (``pipeline/rerank.py``) rides the same
+single-dispatch executor as the signature planes, so it inherits the
+same numeric contract — asserted here on the always-on ``"rerank"``
+regime ledger (``obs.stages.regime_device_counters``) rather than in
+prose:
+
+- exactly 1 ``device_put`` + 1 dispatch per packed pair tile, plus the
+  per-corpus fold-init put and finalize dispatch (``tiles + 1`` /
+  ``tiles + 1``), with ``h2d_bytes`` equal to the byte-exact sum of
+  ``pair_tile_nbytes`` over the tile shapes plus the fold-init buffer;
+- byte-stable representatives across every (put_workers,
+  dispatch_window) combination — integer quantized verdicts make the
+  fold order-independent;
+- a prewarmed engine leaves the rerank recompile sentinel FLAT on its
+  first real corpus (the settle tiles draw from the shared
+  ``tile_rows_options`` derivation);
+- host/device twin parity: ``band_keys_wide_host`` vs
+  ``ops.lsh.band_keys_wide``, and the host ``sketch_jaccard`` estimator
+  vs the vmap'd kernel's quantized verdicts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from advanced_scrapper_tpu.config import DedupConfig
+from advanced_scrapper_tpu.core.tokenizer import tile_rows_options
+from advanced_scrapper_tpu.ops import rerank as oprr
+from advanced_scrapper_tpu.ops.pack import pack_pair_tile, pair_tile_nbytes
+from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+
+def _dup_corpus(rng: np.random.RandomState, n_base=48, dup_per_base=2):
+    """Dup-heavy corpus: enough candidate pairs to force multiple
+    settle tiles at ``rerank_tile_rows=64``."""
+    docs = []
+    for _ in range(n_base):
+        base = bytearray(rng.randint(32, 127, size=400, dtype=np.uint8))
+        docs.append(bytes(base))
+        for _ in range(dup_per_base):
+            mut = bytearray(base)
+            for _ in range(rng.randint(1, 6)):
+                mut[rng.randint(0, len(mut))] = rng.randint(32, 127)
+            docs.append(bytes(mut))
+    order = rng.permutation(len(docs))
+    return [docs[i] for i in order]
+
+
+def _small_cfg(**kw):
+    """Tiny settle tiles (64 rows) so a ~150-pair corpus spans several
+    tiles; sketch 256 keeps the kernel lane-aligned but cheap."""
+    return DedupConfig(
+        rerank_tile_rows=64, rerank_sketch=256, batch_size=256, **kw
+    )
+
+
+def _expected_tile_shapes(m: int, tile_rows: int) -> list[int]:
+    """The tier's greedy power-of-two chunking over the SHARED shape
+    set — re-derived here so a chunking change that breaks the
+    prewarm/runtime shape agreement breaks this ledger too."""
+    options = sorted(tile_rows_options(max(tile_rows, 64), 64), reverse=True)
+    off, shapes = 0, []
+    while off < m:
+        rem = m - off
+        rows = next((o for o in options if o <= rem), options[-1])
+        shapes.append(rows)
+        off += min(rows, rem)
+    return shapes
+
+
+def test_rerank_regime_traffic_exactly_tiles_plus_one():
+    from advanced_scrapper_tpu.obs import stages
+
+    rng = np.random.RandomState(5)
+    docs = _dup_corpus(rng)
+    cfg = _small_cfg()
+    eng = NearDupEngine(cfg)
+    before = stages.regime_device_counters("rerank")
+    eng.dedup_reps(docs)
+    after = stages.regime_device_counters("rerank")
+    stats = eng.rerank_tier.stats
+
+    tiles = stats["tiles"]
+    assert tiles >= 2, f"corpus must span multiple tiles (got {tiles})"
+    puts = after["device_puts"] - before["device_puts"]
+    disp = after["device_dispatches"] - before["device_dispatches"]
+    h2d = after["h2d_bytes"] - before["h2d_bytes"]
+    # THE contract: 1 put + 1 dispatch per tile, plus the fold-init put
+    # and the finalize dispatch — nothing else touches the device
+    assert puts == tiles + 1, (puts, tiles)
+    assert disp == tiles + 1, (disp, tiles)
+
+    shapes = _expected_tile_shapes(stats["pairs"], cfg.rerank_tile_rows)
+    assert len(shapes) == tiles
+    tile_bytes = sum(
+        pair_tile_nbytes(r, cfg.rerank_sketch) for r in shapes
+    )
+    fold_init_bytes = cfg.rerank_pair_cap * 4  # int32[cap]
+    assert stats["h2d_bytes"] == tile_bytes  # tier ledger: tiles only
+    assert h2d == tile_bytes + fold_init_bytes  # regime ledger: + fold
+
+
+def test_rerank_verdicts_byte_stable_across_knobs():
+    rng = np.random.RandomState(17)
+    docs = _dup_corpus(rng)
+    want = None
+    want_stats = None
+    for pw, win in ((1, 1), (3, 1), (4, 6)):
+        eng = NearDupEngine(_small_cfg(put_workers=pw, dispatch_window=win))
+        got = np.asarray(eng.dedup_reps(docs))
+        stats = {
+            k: eng.rerank_tier.stats[k]
+            for k in ("pairs", "tiles", "evicted", "clusters")
+        }
+        if want is None:
+            want, want_stats = got, stats
+            continue
+        assert (got == want).all(), (pw, win)
+        assert stats == want_stats, (pw, win)
+
+
+def test_rerank_prewarm_keeps_recompile_sentinel_flat():
+    from advanced_scrapper_tpu.obs import devprof
+
+    rng = np.random.RandomState(23)
+    docs = _dup_corpus(rng)
+    eng = NearDupEngine(_small_cfg())
+    eng.prewarm(len(docs))
+    by_kernel = devprof.jit_compiles_by_kernel()
+    base = {
+        k: v for k, v in by_kernel.items() if k.startswith("rerank")
+    }
+    assert base, "prewarm must have compiled the rerank shape set"
+    eng.dedup_reps(docs)
+    assert eng.rerank_tier.stats["tiles"] >= 2
+    after = {
+        k: v
+        for k, v in devprof.jit_compiles_by_kernel().items()
+        if k.startswith("rerank")
+    }
+    assert after == base, "first real corpus recompiled a rerank kernel"
+
+
+def test_band_keys_wide_host_matches_device():
+    import jax.numpy as jnp
+
+    from advanced_scrapper_tpu.core.hashing import make_params
+    from advanced_scrapper_tpu.ops.lsh import band_keys_wide
+
+    params = make_params()
+    rng = np.random.RandomState(3)
+    sigs = rng.randint(0, 1 << 31, (37, params.num_perm)).astype(np.uint32)
+    salt = np.asarray(params.band_salt)
+    host = oprr.band_keys_wide_host(sigs, salt)
+    dev = np.asarray(band_keys_wide(jnp.asarray(sigs), jnp.asarray(salt)))
+    assert host.shape == dev.shape
+    assert (host == dev).all()
+
+
+def test_sketch_kernel_matches_host_estimator():
+    """The vmap'd settle kernel's quantized verdicts == quantize(host
+    sketch_jaccard) per pair — including all-PAD rows (both-empty ⇒ J=1)
+    and pad slots (scatter-dropped, fold untouched)."""
+    import jax
+
+    rng = np.random.RandomState(41)
+    k, size, rows, cap = 5, 256, 64, 512
+    texts = []
+    for _ in range(40):
+        base = bytearray(rng.randint(32, 127, size=300, dtype=np.uint8))
+        texts.append(bytes(base))
+        mut = bytearray(base)
+        for _ in range(rng.randint(1, 40)):
+            mut[rng.randint(0, len(mut))] = rng.randint(32, 127)
+        texts.append(bytes(mut))
+    texts.append(b"xy")  # sub-shingle: all-PAD sketch
+    texts.append(b"ab")
+    sk = oprr.bottom_sketches(texts, k, size)
+    n = len(texts)
+    ii = rng.randint(0, n, rows).astype(np.int64)
+    jj = rng.randint(0, n, rows).astype(np.int64)
+    ii[-1], jj[-1] = n - 2, n - 1  # the all-PAD pair
+
+    idx = np.arange(rows, dtype=np.int32)
+    idx[::7] = cap  # every 7th slot: pad row, scatter must drop it
+    packed = pack_pair_tile(sk[ii], sk[jj], idx)
+    fold = jax.device_put(np.full(cap, -7, np.int32))
+    fold = oprr.make_rerank_tile_step(rows, size)(fold, jax.device_put(packed))
+    got = np.asarray(fold)
+    for s in range(rows):
+        want = oprr.quantize(oprr.sketch_jaccard(sk[ii[s]], sk[jj[s]]))
+        if idx[s] == cap:
+            continue  # dropped: asserted via untouched slots below
+        assert got[idx[s]] == want, (s, int(ii[s]), int(jj[s]))
+    untouched = np.setdiff1d(np.arange(cap), idx[idx < cap])
+    assert (got[untouched] == -7).all(), "pad rows leaked into the fold"
+
+
+def test_finalize_verdict_bands():
+    import jax.numpy as jnp
+
+    fin = oprr.make_rerank_finalize()
+    lo, hi = np.int32(6600), np.int32(7400)
+    fold = jnp.asarray(np.array([0, 6599, 6600, 7399, 7400, 10000], np.int32))
+    out, verdict = fin(fold, lo, hi)
+    assert np.asarray(verdict).tolist() == [0, 0, -1, -1, 1, 1]
+    assert (np.asarray(out) == np.asarray(fold)).all()

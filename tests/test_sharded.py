@@ -141,8 +141,13 @@ def test_sharded_fine_margin_matches_async_engine(mesh8):
             mesh8, PARAMS, fine_margin=margin
         )(t, l)
         by_margin[margin] = np.asarray(rep_sharded)
+        # rerank=False: parity is against the raw sharded kernel, which
+        # has no rerank tier — the default engine would re-settle the
+        # knee pairs on top of the fine-margin path under test
         rep_async = np.asarray(
-            NearDupEngine(DedupConfig(fine_margin=margin)).dedup_reps_async(texts)
+            NearDupEngine(
+                DedupConfig(fine_margin=margin, rerank=False)
+            ).dedup_reps_async(texts)
         )[: len(texts)]
         np.testing.assert_array_equal(by_margin[margin], rep_async)
 

@@ -92,6 +92,15 @@ ALLOW: dict[str, tuple[str, ...]] = {
 #: mechanism (its reshard callback is injected by the caller).
 MODULE_RULES: dict[str, tuple[tuple[str, ...], bool]] = {
     os.path.join("index", "reshard.py"): (("pipeline", "net"), False),
+    # the rerank settle math is a pure leaf: the tier's orchestration
+    # half (pipeline/rerank.py) drives it one-way, and the borderline
+    # ANN re-probe consults the INDEX through an injected handle — an
+    # ops.rerank→index import would drag the durable store (and its
+    # storage/ stack) into every kernel test
+    os.path.join("ops", "rerank.py"): (
+        ("index", "storage", "extractors", "parallel"),
+        False,
+    ),
     os.path.join("runtime", "autoscaler.py"): (
         ("pipeline", "extractors", "net", "index", "storage", "parallel"),
         False,

@@ -2,7 +2,12 @@
 
 VERDICT r3 item 1 wants BENCH_r04 captured on the chip with the ragged and
 stream regimes swept over their tuning knobs (feed workers, put workers,
-batch size).  The tunnel has repeatedly died mid-session, so this driver is
+batch size); the rerank axis sweeps the precision tier over
+(put_workers, dispatch_window, rerank_tile_rows), and its ledger rows
+carry the knobs in the source tag (``sweep:rerank:n=...,put_workers=...``)
+so ``obs/perfdb.parse_source_knobs`` → the engine's per-platform
+knob-profile store can adopt each platform's best point automatically.
+The tunnel has repeatedly died mid-session, so this driver is
 built for hostile transport: every configuration runs in its OWN subprocess
 under a hard watchdog, results append to a JSONL file as they land, and a
 dead config (hang or transport error) is recorded and skipped rather than
@@ -112,6 +117,45 @@ with xla_trace(os.environ.get("ASTPU_TRACE_DIR") or None):
     rep = np.asarray(engine.dedup_reps_async(corpus))[:n]
     dt = time.perf_counter() - t0
 print(json.dumps({{"articles_per_sec": round(n / dt, 1)}}))
+"""
+
+RERANK_SNIPPET = """
+import json, os, sys, time
+import numpy as np
+sys.path.insert(0, {here!r})
+# the swept pins must be authoritative: the engine's knob-profile
+# resolver honors env > pin > ledger-best, so a stray knob env (or the
+# sweep's own ledger) would silently collapse the grid to one point
+for _k in (
+    "ASTPU_PERF_LEDGER", "ASTPU_DEDUP_PUT_WORKERS",
+    "ASTPU_DEDUP_DISPATCH_WINDOW", "ASTPU_DEDUP_RERANK_TILE_ROWS",
+    "ASTPU_DEDUP_RERANK",
+):
+    os.environ.pop(_k, None)
+import jax
+import bench
+from advanced_scrapper_tpu.config import DedupConfig
+from advanced_scrapper_tpu.pipeline.dedup import NearDupEngine
+
+n = {n_articles}
+rng = np.random.RandomState(11)
+engine = NearDupEngine(DedupConfig(
+    rerank=True, put_workers={put_workers}, dispatch_window={window},
+    rerank_tile_rows={tile_rows},
+))
+engine.prewarm(n)                      # compile the settle-tile shape set
+engine.dedup_reps(bench._rerank_corpus(rng, n))   # warm the full path
+corpus = bench._rerank_corpus(rng, n)
+from advanced_scrapper_tpu.obs.profiler import xla_trace
+with xla_trace(os.environ.get("ASTPU_TRACE_DIR") or None):
+    t0 = time.perf_counter()
+    rep = engine.dedup_reps(corpus)[:n]
+    dt = time.perf_counter() - t0
+print(json.dumps({{
+    "articles_per_sec": round(n / dt, 1),
+    "rerank_tiles": int(engine.rerank_tier.stats.get("tiles", 0)),
+    "rerank_pairs": int(engine.rerank_tier.stats.get("pairs", 0)),
+}}))
 """
 
 SHARDED_SNIPPET = """
@@ -354,6 +398,34 @@ def main() -> None:
             run_config(
                 f"ragged:n={ragged_n},put_workers={pw}", snip, env,
                 args.timeout,
+            ),
+            snip,
+        )
+    # precision-tier axis: the rerank regime over (put_workers, window,
+    # tile_rows).  The config tag's k=v tail is the ledger-source grammar
+    # obs/perfdb.parse_source_knobs reads back, so the engine's
+    # per-platform knob-profile store adopts each platform's best point
+    # automatically (pipeline.dedup._resolve_knob_profile)
+    rr_grid = (
+        ((1, 2, 512), (4, 6, 1024))
+        if args.quick
+        else tuple(
+            (pw, win, tr)
+            for pw in (1, 4)
+            for win in (2, 6)
+            for tr in (512, 1024, 2048)
+        )
+    )
+    for pw, win, tr in rr_grid:
+        snip = RERANK_SNIPPET.format(
+            here=HERE, n_articles=ragged_n,
+            put_workers=pw, window=win, tile_rows=tr,
+        )
+        emit(
+            run_config(
+                f"rerank:n={ragged_n},put_workers={pw},window={win},"
+                f"tile_rows={tr}",
+                snip, env, args.timeout,
             ),
             snip,
         )
