@@ -623,6 +623,12 @@ class TpuBatchBackend:
 
     # -- internals ---------------------------------------------------------
 
+    @staticmethod
+    def _decision_recorder():
+        from advanced_scrapper_tpu.obs.decisions import get_recorder
+
+        return get_recorder()
+
     def _process(self) -> list[dict]:
         records, self._buffer = self._buffer, []
         self.stats.batches += 1
@@ -699,6 +705,23 @@ class TpuBatchBackend:
                     self.stats.exact_dups += 1
                 else:
                     rec["dup_of"] = None
+            drec = self._decision_recorder()
+            n_dup = int((url_attr >= 0).sum())
+            drec.count("exact", "dup", n_dup)
+            drec.count("exact", "unique", int(keyed.sum()) - n_dup)
+            if drec.journal is not None:
+                drec.journal_rows(
+                    {
+                        "doc": int(doc_ids[i]),
+                        "name": _key_of(records[i], self.key_field),
+                        "verdict": "dup" if url_attr[i] >= 0 else "unique",
+                        "tier": "exact",
+                        "attr": int(url_attr[i]),
+                        "band_key": int(url_hash[i]),
+                        "regime": "stream",
+                    }
+                    for i in np.flatnonzero(keyed).tolist()
+                )
         elif self._bloom_mode:
             # 64-bit url hash: a collision here is an unverifiable false
             # "exact dup" drop, so 32-bit (crc32) key width was the dominant
@@ -722,16 +745,26 @@ class TpuBatchBackend:
                     self.stats.exact_dups += 1
                 else:
                     rec["dup_of"] = None
+            drec = self._decision_recorder()
+            n_dup = int(url_dup.sum())
+            drec.count("exact", "dup", n_dup)
+            drec.count("exact", "unique", int(keyed.sum()) - n_dup)
         else:
+            n_dup = n_uni = 0
             for rec in records:
                 key = _key_of(rec, self.key_field)
                 if key and key in self._seen_keys:
                     rec["dup_of"] = key
                     self.stats.exact_dups += 1
+                    n_dup += 1
                 else:
                     rec["dup_of"] = None
                     if key:
                         self._seen_keys.add(key)
+                        n_uni += 1
+            drec = self._decision_recorder()
+            drec.count("exact", "dup", n_dup)
+            drec.count("exact", "unique", n_uni)
 
         # near-dup stage: device signatures + band keys (computed together
         # in the engine's fused epilogue — one dispatch off the
@@ -760,6 +793,7 @@ class TpuBatchBackend:
         # widening its key set would trade its bounded-memory contract for
         # unverifiable drops.)
         sigs, keys = self.engine.signatures_and_keys(texts)
+        nd_dup = nd_uni = 0
         for i, rec in enumerate(records):
             rec["near_dup_of"] = None
             if rec["dup_of"] is not None:
@@ -790,6 +824,7 @@ class TpuBatchBackend:
             if candidate is not None:
                 rec["near_dup_of"] = candidate
                 self.stats.near_dups += 1
+                nd_dup += 1
             else:
                 sig_idx = len(self._kept_sigs)
                 # copy: a row view would pin the whole batch array forever
@@ -799,6 +834,12 @@ class TpuBatchBackend:
                 for b in range(keys.shape[1]):
                     self._buckets.setdefault((b, int(keys[i, b])), sig_idx)
                 self.stats.kept += 1
+                nd_uni += 1
+        # in-memory stream index: verdicts settle on band collision +
+        # signature agreement — the "band" tier
+        drec = self._decision_recorder()
+        drec.count("band", "dup", nd_dup)
+        drec.count("band", "unique", nd_uni)
 
         if self.sink is not None:
             for rec in records:
@@ -851,6 +892,11 @@ class TpuBatchBackend:
                 self.stats.near_dups += 1
             elif eligible[i]:
                 self.stats.kept += 1
+        # bloom stream index: membership-settled verdicts (no attribution
+        # to journal — the filter stores no doc ids)
+        drec = self._decision_recorder()
+        drec.count("index", "dup", int(dup.sum()))
+        drec.count("index", "unique", int(eligible.sum()) - int(dup.sum()))
         if self.sink is not None:
             for rec in records:
                 self.sink(rec)
@@ -906,7 +952,48 @@ class TpuBatchBackend:
                 self.stats.near_dups += 1
             elif eligible[i]:
                 self.stats.kept += 1
+        self._emit_stream_decisions(records, attr, keys, doc_ids, eligible)
         if self.sink is not None:
             for rec in records:
                 self.sink(rec)
         return records
+
+    def _emit_stream_decisions(
+        self, records, attr, keys, doc_ids, eligible
+    ) -> None:
+        """Decision provenance for the persist near-dup stage: every
+        eligible row settled at tier "index" (posting hit or fresh post).
+        Journal rows carry the row's STABLE doc id and url — the join
+        keys ``tools/explain_dedup.py`` resolves against the docmap —
+        and dup rows' winning band keys come from a per-key re-probe of
+        their own (already-posted) keys: the column whose per-key
+        attribution equals the row's answer is the colliding band.  The
+        re-probe runs only when the journal is enabled."""
+        drec = self._decision_recorder()
+        dup_rows = np.flatnonzero(attr >= 0)
+        n_dup = int(dup_rows.size)
+        drec.count("index", "dup", n_dup)
+        drec.count("index", "unique", int(eligible.sum()) - n_dup)
+        if drec.journal is None:
+            return
+        band_keys: dict[int, int | None] = {}
+        if n_dup:
+            nb = keys.shape[1]
+            probed = np.asarray(
+                self._pindex.probe_batch(keys[dup_rows].reshape(-1))
+            ).reshape(n_dup, nb)
+            for x, i in enumerate(dup_rows.tolist()):
+                cols = np.flatnonzero(probed[x] == attr[i])
+                band_keys[i] = int(keys[i, cols[0]]) if cols.size else None
+        drec.journal_rows(
+            {
+                "doc": int(doc_ids[i]),
+                "name": _key_of(records[i], self.key_field),
+                "verdict": "dup" if attr[i] >= 0 else "unique",
+                "tier": "index",
+                "attr": int(attr[i]),
+                "band_key": band_keys.get(int(i)),
+                "regime": "stream",
+            }
+            for i in np.flatnonzero(eligible).tolist()
+        )

@@ -62,6 +62,7 @@ from advanced_scrapper_tpu.index.repair import (
     mix64,
     range_mask,
 )
+from advanced_scrapper_tpu.index.remote import CANARY_SPACE_PREFIX
 from advanced_scrapper_tpu.index.store import NO_DOC, resolve_intra_batch
 from advanced_scrapper_tpu.runtime import FanoutPool
 from advanced_scrapper_tpu.index.wal import WriteAheadLog, replay_wal
@@ -2142,6 +2143,74 @@ class ShardedIndexClient:
                     self._note_failure(sh, target)
             out["shards"].append(st)
         return out
+
+    def wipe(self) -> int:
+        """Expire every posting of this CANARY space fleet-wide; returns
+        the total dropped count.
+
+        Refused client-side (and again server-side) for any space outside
+        the reserved ``canary:`` prefix — the prober's between-rounds
+        expiry must be structurally unable to touch real postings.  Fans
+        to EVERY node of every shard, not just the write target: replicas
+        hold synchronously replicated copies, and a wipe that missed one
+        would resurrect canary postings at the next failover.  A node
+        that is down or overloaded is skipped (its copy is wiped when the
+        next round's wipe reaches it; canary spaces are never repaired
+        back).  Pending spill entries for the space are dropped too — a
+        replayed canary posting after expiry would be pollution."""
+        if not self.space.startswith(CANARY_SPACE_PREFIX):
+            raise ValueError(
+                f"wipe is restricted to {CANARY_SPACE_PREFIX!r}-prefixed "
+                f"spaces, not {self.space!r}"
+            )
+        dropped = 0
+        for sh in self._shards:
+            with sh.lock:
+                sh.pending.clear()
+                sh.overlay.clear()
+            for node in sh.nodes:
+                if not node.alive:
+                    continue
+                try:
+                    h, _ = self._node_call(
+                        sh, node, "wipe", {"space": self.space},
+                        budget=self.timeout,
+                    )
+                    dropped += int(h.get("dropped", 0))
+                except RpcOverloaded:
+                    pass
+                except RpcUnavailable:
+                    self._note_failure(sh, node)
+        return dropped
+
+    def for_space(self, space: str, *, spill_dir: str | None = None):
+        """A sibling client over the SAME topology for another key space
+        — the canary prober's entry point: given the live fleet client,
+        build the isolated ``canary:…`` namespace client without
+        re-plumbing addresses.  Construction knobs are replayed exactly
+        (the ctor saved them for topology growth); the spill journal
+        defaults OFF — synthetic canary postings must never durably
+        journal into a real spill directory."""
+        return ShardedIndexClient(
+            self.spec,
+            space=space,
+            spill_dir=spill_dir,
+            timeout=self.timeout,
+            retries=self._retries,
+            health_checks=self.health_checks,
+            health_timeout=self.health_timeout,
+            vnodes=self.vnodes,
+            connect=self._connect,
+            seed=self._seed,
+            fs=self._fs,
+            overload_backoff_cap=self.overload_backoff_cap,
+            overload_budget=self.overload_budget,
+            sleep=self._sleep,
+            gap_limit_postings=self.gap_limit_postings,
+            repair_interval=0,
+            resync_rounds=self.resync_rounds,
+            digest_bits=self.digest_bits,
+        )
 
     def close(self) -> None:
         """Release sockets + journals.  Spilled-but-unreplayed postings

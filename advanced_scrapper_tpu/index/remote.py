@@ -43,6 +43,14 @@ __all__ = ["IndexShardServer", "RemoteIndex", "paged_fetch_range", "serve_main"]
 
 DEFAULT_SPACES = ("bands", "urls")
 
+#: reserved key-space name prefix for the ground-truth canary prober
+#: (``obs/canary.py`` declares the same literal — it may not import this
+#: layer).  Spaces under it are auto-provisioned on first touch, and they
+#: are the ONLY spaces the ``wipe`` RPC will drop: synthetic canary
+#: postings expire wholesale between probe rounds, while a stray wipe
+#: aimed at a real space is refused server-side.
+CANARY_SPACE_PREFIX = "canary:"
+
 
 class IndexShardServer:
     """One fleet shard: N persistent-index key spaces behind one RPC port."""
@@ -130,13 +138,15 @@ class IndexShardServer:
                 shed_at=PRIORITY_NORMAL,
                 name=f"shard:{self.name}",
             )
+        # saved for lazily provisioning canary: spaces with the same
+        # durability knobs the declared spaces got
+        self._index_kw = dict(
+            cut_postings=cut_postings,
+            compact_segments=compact_segments,
+            compact_inline=compact_inline,
+        )
         self.indexes: dict[str, PersistentIndex] = {
-            sp: PersistentIndex(
-                os.path.join(directory, sp),
-                cut_postings=cut_postings,
-                compact_segments=compact_segments,
-                compact_inline=compact_inline,
-            )
+            sp: PersistentIndex(os.path.join(directory, sp), **self._index_kw)
             for sp in spaces
         }
         self.server = rpc.RpcServer(
@@ -164,6 +174,8 @@ class IndexShardServer:
                 "retire_range": self._h_retire_range,
                 "unretire_range": self._h_unretire_range,
                 "reshard_mark": self._h_reshard_mark,
+                # canary-space expiry (restricted to the canary: prefix)
+                "wipe": self._h_wipe,
             },
             host=host,
             port=port,
@@ -226,9 +238,25 @@ class IndexShardServer:
         try:
             return self.indexes[sp]
         except KeyError:
-            raise KeyError(
-                f"shard {self.name} hosts {sorted(self.indexes)}, not {sp!r}"
-            ) from None
+            pass
+        if sp.startswith(CANARY_SPACE_PREFIX):
+            # canary spaces are provisioned on first touch: the prober
+            # needs a live fleet to answer under an isolated namespace
+            # without every deployment pre-declaring it.  Real spaces
+            # stay declaration-only — a typo'd space name must fail, not
+            # silently shadow the intended postings.
+            with self._lock:
+                idx = self.indexes.get(sp)
+                if idx is None and not self._stopped:
+                    idx = PersistentIndex(
+                        os.path.join(self.dir, sp), **self._index_kw
+                    )
+                    self.indexes[sp] = idx
+            if idx is not None:
+                return idx
+        raise KeyError(
+            f"shard {self.name} hosts {sorted(self.indexes)}, not {sp!r}"
+        )
 
     # -- handlers ----------------------------------------------------------
 
@@ -412,6 +440,23 @@ class IndexShardServer:
         idx.unretire_range(int(header["lo"]), int(header["hi"]))
         return {"handed_off": len(idx.handed_off_ranges())}
 
+    def _h_wipe(self, header, arrays):
+        """Drop every posting of ONE canary space (crash-safe committed
+        wipe, doc-id high-water preserved).  Refused for any space
+        outside the reserved prefix: expiry is a canary-plane verb, not
+        a general data-deletion API."""
+        sp = header.get("space", "")
+        if not sp.startswith(CANARY_SPACE_PREFIX):
+            raise ValueError(
+                f"wipe is restricted to {CANARY_SPACE_PREFIX!r}-prefixed "
+                f"spaces, not {sp!r}"
+            )
+        idx = self.indexes.get(sp)
+        if idx is None:
+            return {"dropped": 0}  # never provisioned here: idempotent
+        with self._lock:
+            return {"dropped": int(idx.wipe())}
+
     def _h_reshard_mark(self, header, arrays):
         """Set/clear/read the mid-reshard fence on every space this node
         hosts (a reshard moves the whole node's ring slice, not one
@@ -552,6 +597,12 @@ class RemoteIndex:
 
     def checkpoint(self) -> None:
         self._call("checkpoint")
+
+    def wipe(self) -> int:
+        """Expire this space's postings (canary spaces only — the server
+        refuses others); returns the dropped posting count."""
+        h, _ = self._call("wipe")
+        return int(h.get("dropped", 0))
 
     # -- self-healing plane ------------------------------------------------
 

@@ -1077,3 +1077,74 @@ class PersistentIndex:
             except OSError:
                 pass
             self._wal.close()
+
+    def wipe(self) -> int:
+        """Drop every posting — segments, memtable, WAL — in one committed
+        step; returns the physical posting count dropped.
+
+        The canary-space expiry primitive: a probe round's synthetic
+        postings must vanish completely between rounds, but the doc-id
+        high-water mark survives (``next_doc_id`` is monotone forever —
+        reissuing an id would silently re-point any surviving external
+        attribution, the :meth:`allocate_doc_ids` contract).
+
+        Crash-safe the same way a cut is: the new (empty) WAL generation
+        opens first, the manifest swap naming zero segments + the new
+        generation is the commit point, and only then are the superseded
+        files deleted — a crash before the commit reopens the old state
+        intact, one after it sweeps the leftovers as orphans.  The docmap
+        sidecar is dropped too (best-effort, like its writes): wiped
+        postings must not leave attribution ghosts for explain queries.
+        """
+        self._check_writable()
+        with self._lock:
+            n = sum(s.count for s in self._segments) + self._mem_count
+            old_segments = list(self._segments)
+            old_digests = dict(self._digests)
+            old_wal = self._wal
+            old_wal_path = old_wal.path
+            self._segments = []
+            self._digests = {}
+            self._wal_seq += 1
+            try:
+                new_wal = WriteAheadLog(
+                    os.path.join(self.dir, _wal_name(self._wal_seq)),
+                    fs=self._fs,
+                )
+                try:
+                    self._write_manifest()  # ← the commit point
+                except BaseException:
+                    new_wal.close()
+                    try:
+                        self._fs.remove(new_wal.path)
+                    except OSError:
+                        pass
+                    raise
+            except BaseException:
+                self._segments = old_segments
+                self._digests = old_digests
+                self._wal_seq -= 1
+                raise
+            self._mem_keys, self._mem_docs = [], []
+            self._mem_count = 0
+            self._mem_map = {}
+            self._semantic_cache = None
+            self._wal = new_wal
+            old_wal.close()
+            try:
+                self._fs.remove(old_wal_path)
+            except OSError:
+                pass
+            for s in old_segments:
+                s.close()
+                try:
+                    self._fs.remove(s.path)
+                except OSError:
+                    pass
+            docmap = os.path.join(self.dir, DOCMAP)
+            try:
+                if self._fs.exists(docmap):
+                    self._fs.remove(docmap)
+            except OSError:
+                pass
+            return n

@@ -50,6 +50,7 @@ __all__ = [
     "row_from_result",
     "rows_from_artifact",
     "scan_repo_artifacts",
+    "row_from_canary_sli",
     "trajectories",
     "compute_verdicts",
     "build_report",
@@ -196,6 +197,48 @@ def row_from_result(
         or result.get("git_sha")
         or "",
         "metrics": flatten_metrics(result),
+    }
+
+
+def row_from_canary_sli(
+    sli: dict,
+    *,
+    platform: str,
+    source: str = "canary",
+    ts: float | None = None,
+    git: str = "",
+) -> dict:
+    """One ledger row from a canary prober SLI dict
+    (``obs/canary.py``'s ``run_round`` result) — live quality joins the
+    same platform-partitioned trajectory engine as bench throughput, so
+    a recall slide across rounds shows up in ``tools/perf_ledger.py``
+    like any perf regression.  The ``recall``/``precision`` keys carry
+    their higher-is-better direction by prefix and
+    ``canary_latency_seconds`` its lower-is-better by suffix; the shape
+    counters (``oracle_pairs``…) keep trajectories but draw no verdict.
+    """
+    metrics = {
+        f"canary_{k}": float(v)
+        for k, v in sli.items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+        and (not isinstance(v, float) or math.isfinite(v))
+    }
+    # leaf-first direction resolution reads the prefix off the leaf:
+    # strip the canary_ prefix from the quality SLIs so they land in the
+    # recall/precision higher-is-better family
+    for k in ("recall", "precision"):
+        if f"canary_{k}" in metrics:
+            metrics[k] = metrics.pop(f"canary_{k}")
+    return {
+        "schema": SCHEMA,
+        "kind": "canary",
+        "source": source,
+        "order": None,
+        "ts": time.time() if ts is None else ts,
+        "platform": platform,
+        "fingerprint": None,
+        "git_sha": git,
+        "metrics": metrics,
     }
 
 
@@ -354,6 +397,14 @@ class PerfLedger:
 
     def ingest_result(self, result: dict, **kw) -> dict:
         row = row_from_result(result, **kw)
+        self.append(row)
+        return row
+
+    def ingest_canary_sli(self, sli: dict, *, platform: str, **kw) -> dict:
+        """Append one live-quality row (``row_from_canary_sli``); a
+        canary scheduler points here so every probe round grows the
+        same trajectory the bench rounds live in."""
+        row = row_from_canary_sli(sli, platform=platform, **kw)
         self.append(row)
         return row
 

@@ -1447,6 +1447,10 @@ class NearDupEngine:
         from advanced_scrapper_tpu.obs.telemetry import NOOP
 
         need = np.asarray(need_dev)
+        # decision provenance: which pairs the margin tier re-settled
+        # (exact Jaccard, or the strict estimator bar past the cap) —
+        # consumed by _emit_decisions when attributing verdict tiers
+        self._last_exact_pairs = {}
         if self._m_cand is not NOOP:
             # metric-only host work (skipped when telemetry is disabled),
             # counted BEFORE the borderline early-return: candidate volume
@@ -1498,7 +1502,144 @@ class NearDupEngine:
             if not pairs[key]:
                 ok[r, c] = False  # exact Jaccard (or strict bar) refuted it
         self._m_exact_checks.inc(checked)
+        self._last_exact_pairs = pairs
         return ok
+
+    def _emit_decisions(self, regime: str, out, keys_dev, n: int) -> None:
+        """Decision-provenance emission for the certified one-shot path:
+        per-verdict tier counters always, journal rows (with the winning
+        band key) only when the journal is enabled — the keys D2H sync is
+        gated on it, so the disabled journal costs zero extra transfers.
+
+        Tier attribution joins the resolve output against the settling
+        evidence the tiers left behind: the rerank hook's
+        ``last_provenance`` (host-resettled pairs → margin/reprobe,
+        everything else the device sketch settled → rerank, evicted
+        members → rerank uniques), or — hookless — the margin stage's
+        ``_last_exact_pairs``.  A doc with no settling evidence was
+        decided by raw band collision geometry ("band").  The async path
+        deliberately never emits: it never syncs verdicts to host, and a
+        provenance sync would break that contract — streaming callers get
+        provenance from the index path instead.
+        """
+        from advanced_scrapper_tpu.obs.decisions import get_recorder
+
+        rec = get_recorder()
+        out = np.asarray(out)[:n]
+        dup = out != np.arange(n)
+        if self._rerank_applied:
+            prov = getattr(self.rerank_hook, "last_provenance", None) or {}
+            evicted = getattr(self.rerank_hook, "last_evicted", None) or set()
+            participants = getattr(
+                self.rerank_hook, "last_participants", None
+            ) or set()
+            # strongest host evidence per doc: reprobe > margin
+            host_tier: dict[int, str] = {}
+            for (a, b), t in prov.items():
+                if t in ("margin", "reprobe"):
+                    for d in (a, b):
+                        if t == "reprobe" or d not in host_tier:
+                            host_tier[d] = t
+
+            def dup_tier(i: int, r: int) -> str:
+                key = (i, r) if i < r else (r, i)
+                return prov.get(key, "rerank")
+
+            def uniq_tier(i: int) -> str:
+                if i in evicted:
+                    return "rerank"
+                t = host_tier.get(i)
+                if t is not None:
+                    return t
+                return "rerank" if i in participants else "band"
+        else:
+            pairs = getattr(self, "_last_exact_pairs", None) or {}
+            margin_docs = {d for k in pairs for d in k}
+
+            def dup_tier(i: int, r: int) -> str:
+                key = (i, r) if i < r else (r, i)
+                return "margin" if key in pairs else "band"
+
+            def uniq_tier(i: int) -> str:
+                return "margin" if i in margin_docs else "band"
+
+        tiers = [
+            dup_tier(i, int(out[i])) if dup[i] else uniq_tier(i)
+            for i in range(n)
+        ]
+        counts: dict[tuple[str, bool], int] = {}
+        for i, t in enumerate(tiers):
+            k = (t, bool(dup[i]))
+            counts[k] = counts.get(k, 0) + 1
+        for (t, is_dup), c in counts.items():
+            rec.count(t, "dup" if is_dup else "unique", c)
+        if rec.journal is None:
+            return
+        keys = np.asarray(keys_dev)[:n]  # journal-gated D2H sync
+        rows = []
+        for i in range(n):
+            r = int(out[i])
+            band_key = None
+            if dup[i]:
+                # winning band: the first candidate column where this
+                # doc's key collides with its representative's (None for
+                # purely transitive merges)
+                cols = np.flatnonzero(keys[i] == keys[r])
+                if cols.size:
+                    band_key = int(keys[i, cols[0]])
+            rows.append(
+                {
+                    "doc": i,
+                    "verdict": "dup" if dup[i] else "unique",
+                    "tier": tiers[i],
+                    "attr": r if dup[i] else -1,
+                    "band_key": band_key,
+                    "regime": regime,
+                }
+            )
+        rec.journal_rows(rows)
+
+    def _emit_index_decisions(self, out, keys64, eligible, index) -> None:
+        """Decision provenance for the streaming-index path: every
+        eligible row's verdict settled at tier "index" (a persistent
+        posting hit, or a fresh post).  When the journal is enabled, dup
+        rows' winning band keys come from a per-key re-probe of their own
+        (already-posted) keys: the column whose per-key attribution
+        equals the row's answer is the colliding band — works for
+        cross-run and intra-batch attributions alike, no index API
+        change, and runs only when journaling."""
+        from advanced_scrapper_tpu.obs.decisions import get_recorder
+
+        rec = get_recorder()
+        out = np.asarray(out)
+        dup_rows = np.flatnonzero(out >= 0)
+        n_dup = int(dup_rows.size)
+        rec.count("index", "dup", n_dup)
+        rec.count("index", "unique", int(eligible.sum()) - n_dup)
+        if rec.journal is None:
+            return
+        k2 = keys64 if keys64.ndim == 2 else keys64.reshape(out.shape[0], -1)
+        band_keys: dict[int, int | None] = {}
+        if n_dup:
+            nb = k2.shape[1]
+            attr = np.asarray(
+                index.probe_batch(k2[dup_rows].reshape(-1))
+            ).reshape(n_dup, nb)
+            for x, i in enumerate(dup_rows.tolist()):
+                cols = np.flatnonzero(attr[x] == out[i])
+                band_keys[i] = int(k2[i, cols[0]]) if cols.size else None
+        rows = [
+            {
+                "doc": int(i),
+                "verdict": "dup" if out[i] >= 0 else "unique",
+                "tier": "index",
+                "attr": int(out[i]),
+                "band_key": band_keys.get(int(i)),
+                "regime": "stream",
+            }
+            for i in np.flatnonzero(eligible).tolist()
+        ]
+        rec.journal_rows(rows)
 
     def dedup_reps(self, texts: Sequence[str | bytes]) -> np.ndarray:
         """int32[N] first-seen-wins representative per text (union-find
@@ -1542,6 +1683,7 @@ class NearDupEngine:
             stages.count_dispatch("dedup")
             out = np.asarray(rep)[:n]
         self._count_result("oneshot", n, out)
+        self._emit_decisions("oneshot", out, keys, n)
         return out
 
     def keep(self, texts: Sequence[str | bytes]) -> np.ndarray:
@@ -1691,6 +1833,7 @@ class NearDupEngine:
         out[eligible] = index.check_and_add_batch(
             keys64[eligible], doc_ids[eligible]
         )
+        self._emit_index_decisions(out, keys64, eligible, index)
         return out
 
 
@@ -1730,6 +1873,19 @@ class ExactDedup:
         self.last_path: str = ""
 
     def keep_indices(self, items: Sequence[str]) -> list[int]:
+        keep = self._keep_indices(items)
+        if items:
+            # decision provenance: the exact (memcmp) tier settled every
+            # verdict here — kept rows are first-seen uniques, the rest
+            # byte-identical dups of an earlier row
+            from advanced_scrapper_tpu.obs.decisions import get_recorder
+
+            rec = get_recorder()
+            rec.count("exact", "unique", len(keep))
+            rec.count("exact", "dup", len(items) - len(keep))
+        return keep
+
+    def _keep_indices(self, items: Sequence[str]) -> list[int]:
         if not items:
             return []
         if not self._custom_hasher:

@@ -282,12 +282,22 @@ class RerankTier:
             exact_checks=0, reprobes=0, evicted=0, clusters=0,
             dropped_cells=0, predicted_precision=1.0,
         )
+        # decision provenance for the engine's emission pass: pairs the
+        # HOST re-settled, keyed (lo, hi) → settling tier ("margin" exact
+        # Jaccard / "reprobe" index ANN); everything else the device
+        # sketch settled ("rerank", the consumer's default), and evicted
+        # members' unique verdicts belong to the eviction walk
+        prov: dict[tuple[int, int], str] = {}
+        self.last_provenance = prov
+        self.last_evicted: set[int] = set()
+        self.last_participants: set[int] = set()
         if m == 0:
             out, _ = oprr.rewrite_rep_bands(n_bucket, nc, [])
             return out
 
         participating = np.zeros(n, bool)
         participating[np.unique(pair_arr)] = True
+        self.last_participants = set(np.unique(pair_arr).tolist())
         sketches = oprr.bottom_sketches(
             raw, self.params.shingle_k, cfg.rerank_sketch,
             skip=~(participating & valid_np[:n]),
@@ -322,13 +332,17 @@ class RerankTier:
 
         def settle_exact(i: int, j: int, jq_ij: int) -> bool:
             nonlocal exact_used
+            key = (i, j) if i < j else (j, i)
             if exact_used < cfg.rerank_exact_cap:
                 exact_used += 1
+                prov[key] = "margin"
                 return jaccard(sset(i), sset(j)) >= thr
             rp = self._reprobe(i, j, keys64)
             if rp is not None:
                 stats["reprobes"] += 1
+                prov[key] = "reprobe"
                 return rp
+            prov[key] = "rerank"  # cap overflow: the sketch verdict stands
             return jq_ij >= thr_q
 
         for s in border:
@@ -400,6 +414,7 @@ class RerankTier:
         )
         stats["evicted"] = len(evicted)
         stats["predicted_precision"] = pprec
+        self.last_evicted = {int(d) for d in evicted}
 
         # surviving settled-TRUE cluster edges become the new candidate
         # matrix.  Truth, not the estimator: the engine's own lane

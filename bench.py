@@ -1155,12 +1155,29 @@ def main(argv=None) -> None:
                 }
                 _adm_last = now
                 return out
+
+            # decision-provenance ledger per regime: the tier×verdict mix
+            # (obs/decisions.py always-on counters) snapshotted like the
+            # device counters — `<regime>_decision_mix` says WHICH tier
+            # settled that regime's verdicts (a rerank regime whose mix is
+            # all "band" means the precision tier never fired)
+            from advanced_scrapper_tpu.obs import decisions as _decisions
+
+            _dm_last = _decisions.decision_mix_snapshot()
+
+            def _dm_delta(prefix: str) -> dict:
+                nonlocal _dm_last
+                now = _decisions.decision_mix_snapshot()
+                mix = _decisions.decision_mix_delta(_dm_last, now)
+                _dm_last = now
+                return {f"{prefix}_decision_mix": mix} if mix else {}
             if "uniform" in want:
                 uniform = _bench_uniform(jax, mesh, params, backend, batch, block)
                 note(f"uniform done: {uniform:.0f}/s")
                 out["value"] = round(uniform, 1)
                 out["vs_baseline"] = round(uniform / 50000.0, 4)
                 out.update(_adm_delta("uniform"))
+                out.update(_dm_delta("uniform"))
             # stage_ms: per-stage wall attribution over the two host-path
             # regimes (ragged + stream; obs/stages.py on what the numbers
             # mean), so the next PR can see where the remaining time goes
@@ -1202,6 +1219,7 @@ def main(argv=None) -> None:
                     {f"ragged_{k}": v for k, v in ragged_dc.items()}
                 )
                 out.update(_adm_delta("ragged"))
+                out.update(_dm_delta("ragged"))
             if "stream" in want:
                 dc = stages.device_counters()
                 stream = _bench_stream(
@@ -1217,6 +1235,7 @@ def main(argv=None) -> None:
                 out["stream_vs_baseline"] = round(stream / 50000.0, 4)
                 out.update(_dev_delta(dc, "stream"))
                 out.update(_adm_delta("stream"))
+                out.update(_dm_delta("stream"))
             if "sharded" in want:
                 (
                     sharded_warm, sharded, sharded_dc, sharded_ps,
@@ -1237,6 +1256,7 @@ def main(argv=None) -> None:
                 out.update({f"sharded_{k}": v for k, v in sharded_dc.items()})
                 out["sharded_per_shard"] = sharded_ps
                 out.update(_adm_delta("sharded"))
+                out.update(_dm_delta("sharded"))
             if "rerank" in want:
                 rerank_warm, rerank_rate, rerank_dc = _bench_rerank(
                     512 if quick else 4096
@@ -1256,6 +1276,7 @@ def main(argv=None) -> None:
                 # rerank_launch_budget SLO, not prose
                 out.update({f"rerank_{k}": v for k, v in rerank_dc.items()})
                 out.update(_adm_delta("rerank"))
+                out.update(_dm_delta("rerank"))
             stage_ms = {k: 0.0 for k in ("encode", "h2d", "kernel", "resolve")}
             stage_ms.update(stages.snapshot_ms())
             if "recall" in want:
@@ -1273,6 +1294,7 @@ def main(argv=None) -> None:
                 out["precision_oracle"] = round(precision_oracle, 4)
                 out["unchained_merges"] = unchained
                 out.update(_adm_delta("recall"))
+                out.update(_dm_delta("recall"))
             if "exact" in want:
                 (
                     exact, exact_vs_pandas, exact_ms, pandas_ms,
@@ -1296,6 +1318,7 @@ def main(argv=None) -> None:
                 if exact_reason:
                     out["exact_backend_reason"] = exact_reason
                 out.update(_adm_delta("exact"))
+                out.update(_dm_delta("exact"))
             if "matcher" in want:
                 stages.reset()
                 matcher_warm, matcher, matcher_dc = _bench_matcher(
@@ -1315,6 +1338,7 @@ def main(argv=None) -> None:
                 # steady-state window only, matching the rate split
                 out.update({f"matcher_{k}": v for k, v in matcher_dc.items()})
                 out.update(_adm_delta("matcher"))
+                out.update(_dm_delta("matcher"))
             if "index" in want:
                 idx = _bench_index(8192 if quick else 65536)
                 note(
@@ -1324,6 +1348,7 @@ def main(argv=None) -> None:
                 )
                 out.update(idx)
                 out.update(_adm_delta("index"))
+                out.update(_dm_delta("index"))
             if "fleet" in want:
                 flt = _bench_fleet(8192 if quick else 32768)
                 note(
@@ -1333,6 +1358,7 @@ def main(argv=None) -> None:
                 )
                 out.update(flt)
                 out.update(_adm_delta("fleet"))
+                out.update(_dm_delta("fleet"))
     except Exception as e:
         # A tunnel that came up can still die between dispatches (it has).
         # Better one labeled cpu-fallback line than no round record at all.

@@ -1,0 +1,386 @@
+"""The decision-provenance plane: journal durability, sampling, always-on
+counters, and the producers' tier attribution.
+
+The journal's durability contract is the tree-wide torn-tail convention:
+a ChaosFs short write may cost records, but every record that survives
+reads back byte-identical to what was appended — records drop WHOLE,
+never corrupt.  The disabled journal is structurally free: producers
+gate every row-building branch on ``recorder.journal is not None``, so
+the zero-overhead test hands the recorder a generator that explodes on
+first iteration and asserts it is never pulled.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from advanced_scrapper_tpu.obs import telemetry
+from advanced_scrapper_tpu.obs.decisions import (
+    TIERS,
+    VERDICTS,
+    DecisionJournal,
+    DecisionRecorder,
+    decision_mix_delta,
+    decision_mix_snapshot,
+    get_recorder,
+    set_recorder,
+)
+from advanced_scrapper_tpu.storage.fsio import ChaosFs, SimulatedCrash
+
+
+@pytest.fixture()
+def fresh_registry():
+    telemetry.REGISTRY.reset()
+    telemetry.set_enabled(True)
+    yield telemetry.REGISTRY
+    telemetry.REGISTRY.reset()
+    telemetry.set_enabled(None)
+
+
+@pytest.fixture()
+def own_recorder():
+    """Install a counters-only recorder; restore env-driven one after."""
+    rec = DecisionRecorder(None)
+    set_recorder(rec)
+    yield rec
+    set_recorder(None)
+
+
+def _counter_value(name: str, **labels) -> float:
+    total = 0.0
+    for m in telemetry.REGISTRY.find(name):
+        if all(m.labels.get(k) == str(v) for k, v in labels.items()):
+            total += m.value
+    return total
+
+
+# -- journal ----------------------------------------------------------------
+
+
+def test_journal_roundtrip_and_stamps(tmp_path):
+    path = str(tmp_path / "decisions.jsonl")
+    j = DecisionJournal(path, sample=1.0)
+    rows = [
+        {"doc": 7, "verdict": "dup", "tier": "band", "attr": 3, "band_key": 99},
+        {"doc": 8, "verdict": "unique", "tier": "band", "attr": -1,
+         "band_key": None},
+    ]
+    assert j.append(rows) == 2
+    back = DecisionJournal.read(path)
+    assert [r["doc"] for r in back] == [7, 8]
+    assert back[0]["attr"] == 3 and back[0]["band_key"] == 99
+    assert back[1]["verdict"] == "unique"
+    # journal stamps ride every record: monotone seq + a timestamp
+    assert [r["seq"] for r in back] == [0, 1]
+    assert all(r["ts"] > 0 for r in back)
+
+
+def test_journal_sampling_deterministic_and_dup_exempt(tmp_path):
+    def run(path, sample):
+        j = DecisionJournal(str(path), sample=sample, seed=3)
+        j.append(
+            {"doc": i, "verdict": "unique", "tier": "band"} for i in range(400)
+        )
+        j.append([{"doc": 1000, "verdict": "dup", "tier": "band", "attr": 0}])
+        return [r["doc"] for r in DecisionJournal.read(str(path))]
+
+    a = run(tmp_path / "a.jsonl", 0.25)
+    b = run(tmp_path / "b.jsonl", 0.25)
+    assert a == b, "sampling must be a pure function of (seed, seq)"
+    kept_unique = [d for d in a if d < 1000]
+    assert 0 < len(kept_unique) < 400, "sample must thin, not erase or pass"
+    assert 1000 in a, "dup records are always kept — they anchor explains"
+    zero = run(tmp_path / "c.jsonl", 0.0)
+    assert zero == [1000], "sample=0 keeps only the dup records"
+
+
+def test_journal_rotation_bounds_the_sidecar(tmp_path):
+    path = str(tmp_path / "decisions.jsonl")
+    j = DecisionJournal(path, sample=1.0, max_bytes=2048)
+    for i in range(200):
+        j.append([{"doc": i, "verdict": "dup", "tier": "band", "attr": 0}])
+    assert os.path.exists(path + ".old"), "cap crossings must rotate"
+    assert os.path.getsize(path) <= 2048
+    assert os.path.getsize(path + ".old") <= 2048
+    back = DecisionJournal.read(path)
+    docs = [r["doc"] for r in back]
+    assert docs == sorted(docs), ".old reads first: oldest-first order"
+    assert docs[-1] == 199, "the newest record survives rotation"
+
+
+def test_journal_torn_tail_chaos_sweep(tmp_path):
+    """ChaosFs sweep: under short writes and EIO flushes, every surviving
+    record is byte-identical to one that was appended — faults cost
+    records (counted), never corrupt them."""
+    written: dict[int, dict] = {}
+    faulted_runs = 0
+    for seed in range(10):
+        fs = ChaosFs(seed=seed, short_write_rate=0.3, eio_flush_rate=0.2)
+        path = str(tmp_path / f"j{seed}.jsonl")
+        j = DecisionJournal(path, fs=fs, sample=1.0)
+        written.clear()
+        for i in range(40):
+            row = {
+                "doc": i, "verdict": "dup", "tier": "band",
+                "attr": i % 7, "band_key": i * 31,
+            }
+            written[i] = row
+            try:
+                j.append([row])
+            except SimulatedCrash:  # not enabled here, but be explicit
+                break
+        if j.write_errors:
+            faulted_runs += 1
+        back = DecisionJournal.read(path, fs=fs)
+        assert len(back) + j.write_errors >= 1
+        for rec in back:
+            src = written[rec["doc"]]
+            for k, v in src.items():
+                assert rec[k] == v, f"seed {seed}: record corrupted: {rec}"
+        # a torn tail never merges with the NEXT append into a parseable
+        # garbage record: doc ids are unique in what survives
+        docs = [r["doc"] for r in back]
+        assert len(docs) == len(set(docs))
+    assert faulted_runs > 0, "chaos must actually fire"
+
+
+def test_journal_write_errors_are_contained_and_counted(
+    tmp_path, fresh_registry
+):
+    class _Enoent:
+        """An fs whose appends always fail."""
+
+        def exists(self, p):
+            return False
+
+        def size(self, p):
+            return 0
+
+        def open(self, p, mode="r", **kw):
+            raise OSError("injected")
+
+        def replace(self, a, b):
+            raise OSError("injected")
+
+        def remove(self, p):
+            raise OSError("injected")
+
+    j = DecisionJournal(str(tmp_path / "j.jsonl"), fs=_Enoent(), sample=1.0)
+    assert j.append([{"doc": 1, "verdict": "dup", "tier": "band"}]) == 0
+    assert j.write_errors == 1
+    assert _counter_value("astpu_decision_journal_errors_total") == 1.0
+
+
+# -- recorder ---------------------------------------------------------------
+
+
+def test_recorder_counters_always_on_and_generation_safe(fresh_registry):
+    rec = DecisionRecorder(None)
+    rec.count("band", "dup", 3)
+    rec.count("band", "unique")
+    assert _counter_value("astpu_decision_total", tier="band", verdict="dup") == 3
+    telemetry.REGISTRY.reset()  # a test-style reset bumps the generation
+    rec.count("margin", "dup", 2)
+    assert _counter_value("astpu_decision_total", tier="margin", verdict="dup") == 2
+    # the counters are ALWAYS on — gate off, increments still land
+    telemetry.set_enabled(False)
+    telemetry.REGISTRY.reset()
+    rec.count("exact", "unique", 5)
+    assert (
+        _counter_value("astpu_decision_total", tier="exact", verdict="unique")
+        == 5
+    )
+
+
+def test_disabled_journal_is_structurally_free():
+    rec = DecisionRecorder(None)
+
+    def exploding_rows():
+        raise AssertionError("row built despite disabled journal")
+        yield  # pragma: no cover
+
+    # the producer convention: rows are a generator, and journal_rows
+    # must not pull a single element when the journal is off — the
+    # zero-overhead contract is structural, not just fast
+    assert rec.journal_rows(exploding_rows()) == 0
+
+
+def test_decision_mix_snapshot_and_delta(fresh_registry):
+    rec = DecisionRecorder(None)
+    rec.count("band", "dup", 2)
+    before = decision_mix_snapshot()
+    assert before == {"band:dup": 2.0}
+    rec.count("band", "dup")
+    rec.count("rerank", "unique", 4)
+    delta = decision_mix_delta(before)
+    assert delta == {"band:dup": 1.0, "rerank:unique": 4.0}
+    assert decision_mix_delta(decision_mix_snapshot()) == {}
+
+
+def test_get_recorder_env_wiring(tmp_path, monkeypatch):
+    set_recorder(None)
+    monkeypatch.setenv("ASTPU_DECISION_JOURNAL", str(tmp_path / "env.jsonl"))
+    monkeypatch.setenv("ASTPU_DECISION_SAMPLE", "1.0")
+    try:
+        rec = get_recorder()
+        assert rec.journal is not None
+        assert rec.journal.sample == 1.0
+        rec.journal_rows([{"doc": 0, "verdict": "dup", "tier": "exact"}])
+        assert DecisionJournal.read(str(tmp_path / "env.jsonl"))
+    finally:
+        set_recorder(None)
+    monkeypatch.delenv("ASTPU_DECISION_JOURNAL")
+    assert get_recorder().journal is None, "unset env → counters only"
+    set_recorder(None)
+
+
+# -- producers (the certified one-shot path) --------------------------------
+
+
+def _mutate(text: str, n: int, seed: int) -> str:
+    rng = np.random.default_rng(seed)
+    toks = text.split()
+    for p in rng.choice(len(toks), size=n, replace=False):
+        toks[int(p)] = f"mut{int(rng.integers(1 << 30))}"
+    return " ".join(toks)
+
+
+def _corpus(seed: int = 0, n_base: int = 6, tokens: int = 80):
+    rng = np.random.default_rng(seed)
+    texts = []
+    for _ in range(n_base):
+        base = " ".join(f"w{int(t)}" for t in rng.integers(0, 1 << 20, tokens))
+        texts.append(base)
+        texts.append(_mutate(base, 2, seed + 1))  # a clear near-dup
+    return texts
+
+
+def test_oneshot_emits_tier_attributed_decisions(fresh_registry, own_recorder, tmp_path):
+    from advanced_scrapper_tpu.pipeline.dedup import DedupConfig, NearDupEngine
+
+    journal = DecisionJournal(str(tmp_path / "d.jsonl"), sample=1.0)
+    set_recorder(DecisionRecorder(journal))
+    try:
+        eng = NearDupEngine(DedupConfig(rerank=False))
+        texts = _corpus()
+        before = decision_mix_snapshot()
+        reps = np.asarray(eng.dedup_reps(texts))
+        mix = decision_mix_delta(before)
+        assert sum(mix.values()) == len(texts), (
+            f"every doc gets exactly one verdict, got {mix}"
+        )
+        n_dup = int((reps != np.arange(len(texts))).sum())
+        assert sum(v for k, v in mix.items() if k.endswith(":dup")) == n_dup
+        recs = {r["doc"]: r for r in DecisionJournal.read(journal.path)}
+        # dup records are never sampled out and agree with the verdicts
+        for i in range(len(texts)):
+            if reps[i] != i:
+                assert recs[i]["verdict"] == "dup"
+                assert recs[i]["attr"] == int(reps[i])
+                assert recs[i]["tier"] in TIERS
+                assert recs[i]["regime"] == "oneshot"
+        for r in recs.values():
+            assert r["verdict"] in VERDICTS and r["tier"] in TIERS
+    finally:
+        set_recorder(None)
+
+
+def test_oneshot_journal_disabled_builds_no_rows(fresh_registry, own_recorder):
+    """The engine path's zero-overhead gate: with the journal off the
+    keys matrix is never synced for provenance — counters move, and no
+    journal object ever sees a row."""
+    from advanced_scrapper_tpu.pipeline.dedup import DedupConfig, NearDupEngine
+
+    calls = []
+
+    class _TrapJournal:
+        def append(self, rows):
+            calls.append(list(rows))
+            return 0
+
+    rec = own_recorder
+    assert rec.journal is None
+    eng = NearDupEngine(DedupConfig(rerank=False))
+    before = decision_mix_snapshot()
+    eng.dedup_reps(_corpus(seed=5))
+    assert sum(decision_mix_delta(before).values()) > 0, "counters always move"
+    assert calls == []
+
+
+def test_rerank_path_attributes_precision_tiers(fresh_registry, tmp_path):
+    from advanced_scrapper_tpu.pipeline.dedup import DedupConfig, NearDupEngine
+
+    journal = DecisionJournal(str(tmp_path / "rr.jsonl"), sample=1.0)
+    set_recorder(DecisionRecorder(journal))
+    try:
+        eng = NearDupEngine(DedupConfig(rerank=True))
+        if eng.rerank_hook is None:
+            pytest.skip("rerank tier unavailable in this build")
+        texts = _corpus(seed=9)
+        before = decision_mix_snapshot()
+        reps = np.asarray(eng.dedup_reps(texts))
+        mix = decision_mix_delta(before)
+        assert sum(mix.values()) == len(texts)
+        # the precision tier settled this corpus: its tiers must appear
+        settled = {
+            k.split(":")[0] for k in mix if k.split(":")[0] in
+            ("rerank", "margin", "reprobe")
+        }
+        assert settled, f"no precision-tier attribution in {mix}"
+        recs = {r["doc"]: r for r in DecisionJournal.read(journal.path)}
+        for i in range(len(texts)):
+            if reps[i] != i:
+                assert recs[i]["attr"] == int(reps[i])
+    finally:
+        set_recorder(None)
+
+
+def test_exact_dedup_counts_exact_tier(fresh_registry, own_recorder):
+    from advanced_scrapper_tpu.pipeline.dedup import ExactDedup
+
+    before = decision_mix_snapshot()
+    keep = ExactDedup().keep_indices(["a", "b", "a", "c", "b", "a"])
+    mix = decision_mix_delta(before)
+    assert mix.get("exact:unique") == len(keep) == 3
+    assert mix.get("exact:dup") == 3
+
+
+# -- explain CLI over the journal -------------------------------------------
+
+
+def test_explain_dedup_cli_renders_and_filters(tmp_path, capsys):
+    import importlib.util
+    import sys as _sys
+
+    tools = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    )
+    spec = importlib.util.spec_from_file_location(
+        "explain_dedup", os.path.join(tools, "explain_dedup.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    path = str(tmp_path / "j.jsonl")
+    j = DecisionJournal(path, sample=1.0)
+    j.append(
+        [
+            {"doc": 4, "name": "https://a", "verdict": "dup", "tier": "margin",
+             "attr": 1, "band_key": 77, "regime": "oneshot"},
+            {"doc": 5, "verdict": "unique", "tier": "band", "attr": -1,
+             "band_key": None},
+        ]
+    )
+    assert mod.main(["--journal", path, "--doc", "4"]) == 0
+    out = capsys.readouterr().out
+    assert "dup of  : 1" in out and "margin" in out and "77" in out
+    assert mod.main(["--journal", path, "--mix", "--format", "json"]) == 0
+    mix = json.loads(capsys.readouterr().out)
+    assert mix == {"margin:dup": 1, "band:unique": 1}
+    assert mod.main(["--journal", path, "--doc", "999"]) == 1
+    assert mod.main(["--journal", path]) == 2
+    _sys.modules.pop("explain_dedup", None)

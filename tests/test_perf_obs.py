@@ -432,6 +432,31 @@ def test_checked_in_rounds_report_acceptance():
     assert "cpu-fallback" in md
 
 
+def test_ledger_canary_sli_rows_direction_aware(tmp_path):
+    """Canary SLI rows join the trajectory engine with the right
+    directions: a recall slide is a regression (higher-is-better family
+    via the stripped prefix), a latency drop an improvement (_seconds
+    suffix), and the shape counters draw no verdict."""
+    led = perfdb.PerfLedger(str(tmp_path / "ledger.jsonl"))
+    sli = {
+        "round": 0, "recall": 0.96, "precision": 0.9,
+        "latency_seconds": 2.0, "oracle_pairs": 27, "wiped": 3072,
+    }
+    row = led.ingest_canary_sli(sli, platform="cpu", ts=1.0)
+    assert row["kind"] == "canary"
+    assert row["metrics"]["recall"] == 0.96  # canary_ prefix stripped
+    assert "canary_latency_seconds" in row["metrics"]
+    led.ingest_canary_sli(
+        {**sli, "recall": 0.80, "latency_seconds": 0.5},
+        platform="cpu", ts=2.0, source="canary2",
+    )
+    verdicts = {v["metric"]: v for v in perfdb.compute_verdicts(led.rows())}
+    assert verdicts["recall"]["verdict"] == "regression"
+    assert verdicts["canary_latency_seconds"]["verdict"] == "improvement"
+    assert "canary_oracle_pairs" not in verdicts  # unknown direction
+    assert "canary_wiped" not in verdicts
+
+
 def test_ledger_file_roundtrip_and_torn_tail(tmp_path):
     path = str(tmp_path / "ledger.jsonl")
     led = perfdb.PerfLedger(path)

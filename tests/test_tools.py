@@ -310,6 +310,53 @@ def test_obs_top_graph_once_smoke(capsys):
         telemetry.set_enabled(None)
 
 
+def test_obs_top_quality_once_smoke(capsys):
+    """obs_top --quality --once against a live StatusServer: decision-mix
+    table, canary SLIs and the canary SLO verdicts in one frame."""
+    import obs_top
+
+    from advanced_scrapper_tpu.obs import telemetry
+    from advanced_scrapper_tpu.obs.decisions import DecisionRecorder
+
+    telemetry.REGISTRY.reset()
+    telemetry.set_enabled(True)
+    srv = None
+    try:
+        rec = DecisionRecorder(None)
+        rec.count("rerank", "dup", 5)
+        rec.count("band", "unique", 20)
+        telemetry.REGISTRY.gauge(
+            "astpu_canary_recall", "t", always=True
+        ).set(0.95)
+        telemetry.REGISTRY.gauge(
+            "astpu_canary_precision", "t", always=True
+        ).set(0.875)
+        telemetry.REGISTRY.counter(
+            "astpu_canary_rounds_total", "t", always=True
+        ).inc(3)
+        telemetry.REGISTRY.gauge(
+            "astpu_slo_compliant", "t", objective="canary_recall"
+        ).set(0.0)
+        srv = telemetry.StatusServer(port=0).start()
+        rc = obs_top.main(
+            ["--url", f"http://127.0.0.1:{srv.port}", "--once", "--quality"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "obs_top --quality @" in out
+        assert "decision mix" in out
+        assert "rerank" in out and "band" in out and "unique" in out
+        assert "recall 0.950" in out and "precision 0.875" in out
+        assert "rounds 3" in out
+        assert "canary slo:" in out
+        assert "canary_recall" in out and "VIOLATED" in out
+    finally:
+        if srv is not None:
+            srv.stop()
+        telemetry.REGISTRY.reset()
+        telemetry.set_enabled(None)
+
+
 def test_obs_top_once_unreachable_exits_nonzero(capsys):
     import obs_top
 
@@ -492,8 +539,23 @@ def test_lint_imports_catches_violations(tmp_path):
         "def reprobe():\n"
         "    from advanced_scrapper_tpu.index.store import PersistentIndex\n"
     )
+    # the decision/canary plane observes from OUTSIDE: hook-injected, no
+    # pipeline/index reach-in (the obs LAYER itself carries no ban — the
+    # collector legitimately reads siblings — so ok.py stays clean)
+    (pkg / "obs").mkdir()
+    (pkg / "obs" / "decisions.py").write_text(
+        "def emit():\n"
+        "    from advanced_scrapper_tpu.pipeline.dedup import DedupEngine\n"
+        "    import advanced_scrapper_tpu.index.store\n"
+    )
+    (pkg / "obs" / "canary.py").write_text(
+        "from advanced_scrapper_tpu.index.fleet import ShardedIndexClient\n"
+    )
+    (pkg / "obs" / "ok.py").write_text(
+        "import advanced_scrapper_tpu.index.store\n"  # layer-wide: allowed
+    )
     problems = lint_imports.lint(str(tmp_path))
-    assert len(problems) == 18, problems
+    assert len(problems) == 21, problems
     assert any("parallel/ must not import pipeline/" in p for p in problems)
     assert any("parallel/ must not import runtime/" in p for p in problems)
     assert any("parallel/ must not import index/" in p for p in problems)
@@ -526,8 +588,21 @@ def test_lint_imports_catches_violations(tmp_path):
         "rerank.py" in p and "must not import index/" in p
         for p in problems
     ), "module rule: the rerank settle math may not import the index"
+    assert any(
+        "decisions.py" in p and "must not import pipeline/" in p
+        for p in problems
+    ), "module rule: the decision plane may not reach into pipeline/"
+    assert any(
+        "decisions.py" in p and "must not import index/" in p
+        for p in problems
+    ), "module rule: the decision plane may not reach into index/"
+    assert any(
+        os.path.join("obs", "canary.py") in p and "must not import index/" in p
+        for p in problems
+    ), "module rule: the canary prober's index hooks are injected"
     assert not any("ok.py" in p for p in problems), (
-        "net.rpc is exempt for index/, and runtime/ may use obs/"
+        "net.rpc is exempt for index/, runtime/ may use obs/, and the "
+        "obs layer itself carries no layer-wide ban"
     )
 
 

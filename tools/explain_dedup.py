@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Explain-query CLI over the decision journal: why is doc X a dup of Y?
+
+``obs/decisions.py`` journals every dedup verdict with the tier that
+settled it, the attributed doc and the winning band key.  This tool
+joins those records against the persistent index so an operator can
+resolve one verdict's FULL decision path::
+
+    python tools/explain_dedup.py --journal decisions.jsonl --doc 42
+    python tools/explain_dedup.py --journal decisions.jsonl \
+        --name https://ex.ample/page --index /data/idx/bands
+    python tools/explain_dedup.py --journal decisions.jsonl --list
+    python tools/explain_dedup.py --journal decisions.jsonl --mix
+
+With ``--index DIR`` the explanation is *verified*, not just replayed:
+the record's winning band key is re-probed against the live postings
+(read-only open — safe beside a writer) and the answer is compared with
+the journaled attribution; both docs' urls resolve through the docmap
+sidecar (``lookup_names``).  Without an index the tool prints the
+journal's own record (still the full tier/band/attribution path).
+
+``--format json`` emits one JSON object per selected record for
+scripting; ``--mix`` prints the journal's tier×verdict histogram (the
+offline twin of the live ``astpu_decision_total`` counters).
+
+Deliberately jax-free: explain queries must run on a box whose tunnel
+is dead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+from advanced_scrapper_tpu.obs.decisions import DecisionJournal  # noqa: E402
+
+
+def load_records(path: str) -> list[dict]:
+    recs = DecisionJournal.read(path)
+    if not recs:
+        print(f"explain_dedup: no records in {path!r}", file=sys.stderr)
+    return recs
+
+
+def select(recs: list[dict], args) -> list[dict]:
+    out = recs
+    if args.doc is not None:
+        out = [r for r in out if r.get("doc") == args.doc]
+    if args.name:
+        out = [r for r in out if r.get("name") == args.name]
+    if args.tier:
+        out = [r for r in out if r.get("tier") == args.tier]
+    if args.verdict:
+        out = [r for r in out if r.get("verdict") == args.verdict]
+    return out
+
+
+def open_index(directory: str):
+    from advanced_scrapper_tpu.index import PersistentIndex
+
+    return PersistentIndex(directory, read_only=True)
+
+
+def verify_against_index(rec: dict, index) -> dict:
+    """Join one journal record against live postings: re-probe the
+    winning band key and compare with the journaled attribution."""
+    import numpy as np
+
+    out: dict = {}
+    band_key = rec.get("band_key")
+    attr = rec.get("attr", -1)
+    if band_key is not None:
+        probed = int(
+            np.asarray(
+                index.probe_batch(np.asarray([band_key], np.uint64))
+            )[0]
+        )
+        out["probed_doc"] = probed
+        out["consistent"] = bool(probed == attr) if attr >= 0 else None
+    ids = [d for d in (rec.get("doc"), attr) if isinstance(d, int) and d >= 0]
+    if ids:
+        out["names"] = {
+            str(k): v for k, v in index.lookup_names(ids).items()
+        }
+    return out
+
+
+TIER_GLOSS = {
+    "exact": "byte/url-identity stage (memcmp-confirmed first seen)",
+    "index": "persistent/bloom stream-index posting hit",
+    "band": "LSH band collision settled by the signature estimator",
+    "rerank": "device bottom-sketch settle (precision tier)",
+    "margin": "host exact-Jaccard re-settle of the margin band",
+    "reprobe": "borderline ANN re-probe over index postings",
+}
+
+
+def render(rec: dict, joined: dict | None) -> str:
+    tier = rec.get("tier", "?")
+    verdict = rec.get("verdict", "?")
+    doc = rec.get("doc")
+    attr = rec.get("attr", -1)
+    lines = [f"doc {doc}" + (f" ({rec['name']})" if rec.get("name") else "")]
+    lines.append(f"  verdict : {verdict}")
+    lines.append(
+        f"  tier    : {tier} — {TIER_GLOSS.get(tier, 'unknown tier')}"
+    )
+    if verdict == "dup":
+        lines.append(f"  dup of  : {attr}")
+    bk = rec.get("band_key")
+    lines.append(
+        f"  band key: {bk if bk is not None else '(transitive/none)'}"
+    )
+    if rec.get("regime"):
+        lines.append(f"  regime  : {rec['regime']}")
+    if rec.get("seq") is not None:
+        lines.append(f"  journal : seq={rec['seq']} ts={rec.get('ts')}")
+    if joined:
+        if "probed_doc" in joined:
+            mark = {True: "CONSISTENT", False: "MISMATCH", None: "n/a"}[
+                joined.get("consistent")
+            ]
+            lines.append(
+                f"  index   : band key re-probe → doc "
+                f"{joined['probed_doc']} [{mark}]"
+            )
+        for did, nm in (joined.get("names") or {}).items():
+            lines.append(f"  name    : doc {did} = {nm}")
+    return "\n".join(lines)
+
+
+def decision_mix(recs: list[dict]) -> dict:
+    mix: dict[str, int] = {}
+    for r in recs:
+        k = f"{r.get('tier', '?')}:{r.get('verdict', '?')}"
+        mix[k] = mix.get(k, 0) + 1
+    return mix
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="explain dedup verdicts from the decision journal"
+    )
+    ap.add_argument("--journal", required=True, help="decision JSONL path")
+    ap.add_argument("--doc", type=int, default=None, help="doc id to explain")
+    ap.add_argument("--name", default=None, help="doc name/url to explain")
+    ap.add_argument("--tier", default=None, help="filter by settling tier")
+    ap.add_argument("--verdict", default=None, choices=("dup", "unique"))
+    ap.add_argument(
+        "--index", default=None,
+        help="persistent index dir: verify band keys + resolve names",
+    )
+    ap.add_argument("--list", action="store_true", help="list all records")
+    ap.add_argument(
+        "--mix", action="store_true", help="print tier×verdict histogram"
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    args = ap.parse_args(argv)
+
+    recs = load_records(args.journal)
+    if args.mix:
+        mix = decision_mix(recs)
+        if args.format == "json":
+            print(json.dumps(mix, sort_keys=True))
+        else:
+            for k in sorted(mix):
+                print(f"{k:>16}: {mix[k]}")
+        return 0
+    if not (args.list or args.doc is not None or args.name):
+        print(
+            "explain_dedup: pick a selector (--doc / --name / --list / --mix)",
+            file=sys.stderr,
+        )
+        return 2
+    sel = select(recs, args)
+    if not sel:
+        print("explain_dedup: no matching records", file=sys.stderr)
+        return 1
+    index = open_index(args.index) if args.index else None
+    try:
+        for rec in sel:
+            joined = verify_against_index(rec, index) if index else None
+            if args.format == "json":
+                out = dict(rec)
+                if joined:
+                    out["index_join"] = joined
+                print(json.dumps(out, sort_keys=True))
+            else:
+                print(render(rec, joined))
+                print()
+    finally:
+        if index is not None:
+            index.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

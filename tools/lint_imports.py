@@ -70,6 +70,12 @@ RULES: dict[str, tuple[str, ...]] = {
     # the stage-graph runtime is workload-blind: pipeline/net/index ride
     # its edges, never the other way around
     "runtime": ("pipeline", "extractors", "net", "index"),
+    # the obs layer as a whole carries no layer-wide ban (producers all
+    # over the tree import it, and some obs modules legitimately read
+    # sibling layers), but the decision/canary plane gets MODULE_RULES:
+    # those two are hook-injected consumers and must never reach into
+    # the planes they observe
+    "obs": (),
 }
 
 #: source layer → module names exempt from that layer's bans (exact module
@@ -103,6 +109,22 @@ MODULE_RULES: dict[str, tuple[tuple[str, ...], bool]] = {
     ),
     os.path.join("runtime", "autoscaler.py"): (
         ("pipeline", "extractors", "net", "index", "storage", "parallel"),
+        False,
+    ),
+    # the decision-provenance plane and the canary prober observe the
+    # dedup/index planes from OUTSIDE: producers call in through
+    # DecisionRecorder / injected resolve+wipe hooks, and the canary:
+    # key-space prefix is duplicated as a literal rather than imported.
+    # An obs.decisions→pipeline (or →index) import would let the
+    # observer drive the observed and close an import cycle through
+    # every producer.  (obs/canary.py's cpu.oracle import is the point:
+    # the oracle IS the quality definition, not a plane under test.)
+    os.path.join("obs", "decisions.py"): (
+        ("pipeline", "index", "extractors", "net", "parallel"),
+        False,
+    ),
+    os.path.join("obs", "canary.py"): (
+        ("pipeline", "index", "extractors", "net", "parallel"),
         False,
     ),
 }

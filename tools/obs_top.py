@@ -22,6 +22,12 @@ Two modes:
   fetch ``GET /profile`` (a process under ``ASTPU_PROFILE``, or a
   collector's merged fleet view) and render the hottest folded stacks
   with sample shares (``--prof-top`` rows).
+- ``--quality`` (combinable with ``--once``): the quality view — the
+  decision mix (which tier settled each verdict, from the always-on
+  ``astpu_decision_total`` counters, with per-tier rates in live mode),
+  the canary prober's ground-truth SLIs (``astpu_canary_recall`` /
+  ``_precision``, round latency and cadence) and the canary SLO
+  verdicts; the sticky line tracks recall/precision plus compliance.
 - live (default): the :class:`obs.console.ConsoleMux` idiom — a sticky
   one-line summary repainted in place (per-stage rates computed from
   successive histogram snapshots, queue depths, fleet health) with notable
@@ -348,6 +354,133 @@ def render_fleet_frame(status: dict) -> list[str]:
     return lines
 
 
+def render_quality_frame(
+    status: dict, prev: dict | None = None, dt: float = 0.0
+) -> list[str]:
+    """The quality view (``--quality``): decision-mix rates from the
+    always-on ``astpu_decision_total{tier,verdict}`` counters, canary
+    ground-truth SLIs, and the canary SLO compliance verdicts.  Works
+    against a single process endpoint or a collector merge."""
+    idx = _index(status)
+    pidx = _index(prev) if prev else {}
+    lines: list[str] = []
+
+    decisions = [
+        m for m in status.get("metrics", []) if m["name"] == "astpu_decision_total"
+    ]
+    lines.append("  decision mix (tier × verdict):")
+    if not decisions:
+        lines.append("    (no verdicts yet — has a dedup pass run?)")
+    else:
+        total = sum(m["value"] for m in decisions)
+        lines.append(
+            f"    {'tier':<10} {'verdict':<8} {'count':>12} {'share':>7} {'rate/s':>9}"
+        )
+        for m in sorted(
+            decisions, key=lambda m: (-m["value"], _series_key(m))
+        ):
+            labels = m.get("labels") or {}
+            key = _series_key(m)
+            rate = ""
+            if key in pidx and dt > 0:
+                rate = f"{(m['value'] - pidx[key].get('value', 0)) / dt:.1f}"
+            lines.append(
+                f"    {labels.get('tier', '?'):<10} {labels.get('verdict', '?'):<8} "
+                f"{m['value']:>12.0f} {m['value'] / total:>7.1%} {rate:>9}"
+            )
+        jerr = idx.get("astpu_decision_journal_errors_total")
+        if jerr and jerr["value"]:
+            lines.append(
+                f"    journal write errors: {jerr['value']:.0f} (rows dropped whole)"
+            )
+
+    lines.append("")
+    lines.append("  canary (ground-truth prober):")
+    recall = idx.get("astpu_canary_recall")
+    precision = idx.get("astpu_canary_precision")
+    rounds = idx.get("astpu_canary_rounds_total")
+    if recall is None and rounds is None:
+        lines.append("    (no canary rounds yet — is a CanaryProber scheduled?)")
+    else:
+        lat = next(
+            (
+                m for m in status.get("metrics", [])
+                if m["name"] == "astpu_canary_latency_seconds"
+            ),
+            None,
+        )
+        parts = []
+        if recall is not None:
+            parts.append(f"recall {recall['value']:.3f}")
+        if precision is not None:
+            parts.append(f"precision {precision['value']:.3f}")
+        if rounds is not None:
+            parts.append(f"rounds {rounds['value']:.0f}")
+        wiped = idx.get("astpu_canary_postings_wiped_total")
+        if wiped is not None:
+            parts.append(f"postings wiped {wiped['value']:.0f}")
+        lines.append("    " + "  ".join(parts))
+        if lat:
+            lines.append(
+                f"    round latency: n={lat['count']} "
+                f"p50={lat.get('p50_ms', 0):.1f}ms p95={lat.get('p95_ms', 0):.1f}ms"
+            )
+
+    slo = [
+        m for m in status.get("metrics", [])
+        if m["name"] == "astpu_slo_compliant"
+        and (m.get("labels") or {}).get("objective", "").startswith("canary_")
+    ]
+    if slo:
+        lines.append("")
+        lines.append("  canary slo:")
+        for m in sorted(slo, key=_series_key):
+            obj = (m.get("labels") or {}).get("objective", "?")
+            v = m["value"]
+            state = "NO-DATA" if v < 0 else ("OK " if v else "VIOLATED")
+            val = idx.get(f"astpu_slo_value{{objective={obj}}}")
+            vs = f" value={val['value']:.3f}" if val else ""
+            lines.append(f"    {obj:<24} {state}{vs}")
+    return lines
+
+
+def quality_summary_line(status: dict, prev: dict | None, dt: float) -> str:
+    """Sticky one-liner for live ``--quality`` mode: canary SLIs, the
+    hottest decision tiers by rate, and any violated canary objective."""
+    idx = _index(status)
+    pidx = _index(prev) if prev else {}
+    parts = []
+    recall = idx.get("astpu_canary_recall")
+    precision = idx.get("astpu_canary_precision")
+    if recall is not None or precision is not None:
+        r = f"{recall['value']:.2f}" if recall else "?"
+        p = f"{precision['value']:.2f}" if precision else "?"
+        parts.append(f"canary R={r} P={p}")
+    rates = []
+    for key, m in idx.items():
+        if m["name"] != "astpu_decision_total" or key not in pidx or dt <= 0:
+            continue
+        d = (m["value"] - pidx[key].get("value", 0)) / dt
+        if d > 0:
+            labels = m.get("labels") or {}
+            rates.append((d, f"{labels.get('tier')}:{labels.get('verdict')}"))
+    if rates:
+        rates.sort(reverse=True)
+        parts.append(
+            "mix " + " ".join(f"{k} {d:.0f}/s" for d, k in rates[:3])
+        )
+    bad = [
+        (m.get("labels") or {}).get("objective", "?")
+        for m in status.get("metrics", [])
+        if m["name"] == "astpu_slo_compliant"
+        and (m.get("labels") or {}).get("objective", "").startswith("canary_")
+        and m["value"] == 0
+    ]
+    if bad:
+        parts.append(f"slo violated: {','.join(sorted(bad))}")
+    return " | ".join(parts) if parts else "(no quality series yet)"
+
+
 def fleet_summary_line(status: dict, prev: dict | None, dt: float) -> str:
     """Sticky one-liner for live ``--fleet`` mode: up/total endpoints,
     dead shards, violated objectives."""
@@ -473,6 +606,12 @@ def main(argv=None) -> int:
         help="stacks shown in the --prof frame",
     )
     ap.add_argument(
+        "--quality",
+        action="store_true",
+        help="quality view: decision-mix rates (astpu_decision_total), "
+        "canary ground-truth SLIs and the canary SLO verdicts",
+    )
+    ap.add_argument(
         "--frames", type=int, default=0, help="stop after N polls (0 = forever)"
     )
     args = ap.parse_args(argv)
@@ -496,10 +635,16 @@ def main(argv=None) -> int:
             lines = render_fleet_frame(status)
         elif args.graph:
             lines = render_graph_frame(status)
+        elif args.quality:
+            lines = render_quality_frame(status)
         else:
             lines = render_frame(status)
-        if args.graph or args.fleet:
-            mode = "--fleet" if args.fleet else "--graph"
+        if args.graph or args.fleet or args.quality:
+            mode = (
+                "--fleet" if args.fleet
+                else "--graph" if args.graph
+                else "--quality"
+            )
             head = f"obs_top {mode} @ {time.strftime('%H:%M:%S', time.localtime(status.get('ts')))}"
             lines = [head] + lines
         print("\n".join(lines))
@@ -550,6 +695,8 @@ def main(argv=None) -> int:
                 sticky = fleet_summary_line(status, prev, dt)
             elif args.graph:
                 sticky = graph_summary_line(status, prev, dt)
+            elif args.quality:
+                sticky = quality_summary_line(status, prev, dt)
             else:
                 sticky = summary_line(status, prev, dt)
             mux.stats(sticky)
