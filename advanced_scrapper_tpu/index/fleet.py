@@ -62,7 +62,10 @@ from advanced_scrapper_tpu.index.repair import (
     mix64,
     range_mask,
 )
-from advanced_scrapper_tpu.index.remote import CANARY_SPACE_PREFIX
+from advanced_scrapper_tpu.index.remote import (
+    CANARY_SPACE_PREFIX,
+    namespace_policy,
+)
 from advanced_scrapper_tpu.index.store import NO_DOC, resolve_intra_batch
 from advanced_scrapper_tpu.runtime import FanoutPool
 from advanced_scrapper_tpu.index.wal import WriteAheadLog, replay_wal
@@ -2145,12 +2148,14 @@ class ShardedIndexClient:
         return out
 
     def wipe(self) -> int:
-        """Expire every posting of this CANARY space fleet-wide; returns
-        the total dropped count.
+        """Expire every posting of this wipe-allowed space fleet-wide;
+        returns the total dropped count.
 
-        Refused client-side (and again server-side) for any space outside
-        the reserved ``canary:`` prefix — the prober's between-rounds
-        expiry must be structurally unable to touch real postings.  Fans
+        Refused client-side (and again server-side) for any space whose
+        :func:`~advanced_scrapper_tpu.index.remote.namespace_policy` does
+        not declare ``wipe_allowed`` (``canary:`` probe expiry and
+        ``tenant:`` offboarding qualify) — expiry must be structurally
+        unable to touch real postings.  Fans
         to EVERY node of every shard, not just the write target: replicas
         hold synchronously replicated copies, and a wipe that missed one
         would resurrect canary postings at the next failover.  A node
@@ -2158,10 +2163,11 @@ class ShardedIndexClient:
         next round's wipe reaches it; canary spaces are never repaired
         back).  Pending spill entries for the space are dropped too — a
         replayed canary posting after expiry would be pollution."""
-        if not self.space.startswith(CANARY_SPACE_PREFIX):
+        if not namespace_policy(self.space).wipe_allowed:
             raise ValueError(
-                f"wipe is restricted to {CANARY_SPACE_PREFIX!r}-prefixed "
-                f"spaces, not {self.space!r}"
+                f"wipe is restricted to wipe-allowed namespace prefixes "
+                f"({CANARY_SPACE_PREFIX!r}, tenant spaces), not "
+                f"{self.space!r}"
             )
         dropped = 0
         for sh in self._shards:

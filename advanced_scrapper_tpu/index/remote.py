@@ -29,6 +29,7 @@ shard process is cheap to fork.
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
 
@@ -39,17 +40,76 @@ import advanced_scrapper_tpu.net.rpc as rpc  # the ONE allowed net import
 from advanced_scrapper_tpu.index import repair as antientropy
 from advanced_scrapper_tpu.index.store import PersistentIndex
 
-__all__ = ["IndexShardServer", "RemoteIndex", "paged_fetch_range", "serve_main"]
+__all__ = [
+    "IndexShardServer",
+    "NamespacePolicy",
+    "NAMESPACE_POLICIES",
+    "RemoteIndex",
+    "namespace_policy",
+    "paged_fetch_range",
+    "serve_main",
+]
 
 DEFAULT_SPACES = ("bands", "urls")
 
 #: reserved key-space name prefix for the ground-truth canary prober
 #: (``obs/canary.py`` declares the same literal — it may not import this
-#: layer).  Spaces under it are auto-provisioned on first touch, and they
-#: are the ONLY spaces the ``wipe`` RPC will drop: synthetic canary
-#: postings expire wholesale between probe rounds, while a stray wipe
-#: aimed at a real space is refused server-side.
+#: layer).
 CANARY_SPACE_PREFIX = "canary:"
+
+#: reserved key-space name prefix for multi-tenant namespaces: the
+#: service-layer gateway maps tenant ``t`` to ``tenant:t:<sub>`` spaces,
+#: so a tenant's band keys cannot collide with another tenant's (or with
+#: the shared ``bands``/``urls`` spaces) by construction.
+TENANT_SPACE_PREFIX = "tenant:"
+
+
+@dataclasses.dataclass(frozen=True)
+class NamespacePolicy:
+    """Declarative per-prefix key-space policy (the generalization of the
+    canary plane's special-casing): which space names are provisioned on
+    first touch, which the ``wipe`` RPC may drop, and which admission
+    quota class the service layer bills them under.
+
+    - ``auto_provision`` — spaces under the prefix materialize server-side
+      on first touch (the prober / a new tenant needs a live fleet to
+      answer without every deployment pre-declaring it); real spaces stay
+      declaration-only, so a typo'd space name fails instead of silently
+      shadowing the intended postings.
+    - ``wipe_allowed`` — the ``wipe`` RPC drops postings only inside
+      prefixes that declare it (canary expiry between probe rounds,
+      tenant offboarding); a stray wipe aimed at a real space is refused
+      server-side AND client-side.
+    - ``quota_class`` — the admission class the front-door gateway uses
+      when stacking per-namespace token buckets (informational at this
+      layer: the index plane never imports runtime/).
+    """
+
+    prefix: str
+    auto_provision: bool
+    wipe_allowed: bool
+    quota_class: str
+
+
+#: longest-prefix-match table; the ``""`` entry is the catch-all for
+#: declared real spaces (``bands``/``urls``/reshard targets): never
+#: auto-provisioned, never wipeable.
+NAMESPACE_POLICIES: tuple[NamespacePolicy, ...] = (
+    NamespacePolicy(CANARY_SPACE_PREFIX, True, True, "canary"),
+    NamespacePolicy(TENANT_SPACE_PREFIX, True, True, "tenant"),
+    NamespacePolicy("", False, False, "system"),
+)
+
+
+def namespace_policy(space: str) -> NamespacePolicy:
+    """The policy governing ``space``: longest matching prefix wins."""
+    best = None
+    for pol in NAMESPACE_POLICIES:
+        if space.startswith(pol.prefix):
+            if best is None or len(pol.prefix) > len(best.prefix):
+                best = pol
+    assert best is not None  # the "" catch-all always matches
+    return best
 
 
 class IndexShardServer:
@@ -239,10 +299,9 @@ class IndexShardServer:
             return self.indexes[sp]
         except KeyError:
             pass
-        if sp.startswith(CANARY_SPACE_PREFIX):
-            # canary spaces are provisioned on first touch: the prober
-            # needs a live fleet to answer under an isolated namespace
-            # without every deployment pre-declaring it.  Real spaces
+        if namespace_policy(sp).auto_provision:
+            # policy-declared prefixes (canary probe rounds, tenant
+            # namespaces) are provisioned on first touch; real spaces
             # stay declaration-only — a typo'd space name must fail, not
             # silently shadow the intended postings.
             with self._lock:
@@ -441,15 +500,16 @@ class IndexShardServer:
         return {"handed_off": len(idx.handed_off_ranges())}
 
     def _h_wipe(self, header, arrays):
-        """Drop every posting of ONE canary space (crash-safe committed
-        wipe, doc-id high-water preserved).  Refused for any space
-        outside the reserved prefix: expiry is a canary-plane verb, not
-        a general data-deletion API."""
+        """Drop every posting of ONE wipe-allowed space (crash-safe
+        committed wipe, doc-id high-water preserved).  Refused for any
+        space whose :func:`namespace_policy` does not declare
+        ``wipe_allowed``: canary expiry and tenant offboarding are
+        namespace-plane verbs, not a general data-deletion API."""
         sp = header.get("space", "")
-        if not sp.startswith(CANARY_SPACE_PREFIX):
+        if not namespace_policy(sp).wipe_allowed:
             raise ValueError(
-                f"wipe is restricted to {CANARY_SPACE_PREFIX!r}-prefixed "
-                f"spaces, not {sp!r}"
+                f"wipe is restricted to wipe-allowed namespace prefixes "
+                f"(policy {namespace_policy(sp).quota_class!r}), not {sp!r}"
             )
         idx = self.indexes.get(sp)
         if idx is None:

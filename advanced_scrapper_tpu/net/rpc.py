@@ -239,6 +239,19 @@ class RpcServer:
     overloaded server must stay provably alive, or overload becomes
     indistinguishable from death and triggers failover.
     ``method_priority`` maps method → priority class (default NORMAL).
+
+    ``admission_resolver`` is the PER-REQUEST half of the same gate: a
+    callable ``(method, header) -> (controller, priority) | None``
+    consulted before the static controller (the service gateway resolves
+    the request's tenant id to that tenant's own token bucket here).  A
+    resolved gate stacks UNDER the shared one — it is admitted first and
+    refused first, so a tenant over its quota is stopped at its own
+    bucket (billed to its own pressure series) without consuming a
+    shared slot, and its refusal rides the exact same uncached,
+    retry-after-carrying ``RpcOverloaded`` path.  Resolving through the
+    header rather than raising inside a handler is load-bearing: handler
+    exceptions are remembered under the request id and would replay a
+    stale refusal at the client's retry.
     """
 
     def __init__(
@@ -255,9 +268,11 @@ class RpcServer:
         admission=None,
         admission_methods=None,
         method_priority: dict[str, int] | None = None,
+        admission_resolver=None,
     ):
         self.handlers = dict(handlers)
         self.admission = admission
+        self.admission_resolver = admission_resolver
         self.admission_methods = (
             None if admission_methods is None else frozenset(admission_methods)
         )
@@ -518,7 +533,11 @@ class RpcServer:
                         send_frame(conn, hit[0], hit[1])
                         return True
                 return False
-        adm = None
+        gates: list = []  # (controller, priority); per-request gate FIRST
+        if self.admission_resolver is not None and method != "__ping__":
+            resolved = self.admission_resolver(method, header)
+            if resolved is not None:
+                gates.append(resolved)
         if (
             self.admission is not None
             and method != "__ping__"
@@ -531,38 +550,48 @@ class RpcServer:
                 PRIORITY_NORMAL,
             )
 
-            adm = self.admission.admit(
-                self.method_priority.get(method, PRIORITY_NORMAL)
+            gates.append((
+                self.admission,
+                self.method_priority.get(method, PRIORITY_NORMAL),
+            ))
+        admitted: list = []  # (controller, decision) already holding slots
+        for ctrl, prio in gates:
+            adm = ctrl.admit(prio)
+            if adm.admitted:
+                admitted.append((ctrl, adm))
+                continue
+            # counted reject + retry-after hint.  Deliberately NOT
+            # remembered under rid (claim withdrawn): a later retry
+            # of the same request must get a fresh admission
+            # decision, never a replayed refusal.  Slots already
+            # taken from earlier gates are handed back — a refusal
+            # must never leak inflight seats.
+            for held_ctrl, held in admitted:
+                held_ctrl.release(held)
+            if rid is not None:
+                self._unclaim(rid)
+            self.overload_rejects += 1
+            self._overload_counter(method).inc()
+            send_frame(
+                conn,
+                {
+                    "id": rid,
+                    "error": (
+                        f"{self.name}: {method} refused "
+                        f"admission ({adm.reason})"
+                    ),
+                    "etype": "RpcOverloaded",
+                    "retry_after": adm.retry_after,
+                },
             )
-            if not adm.admitted:
-                # counted reject + retry-after hint.  Deliberately NOT
-                # remembered under rid (claim withdrawn): a later retry
-                # of the same request must get a fresh admission
-                # decision, never a replayed refusal.
-                if rid is not None:
-                    self._unclaim(rid)
-                self.overload_rejects += 1
-                self._overload_counter(method).inc()
-                send_frame(
-                    conn,
-                    {
-                        "id": rid,
-                        "error": (
-                            f"{self.name}: {method} refused "
-                            f"admission ({adm.reason})"
-                        ),
-                        "etype": "RpcOverloaded",
-                        "retry_after": adm.retry_after,
-                    },
-                )
-                return True
+            return True
         try:
             return self._execute_and_respond(
                 conn, header, arrays, rid, method, tctx
             )
         finally:
-            if adm is not None:
-                self.admission.release(adm)
+            for ctrl, adm in admitted:
+                ctrl.release(adm)
 
     def _execute_and_respond(
         self, conn, header, arrays, rid, method, tctx

@@ -1,0 +1,495 @@
+"""Multi-tenant front door: namespaces, quotas, zero cross-tenant leakage.
+
+The acceptance spine of the dedup-as-a-service PR: two tenants pushing
+planted-dup corpora through one gateway over a live 2×2 loopback fleet
+must each see attributions BYTE-EQUAL to a single-tenant oracle run of
+the same corpus, a probe under tenant A must be structurally unable to
+touch tenant B's postings (asserted on the servers' own per-space
+posting counts AND on the decision journal's tenant annotations), and a
+tenant over its declared bucket must be answered with a retriable
+``RpcOverloaded`` + retry-after — never a wrong answer, and never for
+critical-priority traffic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from advanced_scrapper_tpu.index.fleet import ShardedIndexClient
+from advanced_scrapper_tpu.index.remote import (
+    CANARY_SPACE_PREFIX,
+    TENANT_SPACE_PREFIX,
+    IndexShardServer,
+    NAMESPACE_POLICIES,
+    namespace_policy,
+)
+from advanced_scrapper_tpu.net.rpc import (
+    RpcClient,
+    RpcOverloaded,
+    RpcRemoteError,
+)
+from advanced_scrapper_tpu.runtime.admission import PRIORITY_CRITICAL
+from advanced_scrapper_tpu.obs import decisions, telemetry
+from advanced_scrapper_tpu.obs.decisions import DecisionJournal
+from advanced_scrapper_tpu.service import (
+    DedupGateway,
+    GATED_VERBS,
+    TenantRegistry,
+    TenantSpec,
+    tenant_space,
+)
+
+BANDS = 8
+
+
+@pytest.fixture
+def fresh_registry():
+    telemetry.REGISTRY.reset()
+    telemetry.set_enabled(True)
+    yield telemetry.REGISTRY
+    telemetry.REGISTRY.reset()
+    telemetry.set_enabled(None)
+
+
+def _counter(name, **labels):
+    for m in telemetry.REGISTRY.find(name):
+        if all(m.labels.get(k) == str(v) for k, v in labels.items()):
+            return m.value
+    return 0.0
+
+
+def _fleet(tmp_path, shards=2, replicas=2, **client_kw):
+    servers, parts = [], []
+    for s in range(shards):
+        nodes = []
+        for r in range(replicas):
+            srv = IndexShardServer(
+                str(tmp_path / f"s{s}n{r}"),
+                spaces=("bands", "urls"),
+                cut_postings=6 * BANDS,
+                compact_segments=4,
+                compact_inline=True,
+                name=f"s{s}n{r}",
+            ).start()
+            servers.append(srv)
+            nodes.append(f"127.0.0.1:{srv.port}")
+        parts.append("|".join(nodes))
+    kw = dict(
+        space="bands",
+        timeout=2.0,
+        retries=1,
+        health_timeout=0.2,
+    )
+    kw.update(client_kw)
+    return servers, ShardedIndexClient(";".join(parts), **kw)
+
+
+def _corpus(tenant: str, n: int, bands: int = BANDS) -> np.ndarray:
+    """Planted-dup band keys: doc ``i`` with ``i % 7 == 3`` repeats doc
+    ``i-3``'s row; every other doc is unique.  The per-tenant crc32 salt
+    makes corpora KEY-DISJOINT across tenants — any cross-tenant hit is
+    a provable leak, not a collision."""
+    salt = zlib.crc32(tenant.encode()) & 0xFFFFFFFF
+    rows = np.empty((n, bands), np.uint64)
+    lanes = np.arange(bands, dtype=np.uint64)
+    for i in range(n):
+        src = i - 3 if (i % 7 == 3 and i >= 3) else i
+        v = (
+            lanes + np.uint64(src * 4096) + np.uint64(salt * 7 + 29)
+        ) * np.uint64(0x9E3779B97F4A7C15)
+        rows[i] = v ^ (v >> np.uint64(31))
+    return rows
+
+
+def _expected_attr(n: int) -> np.ndarray:
+    """Analytic ground truth for :func:`_corpus` submitted in doc order
+    with ids = doc index."""
+    return np.asarray(
+        [i - 3 if (i % 7 == 3 and i >= 3) else -1 for i in range(n)],
+        np.int64,
+    )
+
+
+def _space_postings(servers, space: str) -> int:
+    total = 0
+    for srv in servers:
+        idx = srv.indexes.get(space)
+        if idx is not None:
+            st = idx.stats()
+            total += int(st["segment_postings"]) + int(st["wal_postings"])
+    return total
+
+
+# -- namespace policy table ------------------------------------------------
+
+
+def test_namespace_policy_classes():
+    canary = namespace_policy(CANARY_SPACE_PREFIX + "probe")
+    assert canary.quota_class == "canary"
+    assert canary.auto_provision and canary.wipe_allowed
+    tenant = namespace_policy(tenant_space("acme"))
+    assert tenant.quota_class == "tenant"
+    assert tenant.auto_provision and tenant.wipe_allowed
+    for real in ("bands", "urls", ""):
+        pol = namespace_policy(real)
+        assert pol.quota_class == "system"
+        assert not pol.auto_provision and not pol.wipe_allowed
+
+
+def test_namespace_policy_longest_prefix_and_frozen():
+    # the bare prefixes themselves resolve to their own class, and the
+    # match is prefix-based, not equality
+    assert namespace_policy(TENANT_SPACE_PREFIX).quota_class == "tenant"
+    assert namespace_policy("tenant").quota_class == "system"  # no colon
+    assert namespace_policy("canary").quota_class == "system"
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        NAMESPACE_POLICIES[0].wipe_allowed = True  # type: ignore[misc]
+
+
+# -- tenant declarations ---------------------------------------------------
+
+
+def test_tenant_space_shape_and_charset():
+    assert tenant_space("acme") == "tenant:acme:bands"
+    assert tenant_space("acme", "urls") == "tenant:acme:urls"
+    for bad in ("", "a:b", "-lead", "x" * 65, "sp ace"):
+        with pytest.raises(ValueError):
+            tenant_space(bad)
+    # a valid tenant space always lands under the auto-provisioned prefix
+    assert namespace_policy(tenant_space("a.b-c_9")).quota_class == "tenant"
+
+
+def test_tenant_spec_parse_roundtrip():
+    spec = TenantSpec.parse(
+        "acme,rate=500,burst=50,inflight=8,p99=0.25,rejects=0.1,budget=0.02"
+    )
+    assert spec == TenantSpec(
+        tenant="acme",
+        rate=500.0,
+        burst=50.0,
+        max_inflight=8,
+        p99_slo_s=0.25,
+        reject_budget=0.1,
+        slo_budget=0.02,
+    )
+    assert TenantSpec.parse("solo").tenant == "solo"
+    for bad in ("", "acme,nope=1", "acme,rate", "a:b"):
+        with pytest.raises(ValueError):
+            TenantSpec.parse(bad)
+
+
+def test_tenant_registry_open_vs_closed():
+    open_reg = TenantRegistry(
+        default=TenantSpec(tenant="default", rate=9.0)
+    )
+    stamped = open_reg.get("newco")
+    assert stamped.tenant == "newco" and stamped.rate == 9.0
+    assert open_reg.get("newco") is stamped  # stable after first stamp
+    assert "newco" in open_reg.known()
+    # a walk-in is known but NOT declared: the status surface must let
+    # an operator tell budgeted tenants from auto-provisioned ones
+    assert "newco" not in open_reg.declared()
+
+    closed = TenantRegistry(
+        specs=[TenantSpec(tenant="acme")], auto_provision=False
+    )
+    assert closed.declared() == ("acme",)
+    assert closed.get("acme").tenant == "acme"
+    with pytest.raises(KeyError):
+        closed.get("stranger")
+    with pytest.raises(KeyError):
+        closed.get("bad:id")
+
+
+# -- the zero-leakage acceptance (live 2×2 fleet) --------------------------
+
+
+def test_gateway_zero_cross_tenant_leakage(tmp_path, fresh_registry):
+    servers, client = _fleet(tmp_path)
+    decisions.configure(str(tmp_path / "journal.jsonl"), sample=1.0)
+    gw = rc = None
+    try:
+        gw = DedupGateway(
+            client,
+            registry=TenantRegistry(),
+            name="leaktest",
+            stats_interval=0.0,
+        ).start()
+        rc = RpcClient(("127.0.0.1", gw.port), timeout=5.0)
+
+        n = 35
+        corpora = {t: _corpus(t, n) for t in ("alpha", "beta")}
+        got: dict[str, list[np.ndarray]] = {"alpha": [], "beta": []}
+        # interleave the two tenants batch-by-batch: leaks, if any,
+        # would come from exactly this mixing on one shared fleet
+        for lo in range(0, n, 7):
+            for t in ("alpha", "beta"):
+                ids = np.arange(lo, lo + 7, dtype=np.uint64)
+                resp, arrays = rc.call(
+                    "submit_batch",
+                    {"tenant": t},
+                    [corpora[t][lo : lo + 7], ids],
+                )
+                assert resp["n"] == 7 and not resp["allocated"]
+                got[t].append(np.asarray(arrays[0], np.int64))
+
+        expected = _expected_attr(n)
+        for t in ("alpha", "beta"):
+            attr = np.concatenate(got[t])
+            assert np.array_equal(attr, expected), f"{t}: wrong attributions"
+
+        # single-tenant oracle: the SAME corpus through a direct
+        # (gateway-free, tenant-free) sibling client must answer
+        # byte-identically — the front door adds routing, not semantics
+        oracle = client.for_space(CANARY_SPACE_PREFIX + "oracle")
+        try:
+            oracle_attr = []
+            for lo in range(0, n, 7):
+                ids = np.arange(lo, lo + 7, dtype=np.uint64)
+                oracle_attr.append(
+                    np.asarray(
+                        oracle.check_and_add_batch(
+                            corpora["alpha"][lo : lo + 7], ids
+                        ),
+                        np.int64,
+                    )
+                )
+            assert (
+                np.concatenate(oracle_attr).tobytes()
+                == np.concatenate(got["alpha"]).tobytes()
+            )
+        finally:
+            oracle.wipe()
+            oracle.close()
+
+        # a probe under alpha must never touch beta's postings: the
+        # per-space counts on the servers themselves are the evidence
+        beta_before = _space_postings(servers, tenant_space("beta"))
+        assert beta_before > 0
+        _resp, arrays = rc.call(
+            "probe_batch", {"tenant": "alpha"}, [corpora["beta"]]
+        )
+        assert (np.asarray(arrays[0]) == -1).all(), (
+            "beta's keys must be INVISIBLE under alpha"
+        )
+        assert _space_postings(servers, tenant_space("beta")) == beta_before
+
+        # ... and the probe answers alpha's own truth unchanged
+        _resp, arrays = rc.call(
+            "probe_batch", {"tenant": "alpha"}, [corpora["alpha"]]
+        )
+        probe = np.asarray(arrays[0], np.int64)
+        dup_rows = expected >= 0
+        assert np.array_equal(probe[dup_rows], expected[dup_rows])
+        # previously-inserted unique rows now attribute to themselves
+        assert (
+            probe[~dup_rows] == np.arange(n, dtype=np.int64)[~dup_rows]
+        ).all()
+
+        resp = rc.call(
+            "query", {"tenant": "beta"}, [corpora["beta"][3]]
+        )[0]
+        assert resp["doc"] == 0  # doc 3 is planted on doc 0
+
+        # the journal's tenant annotations partition cleanly: no row
+        # billed to one tenant carries the other's outcome stream
+        rows = DecisionJournal.read(str(tmp_path / "journal.jsonl"))
+        by_tenant: dict[str, list[dict]] = {}
+        for r in rows:
+            if r.get("tier") == "index" and "tenant" in r:
+                by_tenant.setdefault(r["tenant"], []).append(r)
+        assert set(by_tenant) == {"alpha", "beta"}
+        for t in ("alpha", "beta"):
+            assert len(by_tenant[t]) == n
+            docs = sorted(r["doc"] for r in by_tenant[t])
+            assert docs == list(range(n))
+            attrs = {r["doc"]: r["attr"] for r in by_tenant[t]}
+            assert all(attrs[i] == int(expected[i]) for i in range(n))
+
+        # tenant_status sees both key spaces with live posting counts
+        status = rc.call("tenant_status", {})[0]
+        assert set(status["tenants"]) >= {"alpha", "beta"}
+        for t in ("alpha", "beta"):
+            st = status["tenants"][t]
+            assert st["space"] == tenant_space(t)
+            assert st["postings"] and st["postings"] > 0
+
+        # offboarding: wipe alpha, beta untouched
+        dropped = rc.call("wipe_tenant", {"tenant": "alpha"})[0]["dropped"]
+        assert dropped > 0
+        assert _space_postings(servers, tenant_space("alpha")) == 0
+        assert _space_postings(servers, tenant_space("beta")) == beta_before
+        _resp, arrays = rc.call(
+            "probe_batch", {"tenant": "alpha"}, [corpora["alpha"]]
+        )
+        assert (np.asarray(arrays[0]) == -1).all()
+    finally:
+        decisions.set_recorder(None)
+        if rc is not None:
+            rc.close()
+        if gw is not None:
+            gw.stop()
+        client.close()
+        for srv in servers:
+            srv.stop()
+
+
+# -- quotas ----------------------------------------------------------------
+
+
+def test_quota_refusal_is_retriable_never_wrong(tmp_path, fresh_registry):
+    servers, client = _fleet(tmp_path, shards=1, replicas=1)
+    gw = rc = None
+    try:
+        gw = DedupGateway(
+            client,
+            registry=TenantRegistry(
+                specs=[
+                    TenantSpec(
+                        tenant="capped", rate=15.0, burst=2.0, max_inflight=2
+                    )
+                ],
+                auto_provision=False,
+            ),
+            name="quotatest",
+            stats_interval=0.0,
+        ).start()
+        rc = RpcClient(("127.0.0.1", gw.port), timeout=5.0)
+        keys = _corpus("capped", 40)
+        # a 2-token bucket at 15/s against a tight loop of 20 submits:
+        # most calls MUST be refused at least once — and every one must
+        # still land (retry-after honored inside the client, same
+        # request id)
+        for lo in range(0, 40, 2):
+            ids = np.arange(lo, lo + 2, dtype=np.uint64)
+            resp, arrays = rc.call(
+                "submit_batch", {"tenant": "capped"}, [keys[lo : lo + 2], ids]
+            )
+            assert resp["n"] == 2
+        attr = np.asarray(
+            rc.call("probe_batch", {"tenant": "capped"}, [keys])[1][0],
+            np.int64,
+        )
+        dup_rows = _expected_attr(40) >= 0
+        assert np.array_equal(
+            attr[dup_rows], _expected_attr(40)[dup_rows]
+        ), "throttling must never change answers"
+        rejected = _counter(
+            "astpu_tenant_rejected_total", tenant="capped", reason="rate"
+        )
+        assert rejected > 0, "the loop must have overrun the bucket"
+        # every quota refusal is double-entry bookkeeping: the by-reason
+        # counter and the by-verb outcome=rejected stream must agree
+        rejected_by_verb = sum(
+            m.value
+            for m in telemetry.REGISTRY.find("astpu_tenant_requests_total")
+            if m.labels.get("tenant") == "capped"
+            and m.labels.get("outcome") == "rejected"
+        )
+        assert rejected_by_verb == rejected
+        assert _counter("astpu_rpc_client_overloaded_total") > 0
+        assert _counter("astpu_rpc_overload_backoff_seconds_total") > 0, (
+            "the client must have slept the server's retry-after hint"
+        )
+
+        # critical traffic is never refused: drain the bucket, then a
+        # no-retry client at PRIORITY_CRITICAL must land first try
+        strict = RpcClient(("127.0.0.1", gw.port), timeout=5.0, retries=0)
+        try:
+            refused = False
+            for i in range(200):
+                try:
+                    strict.call(
+                        "query", {"tenant": "capped"}, [keys[i % 40]]
+                    )
+                except RpcOverloaded as e:
+                    refused = True
+                    assert e.retry_after and e.retry_after > 0
+                    break
+            assert refused, "tight no-retry loop must hit the bucket"
+            resp = strict.call(
+                "query",
+                {"tenant": "capped", "priority": PRIORITY_CRITICAL},
+                [keys[3]],
+            )[0]
+            assert resp["doc"] == 0
+        finally:
+            strict.close()
+
+        # closed registry: a stranger gets the deterministic remote
+        # error (no gate, no retry storm), not an overload
+        with pytest.raises(RpcRemoteError, match="stranger"):
+            rc.call("query", {"tenant": "stranger"}, [keys[0]])
+    finally:
+        if rc is not None:
+            rc.close()
+        if gw is not None:
+            gw.stop()
+        client.close()
+        for srv in servers:
+            srv.stop()
+
+
+def test_gateway_objectives_and_pressure(fresh_registry, tmp_path):
+    servers, client = _fleet(tmp_path, shards=1, replicas=1)
+    gw = None
+    try:
+        gw = DedupGateway(
+            client,
+            registry=TenantRegistry(
+                specs=[
+                    TenantSpec(
+                        tenant="acme",
+                        rate=100.0,
+                        p99_slo_s=0.25,
+                        reject_budget=0.1,
+                        slo_budget=0.02,
+                    )
+                ],
+                auto_provision=False,
+            ),
+            stats_interval=0.0,
+        )
+        gw._ensure("acme")
+        objs = {o["name"]: o for o in gw.objectives()}
+        p99 = objs["tenant_acme_p99"]
+        assert p99["kind"] == "p99_latency_max"
+        assert p99["metric"] == "astpu_tenant_seconds"
+        assert p99["labels"] == {"tenant": "acme"}
+        assert p99["threshold"] == 0.25 and p99["budget"] == 0.02
+        rej = objs["tenant_acme_rejects"]
+        assert rej["kind"] == "ratio_max"
+        assert rej["denominator"] == "astpu_tenant_requests_total"
+        assert rej["threshold"] == 0.1
+        # the SLO engine must accept them as-is
+        from advanced_scrapper_tpu.obs.slo import SloEngine
+
+        SloEngine(gw.objectives()).evaluate()
+
+        # per-tenant admission gates feed the shared pressure surface
+        # under their own gate label (the autoscaler's input)
+        t = gw._tenants["acme"]
+        assert t.ctrl.name == "tenant:acme"
+        assert gw.pressure() >= 0.0
+        from advanced_scrapper_tpu.obs.slo import SloEngine as _SE
+
+        assert any(
+            name == "astpu_admission_pressure"
+            and labels.get("gate") == "tenant:acme"
+            for name, labels, _v in _SE.registry_samples()
+        ), "tenant gates must surface on the autoscaler's pressure feed"
+    finally:
+        if gw is not None:
+            gw.stop()
+        client.close()
+        for srv in servers:
+            srv.stop()
+
+
+def test_gated_verbs_cover_the_data_plane():
+    assert GATED_VERBS == {"submit_batch", "probe_batch", "query"}

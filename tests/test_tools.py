@@ -554,8 +554,24 @@ def test_lint_imports_catches_violations(tmp_path):
     (pkg / "obs" / "ok.py").write_text(
         "import advanced_scrapper_tpu.index.store\n"  # layer-wide: allowed
     )
+    # the front door routes and meters — it may ride net/index/runtime/
+    # obs but never the dedup math itself; and its tenancy module is pure
+    # declarations (no transport even though the layer allows it)
+    (pkg / "service").mkdir()
+    (pkg / "service" / "bad.py").write_text(
+        "def serve():\n"
+        "    from advanced_scrapper_tpu.pipeline.dedup import DedupEngine\n"
+    )
+    (pkg / "service" / "tenancy.py").write_text(
+        "import advanced_scrapper_tpu.net.rpc as rpc\n"
+    )
+    (pkg / "service" / "ok.py").write_text(
+        "from advanced_scrapper_tpu.index.fleet import ShardedIndexClient\n"
+        "import advanced_scrapper_tpu.net.rpc\n"
+        "from advanced_scrapper_tpu.obs import telemetry\n"
+    )
     problems = lint_imports.lint(str(tmp_path))
-    assert len(problems) == 21, problems
+    assert len(problems) == 23, problems
     assert any("parallel/ must not import pipeline/" in p for p in problems)
     assert any("parallel/ must not import runtime/" in p for p in problems)
     assert any("parallel/ must not import index/" in p for p in problems)
@@ -600,9 +616,16 @@ def test_lint_imports_catches_violations(tmp_path):
         os.path.join("obs", "canary.py") in p and "must not import index/" in p
         for p in problems
     ), "module rule: the canary prober's index hooks are injected"
+    assert any(
+        "service/ must not import pipeline/" in p for p in problems
+    ), "layer rule: the front door never holds the dedup math"
+    assert any(
+        "tenancy.py" in p and "must not import net/" in p for p in problems
+    ), "module rule: tenant declarations stay transport-free"
     assert not any("ok.py" in p for p in problems), (
-        "net.rpc is exempt for index/, runtime/ may use obs/, and the "
-        "obs layer itself carries no layer-wide ban"
+        "net.rpc is exempt for index/, runtime/ may use obs/, the obs "
+        "layer itself carries no layer-wide ban, and service/ may ride "
+        "net/index/obs"
     )
 
 
@@ -1214,3 +1237,140 @@ def test_fleet_snapshot_refuses_mid_reshard(tmp_path):
         assert fleet_snapshot.verify_snapshot(str(tmp_path / "snap2")) == []
     finally:
         srv.stop()
+
+
+def test_loadgen_tenant_smoke_verdict():
+    """The self-contained mixed-tenant storm: skewed per-tenant offered
+    rates through one gateway over a live loopback fleet — zero transport
+    failures, zero wrong answers, zero cross-tenant hits, the noisy
+    tenant throttled (quiet tenants never), retry-after honored, and the
+    per-tenant SLO verdict green."""
+    import loadgen
+
+    report = loadgen.run_tenant_smoke(tenants=3, duration=1.0, base_rate=50.0)
+    assert report["ok_verdict"], report["problems"]
+    assert report["isolation_violations"] == 0
+    for tid, ledger in report["tenants"].items():
+        assert ledger["transport_failures"] == 0, tid
+        assert ledger["wrong_answers"] == 0, tid
+        assert ledger["ok"] > 0, tid
+        assert "p50" in ledger["latency_ms"] and "p99" in ledger["latency_ms"]
+    noisy = max(report["tenants"])  # last tenant id sorts last (t0, t1, …)
+    assert report["quota_rejects"][noisy] > 0, (
+        "the noisy tenant must have overrun its bucket"
+    )
+    assert report["slo"]["ok"]
+
+
+def test_loadgen_cli_tenant_smoke(tmp_path, capsys):
+    import json
+
+    import loadgen
+
+    out = tmp_path / "tenants.json"
+    rc = loadgen.main(
+        ["--tenants", "2", "--duration", "0.8", "--out", str(out)]
+    )
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["ok_verdict"] and "tenants" in report
+
+
+def test_obs_top_tenants_once_smoke(capsys):
+    """obs_top --tenants --once against a live StatusServer carrying the
+    gateway's per-tenant ledger: request/reject tables, the per-tenant
+    posting/p99/burn row, and the violated-objective banner."""
+    import obs_top
+
+    from advanced_scrapper_tpu.obs import telemetry
+
+    telemetry.REGISTRY.reset()
+    telemetry.set_enabled(True)
+    srv = None
+    try:
+        telemetry.REGISTRY.counter(
+            "astpu_tenant_requests_total", "t", always=True,
+            tenant="acme", verb="submit_batch", outcome="ok",
+        ).inc(40)
+        telemetry.REGISTRY.counter(
+            "astpu_tenant_requests_total", "t", always=True,
+            tenant="acme", verb="submit_batch", outcome="rejected",
+        ).inc(4)
+        telemetry.REGISTRY.counter(
+            "astpu_tenant_rejected_total", "t", always=True,
+            tenant="acme", reason="rate",
+        ).inc(4)
+        telemetry.REGISTRY.gauge(
+            "astpu_tenant_postings", "t", always=True, tenant="acme"
+        ).set(1234)
+        h = telemetry.REGISTRY.histogram(
+            "astpu_tenant_seconds", "t", always=True,
+            tenant="acme", verb="submit_batch",
+        )
+        for _ in range(20):
+            h.observe(0.002)
+        telemetry.REGISTRY.gauge(
+            "astpu_slo_burn_rate", "t",
+            objective="tenant_acme_p99", window="fast",
+        ).set(2.5)
+        telemetry.REGISTRY.gauge(
+            "astpu_slo_compliant", "t", objective="tenant_acme_p99"
+        ).set(0.0)
+        srv = telemetry.StatusServer(port=0).start()
+        rc = obs_top.main(
+            ["--url", f"http://127.0.0.1:{srv.port}", "--once", "--tenants"]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "obs_top --tenants @" in out
+        assert "tenants (front-door gateway):" in out
+        assert "acme" in out and "submit_batch" in out
+        assert "quota rejects" in out and "rate" in out
+        assert "1234" in out  # posting count
+        assert "2.50" in out  # burn column
+        assert "tenant slo VIOLATED: tenant_acme_p99" in out
+    finally:
+        if srv is not None:
+            srv.stop()
+        telemetry.REGISTRY.reset()
+        telemetry.set_enabled(None)
+
+
+def test_lint_metrics_covers_tenant_series():
+    """The naming linter sees the gateway's per-tenant series — one
+    owner each (service/gateway.py), suffix rules green."""
+    import lint_metrics
+
+    seen: dict[str, set] = {}
+    pkg = os.path.join(REPO, "advanced_scrapper_tpu")
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if fn.endswith(".py"):
+                _problems, regs = lint_metrics.check_file(
+                    os.path.join(dirpath, fn)
+                )
+                for name, _kind, _ln in regs:
+                    seen.setdefault(name, set()).add(fn)
+    for name in (
+        "astpu_tenant_requests_total",
+        "astpu_tenant_rejected_total",
+        "astpu_tenant_seconds",
+        "astpu_tenant_postings",
+    ):
+        assert name in seen, f"{name} never registered"
+        assert seen[name] == {"gateway.py"}, (name, seen[name])
+    assert not lint_metrics.lint(), "naming lint must stay clean"
+
+
+def test_crashsweep_tenant_workload_registered():
+    """Mixed-tenant traffic under shard kills is a first-class crashsweep
+    workload: child + verifier registered, and the default battery
+    actually schedules it."""
+    import inspect
+
+    import crashsweep
+
+    assert "tenant" in crashsweep.CHILDREN
+    assert "tenant" in crashsweep.VERIFIERS
+    battery = inspect.getsource(crashsweep.main)
+    assert "sweep_tenant(" in battery

@@ -63,6 +63,16 @@ FLEET_REPLICAS = 2
 #: chaos-exit the primary INSIDE a WAL append syscall
 FLEET_KILL_MODES = ("insert", "probe", "promotion", "wal")
 
+#: tenant workload: mixed two-tenant traffic through the service-plane
+#: gateway (every request carries a tenant id; answers come from the
+#: tenant's own key space) over the same 2×2 fleet, with a shard primary
+#: SIGKILLed mid-stream — a node death must never leak one tenant's
+#: postings into another's answers, and each tenant's stream must still
+#: byte-match its single-node oracle
+TENANT_DOCS = 56
+TENANT_BATCH = 8
+TENANT_IDS = ("acme", "bolt")
+
 #: reshard workload: a live 2→4 cutover under the planted-dup stream with
 #: the ORCHESTRATING child SIGKILLed at a seeded instant — landing mid
 #: migration stream, mid dual-write window, or mid flip — or chaos-exited
@@ -431,6 +441,70 @@ def fleet_oracle_annotations():
     return ann, minmap
 
 
+def _tenant_doc_keys(tenant: str, i: int):
+    """Band keys for tenant doc ``i`` — the planted-dup scheme under a
+    per-tenant crc32 salt, so the two tenants' corpora are KEY-DISJOINT
+    by construction: any cross-tenant hit the sweep observes is a
+    provable leak, not a collision."""
+    import zlib
+
+    import numpy as np
+
+    salt = zlib.crc32(tenant.encode()) & 0xFFFFFFFF
+    src = i - 3 if (i % 7 == 3 and i >= 3) else i
+    x = (np.arange(PINDEX_BANDS, dtype=np.uint64)
+         + np.uint64(src * 1000 + salt * 2 + 11)) * np.uint64(0xD1B54A32D192ED03)
+    x ^= x >> np.uint64(31)
+    return x
+
+
+_TENANT_ORACLE_CACHE: dict = {}
+
+
+def tenant_oracle(tenant: str):
+    """One tenant's never-killed single-node truth: the same fixed-doc-id
+    batch stream through ONE PersistentIndex.  Fixed ids make every
+    insert idempotent, so a stream retried across the mid-case shard kill
+    converges on these exact annotations.  Returns ``(annotations,
+    probe answers per doc)``; memoized per tenant."""
+    if tenant in _TENANT_ORACLE_CACHE:
+        return _TENANT_ORACLE_CACHE[tenant]
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from advanced_scrapper_tpu.index import PersistentIndex
+
+    base = tempfile.mkdtemp(prefix=f"tenant-oracle-{tenant}-")
+    idx = PersistentIndex(
+        os.path.join(base, "oracle"),
+        cut_postings=6 * PINDEX_BANDS,
+        compact_segments=4,
+        compact_inline=True,
+    )
+    ann: list[int] = []
+    try:
+        for start in range(0, TENANT_DOCS, TENANT_BATCH):
+            rows = range(start, min(start + TENANT_BATCH, TENANT_DOCS))
+            keys = np.stack([_tenant_doc_keys(tenant, i) for i in rows])
+            ids = np.asarray(list(rows), np.uint64)
+            ann += np.asarray(idx.check_and_add_batch(keys, ids)).tolist()
+        probes = np.asarray(
+            idx.probe_batch(
+                np.stack(
+                    [_tenant_doc_keys(tenant, i) for i in range(TENANT_DOCS)]
+                )
+            ),
+            np.int64,
+        ).tolist()
+    finally:
+        idx.close()
+        shutil.rmtree(base, ignore_errors=True)
+    _TENANT_ORACLE_CACHE[tenant] = (ann, probes)
+    return ann, probes
+
+
 def _reshard_doc_keys(i: int):
     """Band keys for reshard doc ``i`` — the planted-dup scheme under its
     own salt (never aliases fleet/overload/pindex cases)."""
@@ -733,6 +807,156 @@ def child_fleet(case_dir: str, seed: int) -> int:
         )
         return 0
     finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def child_tenant(case_dir: str, seed: int) -> int:
+    """Mixed two-tenant traffic through the front-door gateway under a
+    seeded shard-primary SIGKILL.
+
+    Spawns the 2×2 fleet, an in-process :class:`DedupGateway` over it,
+    and drives both tenants' planted-dup streams batch-interleaved over
+    loopback RPC — every request carrying its tenant id, every doc id
+    FIXED (idempotent across the kill's failover window).  At a seeded
+    batch the seeded shard's primary is SIGKILLed mid-mixed-traffic; the
+    per-tenant fleet siblings must carry both streams to completion
+    through failover/promotion.  The report holds each tenant's
+    annotations + final probe matrix (byte-compared against
+    :func:`tenant_oracle`) and a cross-tenant isolation sweep: tenant
+    A's keys probed under B must all answer −1."""
+    os.environ["ASTPU_TELEMETRY"] = "1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+
+    from advanced_scrapper_tpu.index.fleet import FleetSpec, ShardedIndexClient
+    from advanced_scrapper_tpu.net.rpc import RpcClient
+    from advanced_scrapper_tpu.service import (
+        DedupGateway,
+        TenantRegistry,
+        TenantSpec,
+    )
+
+    rng = random.Random(f"tenant-child|{seed}")
+    kill_shard = rng.randrange(FLEET_SHARDS)
+    n_batches = (TENANT_DOCS + TENANT_BATCH - 1) // TENANT_BATCH
+    kill_batch = rng.randrange(2, n_batches - 2)
+
+    port_list = _fleet_pick_ports(FLEET_SHARDS * FLEET_REPLICAS)
+    ports = {
+        (sid, rep): port_list[sid * FLEET_REPLICAS + rep]
+        for sid in range(FLEET_SHARDS)
+        for rep in range(FLEET_REPLICAS)
+    }
+    procs: dict[tuple[int, int], subprocess.Popen] = {}
+    gw = rc = client = None
+    try:
+        for sid in range(FLEET_SHARDS):
+            for rep in range(FLEET_REPLICAS):
+                procs[(sid, rep)] = _fleet_spawn_server(
+                    case_dir, sid, rep, None, ports[(sid, rep)]
+                )
+        client = ShardedIndexClient(
+            FleetSpec(
+                shards=tuple(
+                    tuple(
+                        ("127.0.0.1", ports[(sid, rep)])
+                        for rep in range(FLEET_REPLICAS)
+                    )
+                    for sid in range(FLEET_SHARDS)
+                )
+            ),
+            space="bands",
+            timeout=1.0,
+            retries=2,
+            health_checks=2,
+            health_timeout=0.3,
+        )
+        gw = DedupGateway(
+            client,
+            registry=TenantRegistry(
+                specs=[TenantSpec(tenant=t) for t in TENANT_IDS],
+                auto_provision=False,
+            ),
+            name="sweep",
+            spill_dir=os.path.join(case_dir, "spill"),
+            stats_interval=0.0,
+        ).start()
+        rc = RpcClient(("127.0.0.1", gw.port), timeout=5.0, retries=3)
+        _touch_marker(case_dir)
+        ann: dict[str, list[int]] = {t: [] for t in TENANT_IDS}
+        for b in range(n_batches):
+            if b == kill_batch:
+                os.kill(procs[(kill_shard, 0)].pid, signal.SIGKILL)
+                procs[(kill_shard, 0)].wait()
+            rows = range(
+                b * TENANT_BATCH, min((b + 1) * TENANT_BATCH, TENANT_DOCS)
+            )
+            for t in TENANT_IDS:
+                keys = np.stack([_tenant_doc_keys(t, i) for i in rows])
+                ids = np.asarray(list(rows), np.uint64)
+                _resp, arrays = rc.call(
+                    "submit_batch", {"tenant": t}, [keys, ids]
+                )
+                ann[t] += np.asarray(arrays[0], np.int64).tolist()
+        probes: dict[str, list[int]] = {}
+        leaks = 0
+        for t in TENANT_IDS:
+            all_keys = np.stack(
+                [_tenant_doc_keys(t, i) for i in range(TENANT_DOCS)]
+            )
+            _resp, arrays = rc.call("probe_batch", {"tenant": t}, [all_keys])
+            probes[t] = np.asarray(arrays[0], np.int64).tolist()
+            # the isolation sweep: this tenant's keys under EVERY other
+            # tenant must be invisible
+            for other in TENANT_IDS:
+                if other == t:
+                    continue
+                _resp, arrays = rc.call(
+                    "probe_batch", {"tenant": other}, [all_keys]
+                )
+                leaks += int((np.asarray(arrays[0], np.int64) >= 0).sum())
+        failovers = promotions = spill_pending = 0
+        with gw._lock:
+            tenants = dict(gw._tenants)
+        for t in tenants.values():
+            failovers += t.client._m_failovers.value
+            promotions += t.client._m_promotions.value
+            spill_pending += sum(
+                int(k.size)
+                for sh in t.client._shards
+                for (_r, k, _d) in sh.pending
+            )
+        report = {
+            "kill_shard": kill_shard,
+            "kill_batch": kill_batch,
+            "annotations": ann,
+            "probes": probes,
+            "isolation_violations": leaks,
+            "failovers": failovers,
+            "promotions": promotions,
+            "spill_pending": spill_pending,
+        }
+        from advanced_scrapper_tpu.storage.fsio import atomic_replace
+
+        atomic_replace(
+            os.path.join(case_dir, "tenant_report.json"),
+            json.dumps(report).encode(),
+        )
+        return 0
+    finally:
+        if rc is not None:
+            rc.close()
+        if gw is not None:
+            gw.stop()
+        if client is not None:
+            client.close()
         for p in procs.values():
             if p.poll() is None:
                 p.terminate()
@@ -1299,6 +1523,7 @@ CHILDREN = {
     "stream": child_stream,
     "pindex": child_pindex,
     "fleet": child_fleet,
+    "tenant": child_tenant,
     "reshard": child_reshard,
     "overload": child_overload,
     "graph": child_graph,
@@ -1630,6 +1855,56 @@ def verify_fleet(case_dir: str) -> list[str]:
     return problems
 
 
+def verify_tenant(case_dir: str) -> list[str]:
+    """Zero-leakage convergence for the tenant sweep:
+
+    - each tenant's annotations AND final probe matrix are byte-identical
+      to its own single-node oracle — a shard kill mid-mixed-traffic may
+      slow a tenant down, never change its answers;
+    - the cross-tenant isolation sweep saw zero hits (tenant A's keys
+      are invisible under B, even across the failover window);
+    - no spilled postings left pending.
+    """
+    problems: list[str] = []
+    report_path = os.path.join(case_dir, "tenant_report.json")
+    if not os.path.exists(report_path):
+        return ["tenant child never wrote its report (gateway died)"]
+    with open(report_path) as f:
+        report = json.load(f)
+    for t in TENANT_IDS:
+        oracle_ann, oracle_probes = tenant_oracle(t)
+        got_ann = report["annotations"].get(t)
+        if got_ann != oracle_ann:
+            diff = [
+                i for i, (a, b) in enumerate(zip(got_ann or [], oracle_ann))
+                if a != b
+            ]
+            problems.append(
+                f"tenant {t}: annotations diverge from the single-node "
+                f"oracle at docs {diff[:5]} (of {len(diff)})"
+            )
+        got_probes = report["probes"].get(t)
+        if got_probes != oracle_probes:
+            diff = [
+                i for i, (a, b) in enumerate(zip(got_probes or [], oracle_probes))
+                if a != b
+            ]
+            problems.append(
+                f"tenant {t}: probe matrix diverges from the oracle at "
+                f"docs {diff[:5]} (of {len(diff)})"
+            )
+    if report.get("isolation_violations"):
+        problems.append(
+            f"{report['isolation_violations']} cross-tenant probe hits — "
+            "one tenant's postings leaked into another's answers"
+        )
+    if report.get("spill_pending"):
+        problems.append(
+            f"{report['spill_pending']} spilled postings never replayed"
+        )
+    return problems
+
+
 def check_reshard_safety(case_dir: str) -> list[str]:
     """Kill-point invariant for the migration WAL: at any crash instant
     the ledger is absent or ONE whole, schema-valid document (atomic
@@ -1903,6 +2178,7 @@ VERIFIERS = {
     "stream": verify_stream,
     "pindex": verify_pindex,
     "fleet": verify_fleet,
+    "tenant": verify_tenant,
     "reshard": verify_reshard,
     "overload": verify_overload,
     "graph": verify_graph,
@@ -2177,6 +2453,54 @@ def sweep_fleet(base_dir: str, *, kills: int, seed: int = 0) -> dict:
     }
 
 
+def sweep_tenant(base_dir: str, *, kills: int, seed: int = 0) -> dict:
+    """Seeded tenant sweep: each case runs the tenant child ONCE (the
+    shard-primary SIGKILL is internal, landed mid mixed two-tenant
+    traffic), then verifies per-tenant byte-convergence against the
+    single-node oracles and the zero-leakage contract.  A 'kill landed'
+    = at least one per-tenant fleet sibling actually watched the node
+    die (failovers moved)."""
+    cases = []
+    for i in range(kills):
+        case_seed = seed * 1000 + i
+        case_dir = os.path.join(base_dir, f"tenant-k{i}")
+        os.makedirs(case_dir, exist_ok=True)
+        rec: dict = {"workload": "tenant", "seed": case_seed}
+        proc = _spawn("tenant", case_dir, case_seed, None)
+        try:
+            proc.wait(timeout=240)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            rec["problems"] = ["tenant child hung past 240 s"]
+            cases.append(rec)
+            continue
+        problems = []
+        if proc.returncode != 0:
+            problems.append(f"tenant child exited {proc.returncode}")
+        problems += verify_tenant(case_dir)
+        report_path = os.path.join(case_dir, "tenant_report.json")
+        killed = False
+        if os.path.exists(report_path):
+            with open(report_path) as f:
+                r = json.load(f)
+            killed = bool(r.get("failovers"))
+            rec["counters"] = {
+                k: r.get(k)
+                for k in ("failovers", "promotions", "spill_pending",
+                          "isolation_violations")
+            }
+        rec["killed"] = killed
+        rec["problems"] = problems
+        cases.append(rec)
+    return {
+        "workload": "tenant",
+        "cases": cases,
+        "kills": sum(1 for c in cases if c.get("killed")),
+        "problems": [p for c in cases for p in c.get("problems", [])],
+    }
+
+
 def sweep_bitrot(base_dir: str, *, kills: int, seed: int = 0) -> dict:
     """Seeded bitrot sweep: each case streams the fleet corpus with a
     seeded mid-stream silent bit flip planted in a replica's segment,
@@ -2239,7 +2563,7 @@ def main(argv=None) -> int:
     import tempfile
 
     base = args.dir or tempfile.mkdtemp(prefix="crashsweep-")
-    per = max(1, args.kills // 9)
+    per = max(1, args.kills // 10)
     report = {
         "seed": args.seed,
         "workloads": [
@@ -2258,6 +2582,7 @@ def main(argv=None) -> int:
                 chaos_only=PINDEX_CHAOS_TARGETS,
             ),
             sweep_fleet(base, kills=per, seed=args.seed),
+            sweep_tenant(base, kills=per, seed=args.seed),
             sweep_workload(
                 "reshard",
                 base,
@@ -2280,10 +2605,10 @@ def main(argv=None) -> int:
             sweep_workload(
                 "stream",
                 base,
-                # the remainder: eight workloads above each land exactly
+                # the remainder: nine workloads above each land exactly
                 # `per` instants, stream takes what's left of --kills
                 # (its one chaos case included)
-                sigkills=max(1, args.kills - 8 * per - 1),
+                sigkills=max(1, args.kills - 9 * per - 1),
                 chaos_kills=1,
                 seed=args.seed,
                 kill_window=(0.05, 1.2),

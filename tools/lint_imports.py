@@ -28,6 +28,10 @@ Rules (banned prefixes per source layer)::
                          pipeline→runtime dependency is strictly one-way,
                          so a stage fn can be anything but the runtime
                          itself knows no workload)
+    service/             must not import  pipeline/, ops/, parallel/,
+                         extractors/  (the front door rides net/index/
+                         runtime/obs and meters tenants; it never holds
+                         the dedup math)
 
 Two modules carry rules STRICTER than their layer (``MODULE_RULES``):
 ``index/reshard.py`` (the pure cutover plan/ledger — loses even the
@@ -70,6 +74,12 @@ RULES: dict[str, tuple[str, ...]] = {
     # the stage-graph runtime is workload-blind: pipeline/net/index ride
     # its edges, never the other way around
     "runtime": ("pipeline", "extractors", "net", "index"),
+    # the front door routes, meters and observes — it may ride net/,
+    # index/, runtime/ and obs/, but never the dedup machinery itself:
+    # a service→pipeline (or →ops/→parallel) import would put workload
+    # math behind the RPC socket and drag jax into the fork-cheap
+    # gateway process
+    "service": ("pipeline", "ops", "parallel", "extractors"),
     # the obs layer as a whole carries no layer-wide ban (producers all
     # over the tree import it, and some obs modules legitimately read
     # sibling layers), but the decision/canary plane gets MODULE_RULES:
@@ -125,6 +135,14 @@ MODULE_RULES: dict[str, tuple[tuple[str, ...], bool]] = {
     ),
     os.path.join("obs", "canary.py"): (
         ("pipeline", "index", "extractors", "net", "parallel"),
+        False,
+    ),
+    # tenancy is pure declarations (specs, namespace names, the
+    # registry): it loses the whole transport/storage surface its layer
+    # keeps — quota POLICY must stay separable from the gateway
+    # MECHANISM that enforces it
+    os.path.join("service", "tenancy.py"): (
+        ("net", "storage", "obs"),
         False,
     ),
 }

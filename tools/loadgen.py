@@ -23,6 +23,10 @@ Modes::
         # in-process admission-bounded RpcServer and storms it (CI smoke)
     python tools/loadgen.py --address H:P       # storm a live RPC endpoint
         # (e.g. an IndexShardServer) with mixed-priority __ping__/insert
+    python tools/loadgen.py --tenants N         # multi-tenant front-door
+        # storm: N tenants at skewed rates through a DedupGateway (an
+        # in-process gateway + 2-shard fleet, or --address for a live
+        # one), per-tenant answer checking + per-tenant SLO verdict
 
 The crashsweep ``overload`` workload reuses :func:`storm_rpc` against a
 live 2×2 fleet with a mid-storm SIGKILL; this CLI is the operator's
@@ -406,6 +410,460 @@ def run_smoke(
     return report
 
 
+# -- multi-tenant front-door storms ------------------------------------------
+
+TENANT_BANDS = 8          # band keys per doc row in tenant storms
+TENANT_SUBMIT_BATCH = 8   # docs per submit_batch request
+
+
+def _tenant_doc_keys(tenant: str, i: int):
+    """Band keys for tenant doc ``i`` — the crashsweep planted-dup scheme
+    (``i % 7 == 3`` shares keys with ``i-3``) under a PER-TENANT salt, so
+    two tenants' corpora are key-disjoint and any cross-tenant answer is
+    provably a leak."""
+    import zlib
+
+    import numpy as np
+
+    src = i - 3 if (i % 7 == 3 and i >= 3) else i
+    salt = zlib.crc32(tenant.encode()) & 0xFFFFFFFF
+    x = (
+        np.arange(TENANT_BANDS, dtype=np.uint64)
+        + np.uint64(src * 4096 + salt * 7 + 29)
+    ) * np.uint64(0x9E3779B97F4A7C15)
+    return x ^ (x >> np.uint64(31))
+
+
+def _tenant_expected(i: int) -> int:
+    """The attributed doc id a probe of tenant doc ``i`` must return once
+    ``i`` settled (its own id when unique, the planted source when dup)."""
+    return i - 3 if (i % 7 == 3 and i >= 3) else i
+
+
+def storm_tenants(
+    address,
+    *,
+    tenants,
+    duration: float,
+    workers_per_tenant: int = 2,
+    timeout: float = 5.0,
+    retries: int = 4,
+    insert_every: int = 4,
+) -> dict:
+    """Mixed-tenant storm against a live ``DedupGateway`` endpoint.
+
+    ``tenants`` is ``[(tenant_id, offered_rate), …]`` — deliberately
+    skewed rates model one noisy neighbor beside quiet ones.  Every
+    tenant's traffic is answer-CHECKED against its own deterministic
+    planted-dup corpus: each ``insert_every``-th op submits the tenant's
+    next :data:`TENANT_SUBMIT_BATCH` docs (explicit ids = doc index, so
+    a refused-then-retried batch stays verifiable), the rest probe an
+    already-settled doc and assert the exact attribution.  A final
+    refusal leaves the batch unsettled and re-submits it on the tenant's
+    next turn — re-submission tolerates self-attribution (the redelivery
+    signature), never a foreign doc.  Returns per-tenant ledgers plus
+    the cross-tenant isolation sweep: every tenant's doc-0 row probed
+    under every OTHER tenant must answer −1."""
+    import numpy as np
+
+    from advanced_scrapper_tpu.net.rpc import (
+        RpcClient,
+        RpcOverloaded,
+        RpcUnavailable,
+    )
+    from advanced_scrapper_tpu.obs import telemetry
+
+    stop_at = time.monotonic() + duration
+    ledgers: dict[str, dict] = {}
+    states: dict[str, dict] = {}
+    for tid, rate in tenants:
+        ledgers[tid] = {
+            "offered_rate": rate,
+            "offered": 0,
+            "ok": 0,
+            "rejected_final": 0,
+            "transport_failures": 0,
+            "wrong_answers": 0,
+            "wrong_samples": [],
+            "latencies": [],
+        }
+        states[tid] = {
+            "lock": threading.Lock(),   # serialises this tenant's submits
+            "settled": 0,               # docs proven applied (watermark)
+            "attempted": set(),         # batch starts ever sent (redelivery)
+        }
+
+    over0 = sum(
+        m.value
+        for m in telemetry.REGISTRY.find("astpu_rpc_client_overloaded_total")
+    )
+    wait0 = sum(
+        m.value
+        for m in telemetry.REGISTRY.find(
+            "astpu_rpc_overload_backoff_seconds_total"
+        )
+    )
+
+    def _submit(client, tid: str, led: dict, st: dict) -> None:
+        # one in-flight submit per tenant: batch b settles before b+1
+        # starts, so probe expectations below the watermark are exact
+        if not st["lock"].acquire(blocking=False):
+            return
+        try:
+            start = st["settled"]
+            rows = range(start, start + TENANT_SUBMIT_BATCH)
+            keys = np.stack([_tenant_doc_keys(tid, i) for i in rows])
+            ids = np.arange(start, start + TENANT_SUBMIT_BATCH, dtype=np.uint64)
+            redelivery = start in st["attempted"]
+            st["attempted"].add(start)
+            t0 = time.perf_counter()
+            try:
+                _h, arrs = client.call(
+                    "submit_batch", {"tenant": tid}, [keys, ids]
+                )
+            except RpcOverloaded:
+                led["offered"] += 1
+                led["rejected_final"] += 1
+                return
+            except RpcUnavailable:
+                led["offered"] += 1
+                led["transport_failures"] += 1
+                return
+            led["offered"] += 1
+            led["ok"] += 1
+            led["latencies"].append(time.perf_counter() - t0)
+            attr = np.asarray(arrs[0], np.int64).tolist()
+            for i, a in zip(rows, attr):
+                want = _tenant_expected(i)
+                good = a == (want if want != i else -1) or (
+                    redelivery and a == want
+                )
+                if not good:
+                    led["wrong_answers"] += 1
+                    if len(led["wrong_samples"]) < 5:
+                        led["wrong_samples"].append(
+                            {"doc": i, "got": a, "op": "submit"}
+                        )
+            st["settled"] = start + TENANT_SUBMIT_BATCH
+        finally:
+            st["lock"].release()
+
+    def _probe(client, tid: str, led: dict, st: dict, k: int) -> None:
+        settled = st["settled"]
+        if not settled:
+            return
+        i = k % settled
+        keys = _tenant_doc_keys(tid, i)[None, :]
+        t0 = time.perf_counter()
+        try:
+            _h, arrs = client.call("probe_batch", {"tenant": tid}, [keys])
+        except RpcOverloaded:
+            led["offered"] += 1
+            led["rejected_final"] += 1
+            return
+        except RpcUnavailable:
+            led["offered"] += 1
+            led["transport_failures"] += 1
+            return
+        led["offered"] += 1
+        led["ok"] += 1
+        led["latencies"].append(time.perf_counter() - t0)
+        got = int(np.asarray(arrs[0]).ravel()[0])
+        if got != _tenant_expected(i):
+            led["wrong_answers"] += 1
+            if len(led["wrong_samples"]) < 5:
+                led["wrong_samples"].append(
+                    {"doc": i, "got": got, "op": "probe"}
+                )
+
+    lock = threading.Lock()
+
+    def one_worker(tid: str, rate: float, wid: int):
+        client = RpcClient(
+            tuple(address), timeout=timeout, retries=retries, seed=wid
+        )
+        led_local = {
+            "offered": 0, "ok": 0, "rejected_final": 0,
+            "transport_failures": 0, "wrong_answers": 0,
+            "wrong_samples": [], "latencies": [],
+        }
+        st = states[tid]
+        interval = workers_per_tenant / max(rate, 1e-9)
+        k = wid
+        try:
+            while time.monotonic() < stop_at:
+                k += 1
+                t0 = time.perf_counter()
+                if k % insert_every == 0:
+                    _submit(client, tid, led_local, st)
+                else:
+                    _probe(client, tid, led_local, st, k)
+                sleep_left = interval - (time.perf_counter() - t0)
+                if sleep_left > 0:
+                    time.sleep(sleep_left)
+        finally:
+            client.close()
+        with lock:
+            led = ledgers[tid]
+            for key in (
+                "offered", "ok", "rejected_final", "transport_failures",
+                "wrong_answers",
+            ):
+                led[key] += led_local[key]
+            led["wrong_samples"] = (
+                led["wrong_samples"] + led_local["wrong_samples"]
+            )[:5]
+            led["latencies"] += led_local["latencies"]
+
+    threads = [
+        threading.Thread(
+            target=one_worker, args=(tid, rate, w), daemon=True
+        )
+        for tid, rate in tenants
+        for w in range(workers_per_tenant)
+    ]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration + 120)
+    elapsed = time.monotonic() - t_start
+
+    # cross-tenant isolation sweep: tenant A's keys under tenant B must
+    # be absent — any hit is a namespace leak, counted as a wrong answer
+    isolation_probes = 0
+    isolation_violations = 0
+    client = RpcClient(tuple(address), timeout=timeout, retries=retries)
+    try:
+        for tid, _r in tenants:
+            if not states[tid]["settled"]:
+                continue
+            keys = _tenant_doc_keys(tid, 0)[None, :]
+            for other, _r2 in tenants:
+                if other == tid:
+                    continue
+                _h, arrs = client.call(
+                    "probe_batch", {"tenant": other}, [keys]
+                )
+                isolation_probes += 1
+                if int(np.asarray(arrs[0]).ravel()[0]) != -1:
+                    isolation_violations += 1
+    finally:
+        client.close()
+
+    out = {
+        "elapsed_s": round(elapsed, 3),
+        "isolation_probes": isolation_probes,
+        "isolation_violations": isolation_violations,
+        "client_overload_answers": sum(
+            m.value
+            for m in telemetry.REGISTRY.find(
+                "astpu_rpc_client_overloaded_total"
+            )
+        )
+        - over0,
+        "retry_after_honored_s": round(
+            sum(
+                m.value
+                for m in telemetry.REGISTRY.find(
+                    "astpu_rpc_overload_backoff_seconds_total"
+                )
+            )
+            - wait0,
+            4,
+        ),
+        "tenants": {},
+    }
+    for tid, led in ledgers.items():
+        vals = sorted(led.pop("latencies"))
+        led["settled_docs"] = states[tid]["settled"]
+        led["latency_ms"] = {
+            "n": len(vals),
+            "p50": round(_percentile(vals, 0.50) * 1e3, 3),
+            "p99": round(_percentile(vals, 0.99) * 1e3, 3),
+        }
+        out["tenants"][tid] = led
+    return out
+
+
+def tenant_reject_snapshot() -> dict:
+    """Per-tenant quota-reject counts from the gateway's own ledger."""
+    from advanced_scrapper_tpu.obs import telemetry
+
+    out: dict[str, float] = {}
+    for m in telemetry.REGISTRY.find("astpu_tenant_rejected_total"):
+        tid = m.labels.get("tenant", "?")
+        out[tid] = out.get(tid, 0.0) + m.value
+    return out
+
+
+def run_tenant_smoke(
+    *,
+    tenants: int = 3,
+    duration: float = 1.5,
+    workers_per_tenant: int = 2,
+    base_rate: float = 60.0,
+) -> dict:
+    """Self-contained mixed-tenant storm: an in-process 2-shard fleet
+    behind a :class:`~advanced_scrapper_tpu.service.gateway.DedupGateway`
+    with skewed per-tenant quotas — the LAST tenant is the noisy
+    neighbor, offered well past its tiny bucket so its shed is visible
+    while every other tenant stays reject-free.  Verdict via the SLO
+    engine over the gateway's own per-tenant objectives."""
+    import shutil
+    import tempfile
+
+    from advanced_scrapper_tpu.index.fleet import FleetSpec, ShardedIndexClient
+    from advanced_scrapper_tpu.index.remote import IndexShardServer
+    from advanced_scrapper_tpu.obs import telemetry
+    from advanced_scrapper_tpu.obs.slo import SloEngine
+    from advanced_scrapper_tpu.service import (
+        DedupGateway,
+        TenantRegistry,
+        TenantSpec,
+    )
+
+    telemetry_was = telemetry.enabled()
+    if not telemetry_was:
+        telemetry.set_enabled(True)
+
+    tenants = max(2, int(tenants))
+    names = [f"t{i}" for i in range(tenants)]
+    noisy = names[-1]
+    noisy_capacity = base_rate / 3.0
+    specs = [
+        TenantSpec(
+            tid,
+            # quiet tenants ride uncapped buckets; the noisy one gets a
+            # bucket a third of its offered rate — it MUST shed
+            rate=0.0 if tid != noisy else noisy_capacity,
+            burst=None if tid != noisy else max(2.0, noisy_capacity / 4),
+            max_inflight=workers_per_tenant * 4,
+            p99_slo_s=1.0,
+            # shedding ~2/3 of a 3× storm is the DESIGNED outcome for
+            # the noisy tenant; the quiet ones must not shed at all
+            reject_budget=0.97 if tid == noisy else 0.05,
+        )
+        for tid in names
+    ]
+    base = tempfile.mkdtemp(prefix="loadgen-tenants-")
+    servers = []
+    gw = None
+    client = None
+    try:
+        servers = [
+            IndexShardServer(
+                os.path.join(base, f"s{i}"),
+                spaces=("bands",),
+                cut_postings=6 * TENANT_BANDS,
+                compact_segments=4,
+                compact_inline=True,
+                name=f"s{i}",
+            ).start()
+            for i in range(2)
+        ]
+        client = ShardedIndexClient(
+            FleetSpec(
+                shards=tuple(
+                    (("127.0.0.1", s.server.port),) for s in servers
+                )
+            ),
+            space="bands",
+            timeout=5.0,
+            retries=2,
+        )
+        gw = DedupGateway(
+            client,
+            registry=TenantRegistry(specs, auto_provision=False),
+            name="loadgen",
+            stats_interval=0.0,
+        ).start()
+        # skewed offered rates: tenant k offers ~2^k × the base share;
+        # the noisy last tenant is ALSO offered 3× its declared bucket
+        offered = [
+            (tid, base_rate * (2.0 ** i)) for i, tid in enumerate(names[:-1])
+        ]
+        offered.append((noisy, noisy_capacity * 3.0))
+        for tid, _r in offered:
+            gw._ensure(tid)  # provision up front: objectives exist pre-storm
+        slo = SloEngine(gw.objectives())
+        slo.evaluate()
+        rejects0 = tenant_reject_snapshot()
+        report = storm_tenants(
+            ("127.0.0.1", gw.port),
+            tenants=offered,
+            duration=duration,
+            workers_per_tenant=workers_per_tenant,
+            retries=3,
+        )
+        report["slo"] = slo.evaluate()
+        rejects1 = tenant_reject_snapshot()
+        report["quota_rejects"] = {
+            tid: rejects1.get(tid, 0.0) - rejects0.get(tid, 0.0)
+            for tid in names
+        }
+    finally:
+        if gw is not None:
+            gw.stop()
+        if client is not None:
+            client.close()
+        for s in servers:
+            s.stop()
+        shutil.rmtree(base, ignore_errors=True)
+        if not telemetry_was:
+            telemetry.set_enabled(None)
+
+    report["noisy_tenant"] = noisy
+    problems = []
+    total_transport = sum(
+        led["transport_failures"] for led in report["tenants"].values()
+    )
+    total_wrong = sum(
+        led["wrong_answers"] for led in report["tenants"].values()
+    )
+    if total_transport:
+        problems.append(
+            f"{total_transport} calls died on transport — tenant quota "
+            "refusals leaked into the failover path"
+        )
+    if total_wrong:
+        problems.append(f"{total_wrong} wrong answers across tenants")
+    if report["isolation_violations"]:
+        problems.append(
+            f"{report['isolation_violations']} cross-tenant probes found "
+            "another tenant's postings"
+        )
+    if not report["quota_rejects"].get(noisy):
+        problems.append(
+            f"noisy tenant {noisy} stormed 3x its bucket but was never "
+            "quota-rejected"
+        )
+    quiet_rejected = {
+        tid: led["rejected_final"]
+        for tid, led in report["tenants"].items()
+        if tid != noisy and led["rejected_final"]
+    }
+    if quiet_rejected:
+        problems.append(
+            f"quota isolation failed: uncapped tenants saw final rejects "
+            f"{quiet_rejected}"
+        )
+    if (
+        report["retry_after_honored_s"] <= 0
+        and report["client_overload_answers"]
+    ):
+        problems.append("client never honored a tenant retry-after hint")
+    for led in report["tenants"].values():
+        if not led["ok"]:
+            problems.append("a tenant completed zero requests")
+            break
+    if not report["slo"]["ok"]:
+        problems.append(f"per-tenant SLO violated: {report['slo']}")
+    report["problems"] = problems
+    report["ok_verdict"] = not problems
+    return report
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -424,10 +882,49 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--duration", type=float, default=1.5, help="seconds")
     ap.add_argument("--workers", type=int, default=6)
+    ap.add_argument(
+        "--tenants", type=int, default=0,
+        help="mixed-tenant front-door storm with N tenants at skewed "
+        "rates (in-process gateway+fleet, or --address for a live one)",
+    )
+    ap.add_argument(
+        "--tenant-rate", type=float, default=60.0,
+        help="tenant storm: base offered req/s (tenant k offers ~2^k x)",
+    )
     ap.add_argument("--out", default=None, help="write the JSON report here")
     args = ap.parse_args(argv)
 
-    if args.smoke or not args.address:
+    if args.tenants:
+        if args.address:
+            host, _, port = args.address.rpartition(":")
+            names = [f"t{i}" for i in range(max(2, args.tenants))]
+            report = storm_tenants(
+                (host, int(port)),
+                tenants=[
+                    (tid, args.tenant_rate * (2.0 ** i))
+                    for i, tid in enumerate(names)
+                ],
+                duration=args.duration,
+                workers_per_tenant=max(1, args.workers // len(names)),
+            )
+            report["quota_rejects"] = tenant_reject_snapshot()
+            total_bad = (
+                sum(
+                    led["transport_failures"] + led["wrong_answers"]
+                    for led in report["tenants"].values()
+                )
+                + report["isolation_violations"]
+            )
+            report["problems"] = []
+            report["ok_verdict"] = total_bad == 0
+        else:
+            report = run_tenant_smoke(
+                tenants=args.tenants,
+                duration=args.duration,
+                workers_per_tenant=max(1, args.workers // args.tenants),
+                base_rate=args.tenant_rate,
+            )
+    elif args.smoke or not args.address:
         report = run_smoke(
             rate_multiple=args.rate_multiple,
             duration=args.duration,

@@ -28,6 +28,13 @@ Two modes:
   the canary prober's ground-truth SLIs (``astpu_canary_recall`` /
   ``_precision``, round latency and cadence) and the canary SLO
   verdicts; the sticky line tracks recall/precision plus compliance.
+- ``--tenants`` (combinable with ``--once``): the multi-tenant front-door
+  view — per-tenant admit/reject/error rates and quota refusals by reason
+  (``astpu_tenant_requests_total`` / ``_rejected_total``), key-space
+  posting counts (``astpu_tenant_postings``), per-tenant verb p99 and the
+  tenant SLO error-budget burn; the sticky line ranks the hottest tenants
+  and flags violated tenant objectives.  Point ``--url`` at a gateway's
+  metrics sidecar (``service/gateway.py --metrics-port``).
 - live (default): the :class:`obs.console.ConsoleMux` idiom — a sticky
   one-line summary repainted in place (per-stage rates computed from
   successive histogram snapshots, queue depths, fleet health) with notable
@@ -444,6 +451,167 @@ def render_quality_frame(
     return lines
 
 
+def _tenant_ids(status: dict) -> list[str]:
+    ids = set()
+    for m in status.get("metrics", []):
+        if m["name"].startswith("astpu_tenant_"):
+            tid = (m.get("labels") or {}).get("tenant")
+            if tid:
+                ids.add(tid)
+    return sorted(ids)
+
+
+def render_tenants_frame(
+    status: dict, prev: dict | None = None, dt: float = 0.0
+) -> list[str]:
+    """The multi-tenant front-door view (``--tenants``): per-tenant
+    admit/reject/shed rates from the gateway's ``astpu_tenant_*``
+    ledger, key-space posting counts, verb p99s and the per-tenant SLO
+    error-budget burn.  Point ``--url`` at a gateway's metrics sidecar
+    (or a collector merge)."""
+    idx = _index(status)
+    pidx = _index(prev) if prev else {}
+    lines: list[str] = []
+    tenants = _tenant_ids(status)
+    lines.append("  tenants (front-door gateway):")
+    if not tenants:
+        lines.append("    (no astpu_tenant_* series — is a gateway serving?)")
+        return lines
+
+    def rate(key: str, value: float) -> str:
+        if key in pidx and dt > 0:
+            return f"{(value - pidx[key].get('value', 0)) / dt:.1f}"
+        return ""
+
+    lines.append(
+        f"    {'tenant':<12} {'verb':<13} {'outcome':<9} {'count':>10} "
+        f"{'rate/s':>8}"
+    )
+    for m in sorted(
+        (
+            m for m in status.get("metrics", [])
+            if m["name"] == "astpu_tenant_requests_total"
+        ),
+        key=_series_key,
+    ):
+        labels = m.get("labels") or {}
+        lines.append(
+            f"    {labels.get('tenant', '?'):<12} "
+            f"{labels.get('verb', '?'):<13} "
+            f"{labels.get('outcome', '?'):<9} {m['value']:>10.0f} "
+            f"{rate(_series_key(m), m['value']):>8}"
+        )
+    rejects = [
+        m for m in status.get("metrics", [])
+        if m["name"] == "astpu_tenant_rejected_total" and m["value"]
+    ]
+    if rejects:
+        lines.append("")
+        lines.append("  quota rejects (answered RpcOverloaded + retry-after):")
+        for m in sorted(rejects, key=_series_key):
+            labels = m.get("labels") or {}
+            lines.append(
+                f"    {labels.get('tenant', '?'):<12} "
+                f"{labels.get('reason', '?'):<10} {m['value']:>10.0f} "
+                f"{rate(_series_key(m), m['value']):>8}"
+            )
+
+    lines.append("")
+    lines.append(
+        f"    {'tenant':<12} {'postings':>10} {'inflight':>9} "
+        f"{'pressure':>9} {'p99_ms':>8} {'burn':>6}"
+    )
+    for tid in tenants:
+        postings = next(
+            (
+                m["value"] for m in status.get("metrics", [])
+                if m["name"] == "astpu_tenant_postings"
+                and (m.get("labels") or {}).get("tenant") == tid
+            ),
+            None,
+        )
+        inflight = idx.get(f"astpu_admission_inflight{{gate=tenant:{tid}}}")
+        pressure = idx.get(f"astpu_admission_pressure{{gate=tenant:{tid}}}")
+        p99 = max(
+            (
+                m.get("p99_ms", 0.0) for m in status.get("metrics", [])
+                if m["name"] == "astpu_tenant_seconds"
+                and (m.get("labels") or {}).get("tenant") == tid
+            ),
+            default=0.0,
+        )
+        burn = max(
+            (
+                m["value"] for m in status.get("metrics", [])
+                if m["name"] == "astpu_slo_burn_rate"
+                and (m.get("labels") or {})
+                .get("objective", "")
+                .startswith(f"tenant_{tid}_")
+                and (m.get("labels") or {}).get("window") == "fast"
+            ),
+            default=0.0,
+        )
+        post_s = "?" if postings is None else f"{postings:.0f}"
+        infl_s = "?" if inflight is None else f"{inflight['value']:.0f}"
+        pres_s = "?" if pressure is None else f"{pressure['value']:.2f}"
+        lines.append(
+            f"    {tid:<12} {post_s:>10} {infl_s:>9} {pres_s:>9} "
+            f"{p99:>8.1f} {burn:>6.2f}"
+        )
+
+    bad = [
+        (m.get("labels") or {}).get("objective", "?")
+        for m in status.get("metrics", [])
+        if m["name"] == "astpu_slo_compliant"
+        and (m.get("labels") or {}).get("objective", "").startswith("tenant_")
+        and m["value"] == 0
+    ]
+    if bad:
+        lines.append("")
+        lines.append(f"  tenant slo VIOLATED: {', '.join(sorted(bad))}")
+    return lines
+
+
+def tenants_summary_line(status: dict, prev: dict | None, dt: float) -> str:
+    """Sticky one-liner for live ``--tenants`` mode: per-tenant ok/rej
+    rates (hottest first) and any violated tenant objective."""
+    idx = _index(status)
+    pidx = _index(prev) if prev else {}
+    per: dict[str, dict[str, float]] = {}
+    for key, m in idx.items():
+        if m["name"] != "astpu_tenant_requests_total":
+            continue
+        labels = m.get("labels") or {}
+        tid = labels.get("tenant", "?")
+        outcome = labels.get("outcome", "?")
+        d = (
+            (m["value"] - pidx[key].get("value", 0)) / dt
+            if key in pidx and dt > 0
+            else 0.0
+        )
+        per.setdefault(tid, {})
+        per[tid][outcome] = per[tid].get(outcome, 0.0) + d
+    if not per:
+        return "(no tenant series yet)"
+    ranked = sorted(
+        per.items(), key=lambda kv: -sum(kv[1].values())
+    )
+    parts = [
+        f"{tid} ok {o.get('ok', 0):.0f}/s rej {o.get('rejected', 0):.0f}/s"
+        for tid, o in ranked[:4]
+    ]
+    bad = [
+        (m.get("labels") or {}).get("objective", "?")
+        for m in status.get("metrics", [])
+        if m["name"] == "astpu_slo_compliant"
+        and (m.get("labels") or {}).get("objective", "").startswith("tenant_")
+        and m["value"] == 0
+    ]
+    if bad:
+        parts.append(f"slo violated: {','.join(sorted(bad))}")
+    return " | ".join(parts)
+
+
 def quality_summary_line(status: dict, prev: dict | None, dt: float) -> str:
     """Sticky one-liner for live ``--quality`` mode: canary SLIs, the
     hottest decision tiers by rate, and any violated canary objective."""
@@ -612,6 +780,13 @@ def main(argv=None) -> int:
         "canary ground-truth SLIs and the canary SLO verdicts",
     )
     ap.add_argument(
+        "--tenants",
+        action="store_true",
+        help="multi-tenant view: per-tenant admit/reject rates, quota "
+        "refusals by reason, key-space posting counts, p99 and SLO "
+        "error-budget burn (point --url at a gateway's metrics sidecar)",
+    )
+    ap.add_argument(
         "--frames", type=int, default=0, help="stop after N polls (0 = forever)"
     )
     args = ap.parse_args(argv)
@@ -637,13 +812,16 @@ def main(argv=None) -> int:
             lines = render_graph_frame(status)
         elif args.quality:
             lines = render_quality_frame(status)
+        elif args.tenants:
+            lines = render_tenants_frame(status)
         else:
             lines = render_frame(status)
-        if args.graph or args.fleet or args.quality:
+        if args.graph or args.fleet or args.quality or args.tenants:
             mode = (
                 "--fleet" if args.fleet
                 else "--graph" if args.graph
-                else "--quality"
+                else "--quality" if args.quality
+                else "--tenants"
             )
             head = f"obs_top {mode} @ {time.strftime('%H:%M:%S', time.localtime(status.get('ts')))}"
             lines = [head] + lines
@@ -697,6 +875,8 @@ def main(argv=None) -> int:
                 sticky = graph_summary_line(status, prev, dt)
             elif args.quality:
                 sticky = quality_summary_line(status, prev, dt)
+            elif args.tenants:
+                sticky = tenants_summary_line(status, prev, dt)
             else:
                 sticky = summary_line(status, prev, dt)
             mux.stats(sticky)
